@@ -1,0 +1,33 @@
+"""dbsp_tpu: a TPU-native framework for incremental view maintenance over data streams.
+
+A brand-new JAX/XLA design with the capabilities of DBSP
+(vmware/database-stream-processor): computations are dataflow circuits of
+operators over Z-sets (multisets with signed integer weights), evaluated
+incrementally so each clock tick costs in proportion to the input delta, not
+the accumulated state.
+
+Architecture (TPU-first, not a port):
+  - Z-set batches are columnar struct-of-arrays device buffers with static
+    capacities, zero-weight padding, and sort-based consolidation kernels
+    (``dbsp_tpu.zset``).
+  - Traces are LSM-style spines of geometric size classes with amortized
+    device merges (``dbsp_tpu.trace``).
+  - The circuit is a host-side DAG driving jitted per-operator kernels
+    (``dbsp_tpu.circuit``, ``dbsp_tpu.operators``).
+  - Worker parallelism is SPMD over a ``jax.sharding.Mesh``: the reference's
+    key-hash shard()/exchange maps to an all_to_all over ICI
+    (``dbsp_tpu.parallel``).
+
+64-bit integers are enabled globally: stream timestamps (ms since epoch) and
+SQL BIGINT semantics require them.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from dbsp_tpu.zset.batch import Batch  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["Batch", "__version__"]

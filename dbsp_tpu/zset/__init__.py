@@ -1,0 +1,4 @@
+from dbsp_tpu.zset.batch import Batch, concat_batches, bucket_cap, WEIGHT_DTYPE
+from dbsp_tpu.zset import kernels
+
+__all__ = ["Batch", "concat_batches", "bucket_cap", "WEIGHT_DTYPE", "kernels"]

@@ -1,0 +1,196 @@
+"""Columnar Z-set batches — the TPU-native answer to the reference's ordered
+batch family (``crates/dbsp/src/trace/ord/``: ``OrdZSet``, ``OrdIndexedZSet``)
+and its trie layers (``trace/layers/column_layer/mod.rs:31`` — whose
+struct-of-arrays ``keys``/``diffs`` vectors validate this representation).
+
+A :class:`Batch` is a pytree of flat device columns with a *static capacity*:
+
+    keys:    tuple of [cap] arrays — the indexing columns (lexicographic order)
+    vals:    tuple of [cap] arrays — the value columns
+    weights: [cap] signed integers — Z-set multiplicities (0 == dead row)
+
+Invariants of a *consolidated* batch (the canonical form every operator
+produces):
+  * rows are sorted lexicographically by (keys, vals),
+  * no two live rows are equal on (keys, vals),
+  * live rows (weight != 0) are packed at the front; dead rows carry per-dtype
+    sentinel keys (max value) so a plain ascending sort keeps them last.
+
+Capacities are powers of two chosen by the host (see :func:`bucket_cap`);
+growth recompiles the operator kernel for the next bucket only, so the set of
+compiled shapes stays logarithmic in state size (XLA static-shape discipline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbsp_tpu.zset import kernels
+
+WEIGHT_DTYPE = jnp.int64
+
+Row = Tuple  # host-side row: tuple of python scalars
+
+
+def bucket_cap(n: int, minimum: int = 8) -> int:
+    """Round ``n`` up to a power-of-two capacity bucket."""
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """An immutable columnar Z-set batch (possibly un-consolidated)."""
+
+    keys: Tuple[jnp.ndarray, ...]
+    vals: Tuple[jnp.ndarray, ...]
+    weights: jnp.ndarray
+
+    # -- pytree plumbing ----------------------------------------------------
+    def tree_flatten(self):
+        return ((self.keys, self.vals, self.weights), (len(self.keys), len(self.vals)))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, vals, weights = children
+        return cls(tuple(keys), tuple(vals), weights)
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def cap(self) -> int:
+        return int(self.weights.shape[0])
+
+    @property
+    def cols(self) -> Tuple[jnp.ndarray, ...]:
+        return (*self.keys, *self.vals)
+
+    def key_dtypes(self):
+        return tuple(k.dtype for k in self.keys)
+
+    def val_dtypes(self):
+        return tuple(v.dtype for v in self.vals)
+
+    def live_count(self) -> jnp.ndarray:
+        """Number of live rows (device scalar)."""
+        return jnp.sum(self.weights != 0)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def empty(key_dtypes: Sequence, val_dtypes: Sequence = (), cap: int = 8,
+              weight_dtype=WEIGHT_DTYPE) -> "Batch":
+        keys = tuple(kernels.sentinel_fill((cap,), d) for d in key_dtypes)
+        vals = tuple(kernels.sentinel_fill((cap,), d) for d in val_dtypes)
+        return Batch(keys, vals, jnp.zeros((cap,), weight_dtype))
+
+    @staticmethod
+    def from_columns(keys: Sequence[jnp.ndarray], vals: Sequence[jnp.ndarray],
+                     weights: jnp.ndarray, cap: int | None = None,
+                     consolidated: bool = False) -> "Batch":
+        """Build (and by default consolidate) a batch from raw device columns."""
+        n = int(weights.shape[0])
+        for c in (*keys, *vals):
+            assert c.shape[0] == n, (
+                f"column length {c.shape[0]} != weights length {n}")
+        cap = cap or bucket_cap(n)
+        keys = tuple(_pad_sentinel(jnp.asarray(k), cap) for k in keys)
+        vals = tuple(_pad_sentinel(jnp.asarray(v), cap) for v in vals)
+        w = jnp.zeros((cap,), WEIGHT_DTYPE).at[:n].set(
+            jnp.asarray(weights, WEIGHT_DTYPE))
+        b = Batch(keys, vals, w)
+        return b if consolidated else b.consolidate()
+
+    @staticmethod
+    def from_tuples(rows: Sequence[Tuple[Row, int]], key_dtypes: Sequence,
+                    val_dtypes: Sequence = (), cap: int | None = None) -> "Batch":
+        """Host-side constructor from ((key..., val...), weight) pairs.
+
+        The analog of the reference's ``Batch::from_tuples``
+        (``trace/mod.rs:237``); used by tests and input handles.
+        """
+        nk, nv = len(key_dtypes), len(val_dtypes)
+        n = len(rows)
+        cap = cap or bucket_cap(max(n, 1))
+        kcols = [np.empty((n,), jnp.dtype(d)) for d in key_dtypes]
+        vcols = [np.empty((n,), jnp.dtype(d)) for d in val_dtypes]
+        ws = np.empty((n,), jnp.dtype(WEIGHT_DTYPE))
+        for i, (row, w) in enumerate(rows):
+            assert len(row) == nk + nv, f"row arity {len(row)} != {nk}+{nv}"
+            for j in range(nk):
+                kcols[j][i] = row[j]
+            for j in range(nv):
+                vcols[j][i] = row[nk + j]
+            ws[i] = w
+        return Batch.from_columns(kcols, vcols, ws, cap=cap)
+
+    # -- canonicalization ---------------------------------------------------
+    def consolidate(self) -> "Batch":
+        cols, w = kernels.consolidate_cols(self.cols, self.weights)
+        nk = len(self.keys)
+        return Batch(cols[:nk], cols[nk:], w)
+
+    def with_cap(self, cap: int) -> "Batch":
+        """Grow or shrink capacity. Shrinking assumes live rows fit (caller
+        checked ``live_count``); consolidated batches keep live rows first."""
+        if cap == self.cap:
+            return self
+        if cap > self.cap:
+            keys = tuple(_pad_sentinel(k, cap) for k in self.keys)
+            vals = tuple(_pad_sentinel(v, cap) for v in self.vals)
+            w = jnp.zeros((cap,), self.weights.dtype).at[: self.cap].set(self.weights)
+            return Batch(keys, vals, w)
+        return Batch(tuple(k[:cap] for k in self.keys),
+                     tuple(v[:cap] for v in self.vals), self.weights[:cap])
+
+    # -- algebra (reference: crates/dbsp/src/algebra) -----------------------
+    def neg(self) -> "Batch":
+        """Z-set group inverse: negate all weights."""
+        return Batch(self.keys, self.vals, -self.weights)
+
+    def scale(self, c) -> "Batch":
+        return Batch(self.keys, self.vals, self.weights * c)
+
+    def add(self, other: "Batch") -> "Batch":
+        """Z-set group addition (concatenate + consolidate)."""
+        return concat_batches([self, other]).consolidate()
+
+    # -- host-side views (tests / output handles) ---------------------------
+    def to_dict(self) -> Dict[Row, int]:
+        """Materialize as {(key..., val...): weight} — the test oracle format."""
+        cols = [np.asarray(c) for c in self.cols]
+        ws = np.asarray(self.weights)
+        out: Dict[Row, int] = {}
+        for i in range(len(ws)):
+            if ws[i] != 0:
+                row = tuple(c[i].item() for c in cols)
+                out[row] = out.get(row, 0) + int(ws[i])
+        return {r: w for r, w in out.items() if w != 0}
+
+
+def _pad_sentinel(col: jnp.ndarray, cap: int) -> jnp.ndarray:
+    n = col.shape[0]
+    if n == cap:
+        return col
+    assert n < cap, f"column of {n} rows exceeds capacity {cap}"
+    return jnp.concatenate([col, kernels.sentinel_fill((cap - n,), col.dtype)])
+
+
+def concat_batches(batches: Sequence[Batch]) -> Batch:
+    """Stack batches into one (un-consolidated) batch of summed capacity."""
+    assert batches
+    first = batches[0]
+    keys = tuple(
+        jnp.concatenate([b.keys[i] for b in batches])
+        for i in range(len(first.keys)))
+    vals = tuple(
+        jnp.concatenate([b.vals[i] for b in batches])
+        for i in range(len(first.vals)))
+    w = jnp.concatenate([b.weights for b in batches])
+    return Batch(keys, vals, w)

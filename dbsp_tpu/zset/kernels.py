@@ -1,0 +1,216 @@
+"""Low-level device kernels shared by the Z-set batch layer.
+
+These are the TPU-native replacements for the reference engine's
+consolidation / trie-layer machinery (reference: ``crates/dbsp/src/trace/
+consolidation/`` and ``trace/layers/advance.rs``): instead of in-place
+quicksort + pairwise merges over growable vectors, everything is expressed as
+static-shape ``lax.sort`` / segmented-scan programs that XLA can fuse and tile.
+
+All kernels operate on flat ``[cap]`` columns. Row validity is carried by the
+weight column (weight == 0 <=> dead row); dead rows hold per-dtype sentinel
+keys (max value) so that a single ascending sort moves them to the end.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# Sentinels
+# ---------------------------------------------------------------------------
+
+
+def sentinel_for(dtype) -> jnp.ndarray:
+    """Largest representable value of ``dtype`` — reserved to mark dead rows."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype=dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(True)
+    raise TypeError(f"unsupported column dtype {dtype}")
+
+
+def sentinel_fill(shape, dtype) -> jnp.ndarray:
+    return jnp.full(shape, sentinel_for(dtype), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Row-wise lexicographic sort
+# ---------------------------------------------------------------------------
+
+
+def sort_rows(cols: Sequence[jnp.ndarray], payload: Sequence[jnp.ndarray]
+              ) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...]]:
+    """Stable ascending lexicographic sort by ``cols``; ``payload`` rides along.
+
+    Zero-column rows (unit-keyed Z-sets, e.g. a global COUNT(*)) are a valid
+    degenerate case: every row is equal, nothing to sort.
+    """
+    if not cols:
+        return (), tuple(payload)
+    ops = (*cols, *payload)
+    out = lax.sort(ops, num_keys=len(cols), is_stable=True)
+    return tuple(out[: len(cols)]), tuple(out[len(cols):])
+
+
+def _col_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Element equality under the total order lax.sort uses: NaN == NaN."""
+    eq = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(a) & jnp.isnan(b))
+    return eq
+
+
+def rows_equal_prev(cols: Sequence[jnp.ndarray], n: int | None = None
+                    ) -> jnp.ndarray:
+    """For sorted columns: mask[i] = row i equals row i-1 (mask[0] = False).
+
+    With zero columns all rows are the unit row, hence equal; ``n`` supplies
+    the row count for that case.
+    """
+    if not cols:
+        assert n is not None
+        return jnp.arange(n) > 0
+    n = cols[0].shape[0]
+    eq = jnp.ones((n,), dtype=jnp.bool_)
+    for c in cols:
+        eq = eq & jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), _col_eq(c[1:], c[:-1])])
+    return eq
+
+
+# ---------------------------------------------------------------------------
+# Compaction: scatter live rows to the front, sentinel-fill the rest
+# ---------------------------------------------------------------------------
+
+
+def compact(cols: Sequence[jnp.ndarray], weights: jnp.ndarray,
+            keep: jnp.ndarray) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
+    """Move rows with ``keep`` to the front (order preserved); rest is dead.
+
+    Equivalent of the reference's in-place ``retain`` on batch vectors, as a
+    cumsum + scatter-with-drop so the shape stays static.
+    """
+    cap = weights.shape[0]
+    dest = jnp.cumsum(keep) - 1
+    idx = jnp.where(keep, dest, cap)  # cap is out of bounds -> dropped
+    out_cols = []
+    for c in cols:
+        buf = sentinel_fill((cap,), c.dtype)
+        out_cols.append(buf.at[idx].set(c, mode="drop"))
+    w = jnp.zeros((cap,), weights.dtype).at[idx].set(weights, mode="drop")
+    return tuple(out_cols), w
+
+
+# ---------------------------------------------------------------------------
+# Consolidation: sort + sum weights of identical rows + compact
+# ---------------------------------------------------------------------------
+
+
+def consolidate_cols(cols: Sequence[jnp.ndarray], weights: jnp.ndarray
+                     ) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
+    """Canonicalize a weighted row set (reference: ``trace/consolidation``).
+
+    Sorts rows lexicographically, sums weights of equal rows, drops rows whose
+    net weight is zero, and packs survivors to the front. Output capacity ==
+    input capacity; tail rows are dead (weight 0, sentinel keys).
+    """
+    cap = weights.shape[0]
+    cols, (weights,) = sort_rows(cols, (weights,))
+    dup = rows_equal_prev(cols, n=cap)
+    seg = jnp.cumsum(~dup) - 1  # segment id per row, first-of-group gets new id
+    sums = jax.ops.segment_sum(weights, seg, num_segments=cap)
+    w_new = jnp.where(dup, 0, sums[seg]).astype(weights.dtype)
+    keep = w_new != 0
+    return compact(cols, w_new, keep)
+
+
+# ---------------------------------------------------------------------------
+# Lexicographic searchsorted over multi-column sorted tables
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("side",))
+def lex_searchsorted(table_cols: Tuple[jnp.ndarray, ...],
+                     query_cols: Tuple[jnp.ndarray, ...],
+                     side: str = "left") -> jnp.ndarray:
+    """Insertion points of ``query`` rows into lexicographically sorted ``table``.
+
+    TPU-native replacement for the reference's exponential-search ``advance``
+    (``trace/layers/advance.rs``): instead of data-dependent binary search we
+    sort table and query rows together once; a query row's position in the
+    merged order, minus the number of queries before it, is its insertion
+    index. O((n+m) log(n+m)), fully static shapes, any number of key columns.
+    """
+    assert len(table_cols) == len(query_cols) and table_cols
+    n = table_cols[0].shape[0]
+    m = query_cols[0].shape[0]
+    # Tie-break flag: for 'left' queries sort before equal table rows.
+    tflag = 1 if side == "left" else 0
+    flags = jnp.concatenate(
+        [jnp.full((n,), tflag, jnp.int32), jnp.full((m,), 1 - tflag, jnp.int32)]
+    )
+    pos = jnp.concatenate(
+        [jnp.zeros((n,), jnp.int32), jnp.arange(m, dtype=jnp.int32)]
+    )
+    cols = tuple(
+        jnp.concatenate([t, q.astype(t.dtype)])
+        for t, q in zip(table_cols, query_cols)
+    )
+    *_, sflags, spos = lax.sort((*cols, flags, pos), num_keys=len(cols) + 1,
+                                is_stable=True)
+    is_query = sflags == (1 - tflag)
+    q_before = jnp.cumsum(is_query) - jnp.where(is_query, 1, 0)
+    insertion = jnp.arange(n + m, dtype=jnp.int32) - q_before.astype(jnp.int32)
+    out = jnp.zeros((m,), jnp.int32)
+    out = out.at[jnp.where(is_query, spos, m)].set(insertion, mode="drop")
+    return out
+
+
+def searchsorted1(table: jnp.ndarray, query: jnp.ndarray,
+                  side: str = "left") -> jnp.ndarray:
+    """Single-column fast path (jnp.searchsorted lowers to a vectorized scan)."""
+    return jnp.searchsorted(table, query.astype(table.dtype), side=side
+                            ).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Range expansion: turn per-row [lo, hi) ranges into a flat gather index list
+# ---------------------------------------------------------------------------
+
+
+def expand_ranges(lo: jnp.ndarray, hi: jnp.ndarray, out_cap: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Flatten variable-length ranges into static-capacity index arrays.
+
+    Given m ranges [lo_i, hi_i), produces for each output slot j < total:
+      src_row[j]  — which input row the slot belongs to,
+      src_idx[j]  — lo[src_row] + offset within the range,
+      valid[j]    — j < total,
+    plus the (device, scalar) total. This is the two-pass count/scan/scatter
+    shape the reference's join fan-out uses, with the scatter replaced by a
+    searchsorted over the prefix sums (static shapes; TPU-friendly gathers).
+
+    OVERFLOW CONTRACT: when ``total > out_cap`` only the first ``out_cap``
+    range elements are emitted. Callers MUST host-check ``total`` against
+    ``out_cap`` and re-run with a grown capacity bucket — see
+    ``operators/join.py``. ``total`` is returned (not clamped) precisely so
+    that check is possible.
+    """
+    counts = jnp.maximum(hi - lo, 0)
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
+    total = jnp.sum(counts)
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = searchsorted1(starts, jnp.minimum(j, total - 1), side="right") - 1
+    row = jnp.clip(row, 0, lo.shape[0] - 1)
+    offset = j - starts[row]
+    src = lo[row] + offset
+    valid = j < total
+    return row, src.astype(jnp.int32), valid, total

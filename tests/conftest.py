@@ -1,0 +1,11 @@
+# Force tests onto the CPU backend with 8 virtual devices so multi-worker
+# sharding (Mesh/shard_map/all_to_all) is exercised without TPU hardware.
+# Must run before jax is imported anywhere.
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

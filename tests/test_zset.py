@@ -1,0 +1,181 @@
+"""Z-set batch layer tests against a host dict oracle.
+
+Mirrors the reference's model-checked batch tests
+(``crates/dbsp/src/trace/test_batch.rs``): every device kernel result is
+compared with a naive {row: weight} dict computed in Python.
+"""
+
+import random
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.zset import Batch, concat_batches, kernels
+
+
+def dict_add(a, b):
+    out = dict(a)
+    for r, w in b.items():
+        out[r] = out.get(r, 0) + w
+        if out[r] == 0:
+            del out[r]
+    return out
+
+
+def random_rows(rng, n, key_range=10, val_range=5, nvals=1):
+    rows = []
+    for _ in range(n):
+        key = rng.randrange(key_range)
+        vals = tuple(rng.randrange(val_range) for _ in range(nvals))
+        w = rng.choice([-2, -1, 1, 2, 3])
+        rows.append(((key, *vals), w))
+    return rows
+
+
+def oracle(rows):
+    d = {}
+    for r, w in rows:
+        d[r] = d.get(r, 0) + w
+        if d[r] == 0:
+            del d[r]
+    return d
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("n", [0, 1, 7, 64])
+def test_from_tuples_consolidates(seed, n):
+    rng = random.Random(seed)
+    rows = random_rows(rng, n)
+    b = Batch.from_tuples(rows, key_dtypes=[jnp.int64], val_dtypes=[jnp.int32])
+    assert b.to_dict() == oracle(rows)
+
+
+def test_consolidated_invariants():
+    rng = random.Random(0)
+    rows = random_rows(rng, 50)
+    b = Batch.from_tuples(rows, key_dtypes=[jnp.int64], val_dtypes=[jnp.int32])
+    w = np.asarray(b.weights)
+    n_live = int((w != 0).sum())
+    # live rows packed at the front
+    assert (w[:n_live] != 0).all() and (w[n_live:] == 0).all()
+    # sorted lexicographically by (key, val) on the live prefix
+    k = np.asarray(b.keys[0])[:n_live]
+    v = np.asarray(b.vals[0])[:n_live]
+    order = sorted(zip(k.tolist(), v.tolist()))
+    assert list(zip(k.tolist(), v.tolist())) == order
+    # no duplicate live rows
+    assert len(set(zip(k.tolist(), v.tolist()))) == n_live
+    # dead rows carry sentinel keys
+    assert (np.asarray(b.keys[0])[n_live:] == np.iinfo(np.int64).max).all()
+    assert int(b.live_count()) == n_live
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_add_neg(seed):
+    rng = random.Random(seed)
+    ra, rb = random_rows(rng, 40), random_rows(rng, 30)
+    a = Batch.from_tuples(ra, key_dtypes=[jnp.int64], val_dtypes=[jnp.int32])
+    b = Batch.from_tuples(rb, key_dtypes=[jnp.int64], val_dtypes=[jnp.int32])
+    assert a.add(b).to_dict() == dict_add(oracle(ra), oracle(rb))
+    # a + (-a) == 0
+    assert a.add(a.neg()).to_dict() == {}
+
+
+def test_concat_batches_then_consolidate():
+    rng = random.Random(3)
+    parts = [random_rows(rng, 20) for _ in range(4)]
+    batches = [
+        Batch.from_tuples(p, key_dtypes=[jnp.int64], val_dtypes=[jnp.int32])
+        for p in parts
+    ]
+    merged = concat_batches(batches).consolidate()
+    want = {}
+    for p in parts:
+        want = dict_add(want, oracle(p))
+    assert merged.to_dict() == want
+
+
+def test_with_cap_grow_shrink():
+    rows = [((i, 0), 1) for i in range(10)]
+    b = Batch.from_tuples(rows, key_dtypes=[jnp.int64], val_dtypes=[jnp.int32])
+    big = b.with_cap(64)
+    assert big.cap == 64 and big.to_dict() == b.to_dict()
+    small = big.with_cap(16)
+    assert small.cap == 16 and small.to_dict() == b.to_dict()
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("seed", range(5))
+def test_lex_searchsorted_matches_numpy_single_col(side, seed):
+    rng = np.random.RandomState(seed)
+    table = np.sort(rng.randint(0, 20, size=30).astype(np.int64))
+    query = rng.randint(-2, 23, size=17).astype(np.int64)
+    got = kernels.lex_searchsorted((jnp.asarray(table),), (jnp.asarray(query),),
+                                   side=side)
+    want = np.searchsorted(table, query, side=side)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_lex_searchsorted_two_cols(side):
+    rows = sorted(
+        [(1, 2), (1, 5), (2, 1), (2, 1), (2, 9), (5, 0), (5, 0), (7, 3)]
+    )
+    queries = [(0, 0), (1, 5), (2, 1), (2, 2), (5, 0), (9, 9), (2, 0)]
+    t0 = jnp.asarray([r[0] for r in rows], jnp.int64)
+    t1 = jnp.asarray([r[1] for r in rows], jnp.int64)
+    q0 = jnp.asarray([q[0] for q in queries], jnp.int64)
+    q1 = jnp.asarray([q[1] for q in queries], jnp.int64)
+    got = kernels.lex_searchsorted((t0, t1), (q0, q1), side=side)
+    import bisect
+
+    fn = bisect.bisect_left if side == "left" else bisect.bisect_right
+    want = [fn(rows, q) for q in queries]
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_expand_ranges():
+    lo = jnp.asarray([0, 3, 3, 7], jnp.int32)
+    hi = jnp.asarray([2, 3, 6, 9], jnp.int32)
+    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap=16)
+    assert int(total) == 7
+    got = [(int(row[j]), int(src[j])) for j in range(7)]
+    assert got == [(0, 0), (0, 1), (2, 3), (2, 4), (2, 5), (3, 7), (3, 8)]
+    assert bool(valid[6]) and not bool(valid[7])
+
+
+def test_expand_ranges_empty():
+    lo = jnp.asarray([4, 4], jnp.int32)
+    hi = jnp.asarray([4, 4], jnp.int32)
+    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap=8)
+    assert int(total) == 0
+    assert not bool(valid.any())
+
+
+def test_float_val_columns():
+    rows = [((1, 2.5), 1), ((1, 2.5), 2), ((2, -1.0), 1)]
+    b = Batch.from_tuples(rows, key_dtypes=[jnp.int64], val_dtypes=[jnp.float32])
+    assert b.to_dict() == {(1, 2.5): 3, (2, -1.0): 1}
+
+
+def test_nan_rows_consolidate_and_cancel():
+    nan = float("nan")
+    rows = [((1, nan), 1), ((1, nan), -1), ((2, nan), 2)]
+    b = Batch.from_tuples(rows, key_dtypes=[jnp.int64], val_dtypes=[jnp.float32])
+    d = b.to_dict()
+    assert len(d) == 1
+    ((k, v), w), = d.items()
+    assert k == 2 and w == 2 and np.isnan(v)
+
+
+def test_unit_keyed_batch():
+    # zero key and value columns: a bare counter Z-set (e.g. global COUNT(*))
+    b = Batch.from_columns([], [], jnp.asarray([3, -1, 4], jnp.int64), cap=8)
+    assert b.to_dict() == {(): 6}
+    assert b.add(b.neg()).to_dict() == {}
+
+
+def test_from_columns_length_mismatch_raises():
+    with pytest.raises(AssertionError):
+        Batch.from_columns([jnp.arange(5)], [], jnp.ones((3,), jnp.int64))
