@@ -1,0 +1,256 @@
+"""Circuit construction: streams, nodes, edges, feedback, caching, events.
+
+The host-side equivalent of the reference's circuit builder
+(``crates/dbsp/src/circuit/circuit_builder.rs``): a DAG of operators connected
+by streams, built once, then evaluated tick-by-tick by a scheduler. The graph
+lives on the host (graph construction is control flow, not compute); the data
+flowing on streams is device-resident :class:`~dbsp_tpu.zset.Batch` pytrees or
+host scalars, and each operator drives its own jitted kernels.
+
+Key surface parity (reference file:line):
+  Stream                circuit_builder.rs:92
+  Circuit node insert   circuit_builder.rs:1943-2224 (add_*_operator)
+  add_feedback          circuit_builder.rs:2225 (FeedbackConnector :3490)
+  RootCircuit.build     circuit_builder.rs:1403
+  circuit cache         circuit/cache.rs:59
+  event handlers        circuit_builder.rs:1474-1516
+  step                  circuit_builder.rs:3658
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from dbsp_tpu.circuit.operator import (
+    BinaryOperator, ImportOperator, NaryOperator, Operator, SinkOperator,
+    SourceOperator, StrictOperator, UnaryOperator)
+
+# ---------------------------------------------------------------------------
+# Construction / scheduler events (reference: circuit/trace.rs:44,496)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CircuitEvent:
+    kind: str           # "operator" | "subcircuit" | "edge"
+    node_id: Tuple[int, ...] | None = None
+    name: str | None = None
+    from_id: Tuple[int, ...] | None = None
+    to_id: Tuple[int, ...] | None = None
+
+
+@dataclasses.dataclass
+class SchedulerEvent:
+    kind: str           # "step_start" | "step_end" | "eval_start" | "eval_end"
+    #                     | "clock_start" | "clock_end"
+    node_id: Tuple[int, ...] | None = None
+    name: str | None = None
+    time_ns: int = 0
+
+
+class Stream:
+    """A typed edge in the circuit carrying one value per clock tick.
+
+    Operator sugar (``map``/``join``/``aggregate``/...) is attached by the
+    ``dbsp_tpu.operators`` package, mirroring how the reference implements
+    operators as extension methods on ``Stream``.
+    """
+
+    def __init__(self, circuit: "Circuit", node_index: int):
+        self.circuit = circuit
+        self.node_index = node_index
+
+    @property
+    def node(self) -> "Node":
+        return self.circuit.nodes[self.node_index]
+
+    def get(self) -> Any:
+        """Value produced this tick (valid during a step)."""
+        return self.circuit._values[self.node_index]
+
+    # local (per-worker) id, unique within the circuit
+    @property
+    def stream_id(self) -> int:
+        return self.node_index
+
+    def __repr__(self):
+        return f"Stream({self.circuit.path()}/{self.node_index}:{self.node.operator.name})"
+
+
+@dataclasses.dataclass
+class Node:
+    """One scheduled unit: an operator plus its input streams.
+
+    A :class:`StrictOperator` contributes TWO nodes — an output half (acts as
+    a source; scheduled first) and an input half (acts as a sink; scheduled
+    after its input is produced). This is how feedback cycles become a DAG.
+    """
+
+    index: int
+    operator: Operator
+    kind: str  # "source" | "unary" | "binary" | "nary" | "sink"
+    #            | "strict_output" | "strict_input" | "subcircuit" | "import"
+    inputs: List[int] = dataclasses.field(default_factory=list)
+    # for strict halves: the index of the partner node
+    partner: Optional[int] = None
+    # subcircuit payload
+    child: Optional["Circuit"] = None
+
+
+class FeedbackConnector:
+    """Handle returned by :meth:`Circuit.add_feedback`; closing the loop with
+    :meth:`connect` schedules the strict operator's input half."""
+
+    def __init__(self, circuit: "Circuit", output_node: int, op: StrictOperator):
+        self.circuit = circuit
+        self.output_node = output_node
+        self.op = op
+        self.stream = Stream(circuit, output_node)
+
+    def connect(self, input_stream: Stream) -> None:
+        assert input_stream.circuit is self.circuit, "feedback across circuits"
+        node = self.circuit._add_node(self.op, "strict_input",
+                                      [input_stream.node_index])
+        node.partner = self.output_node
+        self.circuit.nodes[self.output_node].partner = node.index
+
+
+class Circuit:
+    """A (possibly nested) dataflow circuit under one logical clock."""
+
+    def __init__(self, parent: Optional["Circuit"] = None,
+                 iterative: bool = False):
+        self.parent = parent
+        self.iterative = iterative
+        self.nodes: List[Node] = []
+        self._values: Dict[int, Any] = {}
+        self.cache: Dict[Any, Any] = {}
+        self._executor = None
+        self._circuit_handlers: List[Callable[[CircuitEvent], None]] = []
+        self._scheduler_handlers: List[Callable[[SchedulerEvent], None]] = []
+        self._index_in_parent: Optional[int] = None
+
+    # -- identity -----------------------------------------------------------
+    def root(self) -> "Circuit":
+        return self if self.parent is None else self.parent.root()
+
+    def scope_depth(self) -> int:
+        return 0 if self.parent is None else 1 + self.parent.scope_depth()
+
+    def path(self) -> Tuple[int, ...]:
+        if self.parent is None:
+            return ()
+        return (*self.parent.path(), self._index_in_parent)
+
+    def global_id(self, node_index: int) -> Tuple[int, ...]:
+        return (*self.path(), node_index)
+
+    # -- events -------------------------------------------------------------
+    def register_circuit_event_handler(self, h) -> None:
+        self.root()._circuit_handlers.append(h)
+
+    def register_scheduler_event_handler(self, h) -> None:
+        self.root()._scheduler_handlers.append(h)
+
+    def _emit_circuit_event(self, ev: CircuitEvent) -> None:
+        for h in self.root()._circuit_handlers:
+            h(ev)
+
+    def _emit_scheduler_event(self, ev: SchedulerEvent) -> None:
+        for h in self.root()._scheduler_handlers:
+            h(ev)
+
+    # -- node insertion (reference: circuit_builder.rs:1943-2224) -----------
+    def _add_node(self, op: Operator, kind: str, inputs: List[int],
+                  child: Optional["Circuit"] = None) -> Node:
+        node = Node(index=len(self.nodes), operator=op, kind=kind,
+                    inputs=list(inputs), child=child)
+        self.nodes.append(node)
+        self._executor = None  # invalidate schedule
+        self._emit_circuit_event(CircuitEvent(
+            kind="operator", node_id=self.global_id(node.index), name=op.name))
+        for i in inputs:
+            self._emit_circuit_event(CircuitEvent(
+                kind="edge", from_id=self.global_id(i),
+                to_id=self.global_id(node.index)))
+        return node
+
+    def add_source(self, op: SourceOperator) -> Stream:
+        return Stream(self, self._add_node(op, "source", []).index)
+
+    def add_unary_operator(self, op: UnaryOperator, s: Stream) -> Stream:
+        self._check_stream(s)
+        return Stream(self, self._add_node(op, "unary", [s.node_index]).index)
+
+    def add_binary_operator(self, op: BinaryOperator, a: Stream, b: Stream
+                            ) -> Stream:
+        self._check_stream(a), self._check_stream(b)
+        return Stream(self, self._add_node(
+            op, "binary", [a.node_index, b.node_index]).index)
+
+    def add_nary_operator(self, op: NaryOperator, streams: Sequence[Stream]
+                          ) -> Stream:
+        for s in streams:
+            self._check_stream(s)
+        return Stream(self, self._add_node(
+            op, "nary", [s.node_index for s in streams]).index)
+
+    def add_sink(self, op: SinkOperator, s: Stream) -> None:
+        self._check_stream(s)
+        self._add_node(op, "sink", [s.node_index])
+
+    def add_feedback(self, op: StrictOperator) -> FeedbackConnector:
+        node = self._add_node(op, "strict_output", [])
+        return FeedbackConnector(self, node.index, op)
+
+    def _check_stream(self, s: Stream) -> None:
+        assert s.circuit is self, (
+            f"stream {s} belongs to a different circuit; use delta0/import "
+            "to move values across clock domains")
+
+    # -- stepping -----------------------------------------------------------
+    def step(self) -> None:
+        """Evaluate every node exactly once (one tick of this clock).
+
+        Reference: ``CircuitHandle::step`` (circuit_builder.rs:3658) via the
+        static scheduler (schedule/static_scheduler.rs:52).
+        """
+        from dbsp_tpu.circuit.scheduler import OnceExecutor
+
+        if self._executor is None:
+            self._executor = OnceExecutor(self)
+        self._executor.run(self)
+
+    def clock_start(self, scope: int = 0) -> None:
+        self._emit_scheduler_event(SchedulerEvent(kind="clock_start"))
+        for n in self.nodes:
+            if n.kind != "strict_input":  # one call per operator instance
+                n.operator.clock_start(scope)
+            if n.child is not None:
+                n.child.clock_start(scope + 1)
+
+    def clock_end(self, scope: int = 0) -> None:
+        for n in self.nodes:
+            if n.kind != "strict_input":
+                n.operator.clock_end(scope)
+            if n.child is not None:
+                n.child.clock_end(scope + 1)
+        self._emit_scheduler_event(SchedulerEvent(kind="clock_end"))
+
+
+class RootCircuit(Circuit):
+    """Top-level circuit under the root clock (one tick == one input delta).
+
+    ``RootCircuit.build(f)`` constructs the dataflow from ``f`` and returns
+    the circuit plus ``f``'s result (typically input/output handles) —
+    reference: ``circuit_builder.rs:1403``.
+    """
+
+    @staticmethod
+    def build(constructor: Callable[["RootCircuit"], Any]
+              ) -> Tuple["RootCircuit", Any]:
+        circuit = RootCircuit()
+        result = constructor(circuit)
+        circuit.clock_start(0)
+        return circuit, result

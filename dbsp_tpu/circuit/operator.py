@@ -1,0 +1,99 @@
+"""Operator traits — the plugin boundary every circuit node implements.
+
+Equivalent surface to the reference's operator traits
+(``crates/dbsp/src/circuit/operator_traits.rs:18-363``): lifecycle hooks
+(``clock_start``/``clock_end``), fixedpoint reporting for nested circuits, and
+arity-specific ``eval`` signatures. Differences by design:
+
+* No ``is_async``/``ready`` machinery. The reference needs async operators so
+  its thread scheduler can overlap exchange communication with compute; here
+  cross-worker communication is an XLA collective *inside* a jitted kernel —
+  overlap is the compiler's job, so every operator is synchronous on the host.
+* ``eval`` takes and returns host Python values (usually :class:`Batch` pytrees
+  holding device buffers); device work happens in jitted kernels the operator
+  owns. Operators are free to keep device-side state (e.g. spines).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+
+class Operator:
+    """Base: naming, clock lifecycle, fixedpoint contract."""
+
+    name: str = "operator"
+
+    def clock_start(self, scope: int) -> None:
+        """A (possibly nested) clock this operator belongs to started."""
+
+    def clock_end(self, scope: int) -> None:
+        """The clock ended (an epoch of the nested circuit completed)."""
+
+    def fixedpoint(self, scope: int) -> bool:
+        """True if, fed the same inputs forever, outputs will not change.
+
+        Used by iterative executors to detect quiescence of nested circuits
+        (reference contract: ``operator_traits.rs:148-196``). Stateless
+        operators are trivially at a fixedpoint.
+        """
+        return True
+
+    def metadata(self) -> dict:
+        """Profiling metadata (sizes, counts) — reference: ``circuit/metadata.rs``."""
+        return {}
+
+
+class SourceOperator(Operator):
+    """Produces one value per tick (reference: ``operator_traits.rs:202``)."""
+
+    def eval(self) -> Any:
+        raise NotImplementedError
+
+
+class SinkOperator(Operator):
+    def eval(self, value: Any) -> None:
+        raise NotImplementedError
+
+
+class UnaryOperator(Operator):
+    def eval(self, value: Any) -> Any:
+        raise NotImplementedError
+
+
+class BinaryOperator(Operator):
+    def eval(self, a: Any, b: Any) -> Any:
+        raise NotImplementedError
+
+
+class NaryOperator(Operator):
+    def eval(self, *values: Any) -> Any:
+        raise NotImplementedError
+
+
+class StrictOperator(Operator):
+    """Feedback operator (z^-1): output at t must not depend on input at t.
+
+    The scheduler reads :meth:`get_output` *before* the rest of the circuit
+    runs, and feeds the tick's input to :meth:`eval_strict` afterwards
+    (reference: ``operator_traits.rs:363`` + ``operator/z1.rs``).
+    """
+
+    def get_output(self) -> Any:
+        raise NotImplementedError
+
+    def eval_strict(self, value: Any) -> None:
+        raise NotImplementedError
+
+
+class ImportOperator(Operator):
+    """Imports a value across a clock-domain boundary into a child circuit
+    (reference: ``operator_traits.rs:411``, ``operator/delta0.rs``): receives
+    the parent value once per parent tick, emits into the child clock.
+    """
+
+    def import_value(self, value: Any) -> None:
+        raise NotImplementedError
+
+    def eval(self) -> Any:
+        raise NotImplementedError

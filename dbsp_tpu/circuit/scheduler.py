@@ -1,0 +1,108 @@
+"""Schedulers: decide node evaluation order per tick.
+
+Reference: ``crates/dbsp/src/circuit/schedule/`` — a static toposort scheduler
+plus a dynamic work-stealing one. Only the static scheduler exists here, by
+design: the reference's dynamic scheduler earns its keep by overlapping async
+exchange I/O across threads, but in this engine cross-worker communication is
+an XLA collective inside a jitted kernel, so the host-side order is a pure
+toposort and XLA owns all overlap. (See SURVEY.md §7 "Operators stay a
+host-side circuit graph".)
+
+The executor hierarchy mirrors ``schedule/mod.rs:91-143``:
+  OnceExecutor      — run the schedule once per tick (root circuits)
+  IterativeExecutor — run the child clock to a fixedpoint (nested circuits)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, List
+
+from dbsp_tpu.circuit.builder import Circuit, Node, SchedulerEvent
+
+if TYPE_CHECKING:
+    pass
+
+
+class CircuitGraphError(RuntimeError):
+    pass
+
+
+def static_schedule(circuit: Circuit) -> List[Node]:
+    """Topological order; strict-output halves act as sources, so feedback
+    cycles are already broken (reference: schedule/static_scheduler.rs:17-88).
+    """
+    nodes = circuit.nodes
+    indeg = [0] * len(nodes)
+    consumers: List[List[int]] = [[] for _ in nodes]
+    for n in nodes:
+        for i in n.inputs:
+            indeg[n.index] += 1
+            consumers[i].append(n.index)
+    ready = [n.index for n in nodes if indeg[n.index] == 0]
+    order: List[Node] = []
+    while ready:
+        # FIFO keeps sources first and sinks last within ties (stable order
+        # aids debugging and profiling diffs).
+        idx = ready.pop(0)
+        order.append(nodes[idx])
+        for c in consumers[idx]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                ready.append(c)
+    if len(order) != len(nodes):
+        stuck = [n.index for n in nodes if n not in order]
+        raise CircuitGraphError(
+            f"circuit has a non-strict cycle through nodes {stuck}; every "
+            "feedback loop must pass through a strict (z^-1) operator")
+    return order
+
+
+def _eval_node(circuit: Circuit, node: Node) -> None:
+    op = node.operator
+    gid = circuit.global_id(node.index)
+    circuit._emit_scheduler_event(SchedulerEvent(
+        kind="eval_start", node_id=gid, name=op.name,
+        time_ns=time.perf_counter_ns()))
+    vals = [circuit._values[i] for i in node.inputs]
+    if node.kind == "source":
+        circuit._values[node.index] = op.eval()
+    elif node.kind == "import":
+        circuit._values[node.index] = op.eval()
+    elif node.kind == "unary":
+        circuit._values[node.index] = op.eval(vals[0])
+    elif node.kind == "binary":
+        circuit._values[node.index] = op.eval(vals[0], vals[1])
+    elif node.kind == "nary":
+        circuit._values[node.index] = op.eval(*vals)
+    elif node.kind == "sink":
+        op.eval(vals[0])
+    elif node.kind == "strict_output":
+        circuit._values[node.index] = op.get_output()
+    elif node.kind == "strict_input":
+        op.eval_strict(vals[0])
+    elif node.kind == "subcircuit":
+        raise NotImplementedError(
+            "nested circuits are evaluated by the IterativeExecutor "
+            "(fixedpoint/recursive support); see operators/recursive.py")
+    else:  # pragma: no cover
+        raise AssertionError(f"unknown node kind {node.kind}")
+    circuit._emit_scheduler_event(SchedulerEvent(
+        kind="eval_end", node_id=gid, name=op.name,
+        time_ns=time.perf_counter_ns()))
+
+
+class OnceExecutor:
+    """Evaluate each node exactly once per tick (reference: schedule/mod.rs:143)."""
+
+    def __init__(self, circuit: Circuit):
+        self.order = static_schedule(circuit)
+
+    def run(self, circuit: Circuit) -> None:
+        circuit._emit_scheduler_event(SchedulerEvent(
+            kind="step_start", time_ns=time.perf_counter_ns()))
+        for node in self.order:
+            _eval_node(circuit, node)
+        circuit._values.clear()
+        circuit._emit_scheduler_event(SchedulerEvent(
+            kind="step_end", time_ns=time.perf_counter_ns()))

@@ -1,0 +1,15 @@
+from dbsp_tpu.nexmark.generator import GeneratorConfig, NexmarkGenerator
+from dbsp_tpu.nexmark import model, queries
+
+__all__ = ["GeneratorConfig", "NexmarkGenerator", "model", "queries"]
+
+
+def build_inputs(circuit):
+    """Create the three Nexmark relation inputs; returns (streams, handles)."""
+    from dbsp_tpu.operators import add_input_zset
+    from dbsp_tpu.nexmark import model as M
+
+    persons, hp = add_input_zset(circuit, M.PERSON_KEY, M.PERSON_VALS)
+    auctions, ha = add_input_zset(circuit, M.AUCTION_KEY, M.AUCTION_VALS)
+    bids, hb = add_input_zset(circuit, M.BID_KEY, M.BID_VALS)
+    return (persons, auctions, bids), (hp, ha, hb)

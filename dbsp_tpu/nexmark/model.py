@@ -1,0 +1,43 @@
+"""Nexmark data model, columnar (device schemas + string dictionaries).
+
+Reference: the generator's row models (``crates/nexmark/src/model.rs:14-69``:
+Person/Auction/Bid). TPU-native change: variable-length strings (names,
+cities, channels, urls) are dictionary-encoded on the host into int32 codes
+(SURVEY.md §7 "variable-length keys"); the decode tables live host-side in
+:mod:`dbsp_tpu.nexmark.generator`.
+
+Device schemas (key columns index the Z-set; joins/aggregates group by them):
+  persons:  key (id:i64)        vals (name:i32, city:i32, state:i32, email:i32, date_time:i64)
+  auctions: key (id:i64)        vals (item:i32, seller:i64, category:i64, initial_bid:i64,
+                                      reserve:i64, date_time:i64, expires:i64)
+  bids:     key (auction:i64)   vals (bidder:i64, price:i64, channel:i32, date_time:i64)
+"""
+
+import jax.numpy as jnp
+
+PERSON_KEY = (jnp.int64,)
+PERSON_VALS = (jnp.int32, jnp.int32, jnp.int32, jnp.int32, jnp.int64)
+# person val column order: name, city, state, email, date_time
+P_NAME, P_CITY, P_STATE, P_EMAIL, P_DATE = range(5)
+
+AUCTION_KEY = (jnp.int64,)
+AUCTION_VALS = (jnp.int32, jnp.int64, jnp.int64, jnp.int64, jnp.int64,
+                jnp.int64, jnp.int64)
+# auction val column order: item, seller, category, initial_bid, reserve,
+# date_time, expires
+A_ITEM, A_SELLER, A_CATEGORY, A_INITIAL, A_RESERVE, A_DATE, A_EXPIRES = range(7)
+
+BID_KEY = (jnp.int64,)
+BID_VALS = (jnp.int64, jnp.int64, jnp.int32, jnp.int64)
+# bid val column order: bidder, price, channel, date_time
+B_BIDDER, B_PRICE, B_CHANNEL, B_DATE = range(4)
+
+# Generator constants (same universe as the Nexmark spec: first ids, the
+# 1 person : 3 auctions : 46 bids mix per 50 events, category base 10).
+FIRST_PERSON_ID = 1000
+FIRST_AUCTION_ID = 1000
+FIRST_CATEGORY_ID = 10
+NUM_CATEGORIES = 5
+PERSON_PROPORTION = 1
+AUCTION_PROPORTION = 3
+PROPORTION_DENOMINATOR = 50  # 1 + 3 + 46

@@ -1,0 +1,106 @@
+"""Nexmark queries as circuit builders.
+
+Reference: ``crates/nexmark/src/queries/*.rs`` (hand-built on the Stream
+API, q0-q9 + q12-q22). Each builder takes the three relation streams
+(persons, auctions, bids — see model.py schemas) and returns the query's
+output stream. Queries are added here stage by stage as the operator
+library grows; q3+ use incremental join/aggregate (operators/join.py,
+operators/aggregate.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.nexmark import model as M
+
+
+def q0(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Passthrough — measures raw engine overhead (queries/q0.rs)."""
+    return bids.map_rows(lambda k, v: (k, v), M.BID_KEY, M.BID_VALS,
+                         name="q0", preserves_order=True)
+
+
+def q1(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Currency conversion: price dollars -> euros * 0.908 (queries/q1.rs).
+
+    Integer semantics: price * 908 / 1000 (the reference uses f32; integer
+    milli-euros keep the Z-set exactly comparable across backends).
+    """
+    def conv(k, v):
+        bidder, price, channel, ts = v
+        return k, (bidder, price * 908 // 1000, channel, ts)
+
+    return bids.map_rows(conv, M.BID_KEY, M.BID_VALS, name="q1",
+                         preserves_order=True)
+
+
+def q2(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Bids on a sampled set of auctions: auction % 123 == 0, project
+    (auction, price) (queries/q2.rs)."""
+    filt = bids.filter_rows(lambda k, v: k[0] % 123 == 0, name="q2-filter")
+    return filt.map_rows(lambda k, v: (k, (v[M.B_PRICE],)),
+                         M.BID_KEY, (jnp.int64,), name="q2-project")
+
+
+# State codes standing in for the reference's 'OR','ID','CA' literals
+# (states are dictionary-encoded, generator.py).
+Q3_STATES = (0, 1, 2)
+Q3_CATEGORY = 10
+
+
+def q3(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Who is selling in OR/ID/CA in category 10? (queries/q3.rs:35)
+
+    filter(persons by state) ⋈ filter(auctions by category) on seller ->
+    (name, city, state, auction id), keyed by auction id. Incremental
+    equi-join (operators/join.py).
+    """
+    sellers = persons.filter_rows(
+        lambda k, v: (v[M.P_STATE] == Q3_STATES[0])
+        | (v[M.P_STATE] == Q3_STATES[1]) | (v[M.P_STATE] == Q3_STATES[2]),
+        name="q3-sellers")
+    cat = auctions.filter_rows(
+        lambda k, v: v[M.A_CATEGORY] == Q3_CATEGORY, name="q3-category")
+    # re-key auctions by seller (person id)
+    by_seller = cat.index_by(
+        lambda k, v: (v[M.A_SELLER],), M.PERSON_KEY,
+        val_fn=lambda k, v: (k[0],), val_dtypes=(jnp.int64,),
+        name="q3-by-seller")
+    return sellers.join_index(
+        by_seller,
+        lambda k, pv, av: ((av[0],), (pv[0], pv[1], pv[2])),
+        [jnp.int64], [jnp.int32, jnp.int32, jnp.int32], name="q3-join")
+
+
+def q4(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Average final (max) bid price per category over closed auctions
+    (queries/q4.rs:43): bids within [auction.date_time, auction.expires]
+    joined on auction id -> max price per (auction, category) -> average per
+    category. Exercises join + two incremental aggregates."""
+    by_auction = auctions.index_by(
+        lambda k, v: (k[0],), M.AUCTION_KEY,
+        val_fn=lambda k, v: (v[M.A_CATEGORY], v[M.A_DATE], v[M.A_EXPIRES]),
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q4-auctions")
+    joined = bids.join_index(
+        by_auction,
+        lambda k, bv, av: (
+            (k[0], av[0]),
+            (bv[M.B_PRICE], bv[M.B_DATE], av[1], av[2])),
+        [jnp.int64, jnp.int64], [jnp.int64, jnp.int64, jnp.int64, jnp.int64],
+        name="q4-join")
+    in_window = joined.filter_rows(
+        lambda k, v: (v[1] >= v[2]) & (v[1] <= v[3]), name="q4-window")
+    # max price per (auction, category)
+    from dbsp_tpu.operators.aggregate import Average, Max
+
+    per_auction = in_window.map_rows(
+        lambda k, v: (k, (v[0],)), (jnp.int64, jnp.int64), (jnp.int64,),
+        name="q4-price").aggregate(Max(0), name="q4-max")
+    # average of those maxima per category
+    by_category = per_auction.index_by(
+        lambda k, v: (k[1],), (jnp.int64,),
+        val_fn=lambda k, v: (v[0],), val_dtypes=(jnp.int64,),
+        name="q4-by-category")
+    return by_category.aggregate(Average(0), name="q4-avg")

@@ -1,0 +1,13 @@
+"""Operator library. Importing this package attaches all Stream sugar
+(map/filter/join/aggregate/...) — the analog of bringing the reference's
+operator extension traits into scope."""
+
+from dbsp_tpu.operators import (  # noqa: F401  (Stream-method registration)
+    aggregate, basic, distinct, filter_map, io_handles, join, trace_op, z1)
+from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
+from dbsp_tpu.operators.basic import Generator
+from dbsp_tpu.operators.io_handles import InputHandle, OutputHandle, add_input_zset
+from dbsp_tpu.operators.z1 import Z1
+
+__all__ = ["Generator", "InputHandle", "OutputHandle", "add_input_zset", "Z1",
+           "Count", "Sum", "Min", "Max", "Average"]

@@ -1,0 +1,339 @@
+"""Incremental group-by aggregation.
+
+Reference: ``operator/aggregate/mod.rs`` — the ``Aggregator`` trait (:75),
+``stream_aggregate`` (:172), incremental ``aggregate`` (:204) whose
+``AggregateIncremental::eval`` (:600) recomputes aggregates ONLY for keys
+touched by the delta, reading the full group from the input trace, and emits
+retract/insert pairs against the previous output.
+
+TPU shape of the same algorithm, per tick:
+  1. unique touched keys Q  = distinct live keys of the delta (one compact);
+  2. group gather           = probe every input-spine level for Q's ranges,
+                              expand (grow-on-demand caps), gather rows;
+  3. net weights            = consolidate gathered rows on (q, vals) so a
+                              (key,val) split across levels nets out;
+  4. reduce                 = aggregator's segment reduction per q;
+  5. diff                   = probe the operator's own output spine for Q's
+                              previous values; emit -1 old / +1 new where
+                              changed (skip unchanged; empty group retracts).
+All steps are static-shape kernels; per-step cost scales with the delta and
+the touched groups, not the accumulated state.
+
+Weights semantics: a (key, val) with net weight w > 0 is present (w copies);
+non-positive net weights mean absent. Inputs whose groups net to negative
+multiplicities are ill-formed for aggregation (same contract as the
+reference's aggregates over indexed Z-sets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.operators.trace_op import TraceView
+from dbsp_tpu.trace.spine import Spine
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
+
+# ---------------------------------------------------------------------------
+# Aggregators (reference: Fold/Min/Max/Avg, operator/aggregate/{fold,...}.rs)
+# ---------------------------------------------------------------------------
+
+
+class Aggregator:
+    """Segment-reduction spec: vals+weights grouped by segment id -> outputs.
+
+    ``reduce`` sees every gathered row (including absent ones, net w <= 0) and
+    must ignore non-present rows itself; identity segments are reported
+    through the separate nonempty mask, so identity values never escape.
+    """
+
+    out_dtypes: Tuple = ()
+    name = "agg"
+
+    def reduce(self, val_cols: Tuple[jnp.ndarray, ...], weights: jnp.ndarray,
+               seg: jnp.ndarray, num_segments: int
+               ) -> Tuple[jnp.ndarray, ...]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Count(Aggregator):
+    out_dtypes = (jnp.int64,)
+    name = "count"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        w = jnp.maximum(weights, 0)
+        return (jax.ops.segment_sum(w, seg, num_segments=num_segments),)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sum(Aggregator):
+    col: int = 0
+    out_dtypes = (jnp.int64,)
+    name = "sum"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        w = jnp.maximum(weights, 0)
+        return (jax.ops.segment_sum(val_cols[self.col] * w, seg,
+                                    num_segments=num_segments),)
+
+
+@dataclasses.dataclass(frozen=True)
+class Max(Aggregator):
+    col: int = 0
+    out_dtypes = (jnp.int64,)
+    name = "max"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        v = val_cols[self.col]
+        lo = jnp.iinfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.integer) \
+            else -jnp.inf
+        masked = jnp.where(weights > 0, v, lo)
+        return (jax.ops.segment_max(masked, seg, num_segments=num_segments),)
+
+
+@dataclasses.dataclass(frozen=True)
+class Min(Aggregator):
+    col: int = 0
+    out_dtypes = (jnp.int64,)
+    name = "min"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        v = val_cols[self.col]
+        hi = jnp.iinfo(v.dtype).max if jnp.issubdtype(v.dtype, jnp.integer) \
+            else jnp.inf
+        masked = jnp.where(weights > 0, v, hi)
+        return (jax.ops.segment_min(masked, seg, num_segments=num_segments),)
+
+
+@dataclasses.dataclass(frozen=True)
+class Average(Aggregator):
+    """Integer average sum//count (deterministic across worker counts, unlike
+    float accumulation order)."""
+
+    col: int = 0
+    out_dtypes = (jnp.int64,)
+    name = "avg"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        w = jnp.maximum(weights, 0)
+        s = jax.ops.segment_sum(val_cols[self.col] * w, seg,
+                                num_segments=num_segments)
+        c = jnp.maximum(jax.ops.segment_sum(w, seg,
+                                            num_segments=num_segments), 1)
+        # truncating division (SQL/Rust semantics), not Python floor:
+        # -7 / 2 == -3, matching the reference engine on negative sums
+        return (jnp.where(s >= 0, s // c, -((-s) // c)),)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("nk",))
+def _unique_keys(delta: Batch, nk: int) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
+    """Distinct live keys of a consolidated batch, compacted to the front.
+
+    Returns (key_cols, live_mask) at the delta's capacity.
+    """
+    keys = delta.keys[:nk]
+    first = ~kernels.rows_equal_prev(keys, n=delta.cap)
+    live = (delta.weights != 0) & first
+    cols, w = kernels.compact(keys, jnp.where(live, 1, 0).astype(jnp.int32), live)
+    return cols, w != 0
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _gather_level(qkeys: Tuple[jnp.ndarray, ...], qlive: jnp.ndarray,
+                  level: Batch, out_cap: int):
+    """Expand one spine level's matching rows for the query keys.
+
+    Returns (qrow ids, gathered val cols, weights, total)."""
+    nk = len(qkeys)
+    lo = kernels.lex_probe(level.keys[:nk], qkeys, side="left")
+    hi = kernels.lex_probe(level.keys[:nk], qkeys, side="right")
+    lo = jnp.where(qlive, lo, 0)
+    hi = jnp.where(qlive, hi, lo)
+    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap)
+    w = jnp.where(valid, level.weights[src], 0)
+    vals = tuple(jnp.where(valid, c[src], kernels.sentinel_for(c.dtype))
+                 for c in level.vals)
+    qrow = jnp.where(valid, row, jnp.int32(-1))
+    return qrow, vals, w, total
+
+
+class GroupGather:
+    """Host driver: gather the full groups of the query keys across all spine
+    levels, with per-level grow-on-demand output capacities."""
+
+    def __init__(self):
+        self.caps: Dict[int, int] = {}
+
+    def __call__(self, qkeys, qlive, levels: Sequence[Batch], q_cap: int):
+        rows, vals, ws = [], [], []
+        for level in levels:
+            cap = self.caps.get(level.cap, max(64, q_cap))
+            qrow, v, w, total = _gather_level(qkeys, qlive, level, cap)
+            t = int(total)
+            if t > cap:
+                cap = bucket_cap(t)
+                self.caps[level.cap] = cap
+                qrow, v, w, total = _gather_level(qkeys, qlive, level, cap)
+            rows.append(qrow)
+            vals.append(v)
+            ws.append(w)
+        if not rows:
+            return None
+        qrow = jnp.concatenate(rows)
+        val_cols = tuple(jnp.concatenate([v[i] for v in vals])
+                         for i in range(len(vals[0])))
+        w = jnp.concatenate(ws)
+        return qrow, val_cols, w
+
+
+@partial(jax.jit, static_argnames=("agg", "q_cap"))
+def _reduce_groups(qrow, val_cols, w, agg: Aggregator, q_cap: int):
+    """Net out cross-level duplicates, then run the aggregator per q segment."""
+    # consolidate on (qrow, vals): sums weights of identical rows
+    cols, w = kernels.consolidate_cols((qrow, *val_cols), w)
+    qrow, val_cols = cols[0], cols[1:]
+    seg = jnp.where(qrow >= 0, qrow, q_cap).astype(jnp.int32)
+    outs = agg.reduce(val_cols, w, seg, q_cap + 1)
+    present = jax.ops.segment_max(
+        jnp.where(w > 0, 1, 0), seg, num_segments=q_cap + 1)
+    return tuple(o[:q_cap] for o in outs), present[:q_cap] > 0
+
+
+@jax.jit
+def _diff_outputs(qkeys, qlive, new_vals, new_present, old_vals, old_present):
+    """Build the retract/insert output delta (2*q_cap capacity)."""
+    changed = jnp.zeros(qlive.shape, jnp.bool_)
+    for nv, ov in zip(new_vals, old_vals):
+        changed = changed | ~kernels._col_eq(nv.astype(ov.dtype), ov)
+    changed = changed | (new_present != old_present)
+    insert_w = jnp.where(qlive & new_present & changed, 1, 0)
+    retract_w = jnp.where(qlive & old_present & changed, -1, 0)
+    keys = tuple(jnp.concatenate([c, c]) for c in qkeys)
+    vals = tuple(jnp.concatenate([nv.astype(ov.dtype), ov])
+                 for nv, ov in zip(new_vals, old_vals))
+    w = jnp.concatenate([insert_w, retract_w]).astype(jnp.int64)
+    cols, w = kernels.consolidate_cols((*keys, *vals), w)
+    return cols, w
+
+
+class AggregateOp(UnaryOperator):
+    """Incremental aggregate over a traced indexed Z-set (aggregate/mod.rs:410)."""
+
+    def __init__(self, agg: Aggregator, key_dtypes, name=None):
+        self.agg = agg
+        self.name = name or f"aggregate<{agg.name}>"
+        self.key_dtypes = tuple(key_dtypes)
+        self.out_schema = (self.key_dtypes, tuple(agg.out_dtypes))
+        self.out_spine = Spine(self.key_dtypes, tuple(agg.out_dtypes))
+        self._group_gather = GroupGather()
+        self._old_gather = GroupGather()
+
+    def eval(self, view: TraceView) -> Batch:
+        delta = view.delta
+        nk = len(self.key_dtypes)
+        if int(delta.live_count()) == 0:
+            return Batch.empty(*self.out_schema)
+        qkeys, qlive = _unique_keys(delta, nk)
+        q_cap = delta.cap
+
+        gathered = self._group_gather(qkeys, qlive, view.spine.batches, q_cap)
+        if gathered is None:
+            new_vals = tuple(
+                jnp.zeros((q_cap,), d) for d in self.agg.out_dtypes)
+            new_present = jnp.zeros((q_cap,), jnp.bool_)
+        else:
+            new_vals, new_present = _reduce_groups(*gathered, self.agg, q_cap)
+
+        old = self._old_gather(qkeys, qlive, self.out_spine.batches, q_cap)
+        if old is None:
+            old_vals = tuple(
+                kernels.sentinel_fill((q_cap,), d) for d in self.agg.out_dtypes)
+            old_present = jnp.zeros((q_cap,), jnp.bool_)
+        else:
+            # previous outputs are single rows per key; Max over net-positive
+            # rows reconstructs the value, presence from net weight
+            old_vals_all, old_present = _reduce_groups(
+                old[0], old[1], old[2],
+                _TupleMax(len(self.agg.out_dtypes)), q_cap)
+            old_vals = old_vals_all
+
+        cols, w = _diff_outputs(qkeys, qlive, new_vals, new_present,
+                                old_vals, old_present)
+        out = Batch(cols[:nk], cols[nk:], w)
+        self.out_spine.insert(out)
+        return out
+
+    def fixedpoint(self, scope: int) -> bool:
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class _TupleMax(Aggregator):
+    """Internal: recover the (unique) previous output row per key."""
+
+    ncols: int = 1
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        outs = []
+        for v in val_cols:
+            lo = (jnp.iinfo(v.dtype).min
+                  if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf)
+            outs.append(jax.ops.segment_max(
+                jnp.where(weights > 0, v, lo), seg,
+                num_segments=num_segments))
+        return tuple(outs)
+
+
+@stream_method
+def aggregate(self: Stream, agg: Aggregator, name=None) -> Stream:
+    """Incremental aggregate by the stream's key columns; output is an
+    indexed Z-set (key -> aggregate value) maintained under retractions."""
+    schema = getattr(self, "schema", None)
+    assert schema is not None, "aggregate needs stream schema metadata"
+    t = self.trace()
+    out = self.circuit.add_unary_operator(
+        AggregateOp(agg, schema[0], name), t)
+    out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+    return out
+
+
+@stream_method
+def stream_aggregate(self: Stream, agg: Aggregator, name=None) -> Stream:
+    """Non-incremental variant: aggregates each tick's batch alone
+    (aggregate/mod.rs:172) — the differential-testing oracle for
+    :func:`aggregate` via ``integrate().stream_aggregate()``."""
+    schema = getattr(self, "schema", None)
+    assert schema is not None
+    nk = len(schema[0])
+    op_name = name or f"stream_aggregate<{agg.name}>"
+
+    def eval_fn(batch: Batch) -> Batch:
+        qkeys, qlive = _unique_keys(batch, nk)
+        q_cap = batch.cap
+        gg = GroupGather()
+        gathered = gg(qkeys, qlive, [batch], q_cap)
+        new_vals, new_present = _reduce_groups(*gathered, agg, q_cap)
+        w = jnp.where(qlive & new_present, 1, 0).astype(jnp.int64)
+        cols, w = kernels.consolidate_cols(
+            (*qkeys, *(v for v in new_vals)), w)
+        return Batch(cols[:nk], cols[nk:], w)
+
+    from dbsp_tpu.operators.basic import Apply
+
+    out = self.circuit.add_unary_operator(Apply(eval_fn, op_name), self)
+    out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+    return out

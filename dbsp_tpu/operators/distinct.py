@@ -1,0 +1,99 @@
+"""Incremental distinct: set semantics over Z-set multiplicities.
+
+Reference: ``operator/distinct.rs`` — ``stream_distinct`` (:40) and the
+root-scope-optimized incremental ``distinct`` (:64, eval :196): for each row
+in the delta, compare the row's accumulated weight before vs after the tick;
+emit +1 when it becomes positive, -1 when it stops being positive.
+
+TPU shape: one probe of the input's pre-tick trace for the delta's rows
+(full-row lex probe across spine levels), a segment-sum to net the old weight,
+then a pure elementwise old/new comparison. Cost: O(|delta| log |trace|).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.operators.trace_op import TraceView
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch
+
+
+@jax.jit
+def _old_weights_level(delta: Batch, level: Batch) -> jnp.ndarray:
+    """Accumulated weight of each delta ROW (keys+vals) in one spine level.
+
+    Rows are unique within a consolidated level, so the [lo, hi) range per
+    row is 0 or 1 wide; gather the weight when present.
+    """
+    cols = delta.cols
+    lo = kernels.lex_probe(level.cols, cols, side="left")
+    hi = kernels.lex_probe(level.cols, cols, side="right")
+    found = (hi > lo) & (delta.weights != 0)
+    w = level.weights[jnp.minimum(lo, level.cap - 1)]
+    return jnp.where(found, w, 0)
+
+
+@jax.jit
+def _distinct_delta(delta: Batch, old_w: jnp.ndarray) -> Batch:
+    new_w = old_w + delta.weights
+    became = (old_w <= 0) & (new_w > 0)
+    ceased = (old_w > 0) & (new_w <= 0)
+    live = delta.weights != 0
+    out_w = jnp.where(live & became, 1,
+                      jnp.where(live & ceased, -1, 0)).astype(delta.weights.dtype)
+    cols, w = kernels.compact(delta.cols, out_w, out_w != 0)
+    return Batch(cols[: len(delta.keys)], cols[len(delta.keys):], w)
+
+
+class DistinctOp(UnaryOperator):
+    name = "distinct"
+
+    def eval(self, view: TraceView) -> Batch:
+        delta = view.delta
+        old_w = None
+        for level in view.pre_levels:
+            w = _old_weights_level(delta, level)
+            old_w = w if old_w is None else old_w + w
+        if old_w is None:
+            old_w = jnp.zeros((delta.cap,), delta.weights.dtype)
+        return _distinct_delta(delta, old_w)
+
+
+class StreamDistinct(UnaryOperator):
+    """Per-tick set projection (distinct.rs:40): weight>0 -> 1, else drop."""
+
+    name = "stream_distinct"
+
+    @staticmethod
+    @jax.jit
+    def _kernel(batch: Batch) -> Batch:
+        w = jnp.where(batch.weights > 0, 1, 0).astype(batch.weights.dtype)
+        cols, w = kernels.compact(batch.cols, w, w != 0)
+        return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w)
+
+    def eval(self, batch: Batch) -> Batch:
+        return self._kernel(batch)
+
+
+@stream_method
+def distinct(self: Stream) -> Stream:
+    """Incremental distinct (root scope)."""
+    t = self.trace()
+    out = self.circuit.add_unary_operator(DistinctOp(), t)
+    out.schema = getattr(self, "schema", None)
+    return out
+
+
+@stream_method
+def stream_distinct(self: Stream) -> Stream:
+    out = self.circuit.add_unary_operator(StreamDistinct(), self)
+    out.schema = getattr(self, "schema", None)
+    return out

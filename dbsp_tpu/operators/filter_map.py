@@ -1,0 +1,180 @@
+"""Linear per-record operators: map, filter, flat_map, map_index.
+
+Reference surface: the ``FilterMap`` trait family
+(``operator/filter_map.rs:67,124,143``) and ``operator/index.rs:29,61``.
+
+TPU-native shape: the user function is a *traced columnar transform* — it
+receives the batch's columns as device arrays and returns new key/value
+columns — so one jitted kernel handles the whole batch (no per-record host
+calls, unlike the reference's per-record closures). Row validity rides on the
+weight column: transforms run on dead (sentinel) rows too, but their weight
+stays 0 and consolidation drops them — user functions therefore must be
+total (no assertions on padding garbage), which numeric jnp ops are.
+
+A ``RowFn`` takes ``(key_cols, val_cols)`` (tuples of [cap] arrays) and
+returns ``(new_key_cols, new_val_cols)`` of the same length.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch
+
+Cols = Tuple[jnp.ndarray, ...]
+RowFn = Callable[[Cols, Cols], Tuple[Cols, Cols]]
+PredFn = Callable[[Cols, Cols], jnp.ndarray]
+
+
+class MapOp(UnaryOperator):
+    """Per-row transform + re-consolidation (transforms may collide rows).
+
+    ``preserves_order=True`` asserts the transform is monotone w.r.t. the
+    row order (e.g. currency scaling, dropping trailing columns) and skips
+    the re-sort. Monotonicity means colliding outputs are contiguous, so the
+    kernel still merges duplicates (segment-sum + compact) to uphold the
+    consolidated-batch invariant — it only skips the sort itself.
+    """
+
+    def __init__(self, fn: RowFn, name: str = "map",
+                 preserves_order: bool = False):
+        self.fn = fn
+        self.name = name
+        self.preserves_order = preserves_order
+
+        @jax.jit
+        def kernel(batch: Batch) -> Batch:
+            nk, nv = fn(batch.keys, batch.vals)
+            nk, nv = tuple(nk), tuple(nv)
+            if self.preserves_order:
+                # sort-free consolidation: inputs are sorted and the map is
+                # monotone, so equal output rows are adjacent (dead rows got
+                # garbage transforms but weight 0 and merge/drop cleanly)
+                cap = batch.cap
+                live = batch.weights != 0
+                cols = tuple(
+                    jnp.where(live, c, kernels.sentinel_for(c.dtype))
+                    for c in (*nk, *nv))
+                dup = kernels.rows_equal_prev(cols, n=cap) & live
+                seg = jnp.cumsum(~dup) - 1
+                sums = jax.ops.segment_sum(batch.weights, seg,
+                                           num_segments=cap)
+                w = jnp.where(dup, 0, sums[seg]).astype(batch.weights.dtype)
+                cols, w = kernels.compact(cols, w, w != 0)
+            else:
+                cols, w = kernels.consolidate_cols((*nk, *nv), batch.weights)
+            return Batch(cols[: len(nk)], cols[len(nk):], w)
+
+        self._kernel = kernel
+
+    def eval(self, batch: Batch) -> Batch:
+        return self._kernel(batch)
+
+
+class FilterOp(UnaryOperator):
+    """Keep rows where the predicate holds. Input order is preserved, so the
+    kernel is a mask + compaction — no sort."""
+
+    def __init__(self, pred: PredFn, name: str = "filter"):
+        self.pred = pred
+        self.name = name
+
+        @jax.jit
+        def kernel(batch: Batch) -> Batch:
+            keep = pred(batch.keys, batch.vals) & (batch.weights != 0)
+            cols, w = kernels.compact(batch.cols, batch.weights, keep)
+            return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w)
+
+        self._kernel = kernel
+
+    def eval(self, batch: Batch) -> Batch:
+        return self._kernel(batch)
+
+
+class FlatMapOp(UnaryOperator):
+    """Each row expands to up to ``fanout`` rows (static bound, XLA shapes).
+
+    ``fn(keys, vals) -> (new_keys, new_vals, keep)`` where each new column has
+    shape [fanout, cap] and keep is a [fanout, cap] bool mask. Reference:
+    ``flat_map`` (filter_map.rs:143) — the static bound replaces the
+    reference's unbounded per-record iterators.
+    """
+
+    def __init__(self, fn, fanout: int, name: str = "flat_map"):
+        self.fn = fn
+        self.fanout = fanout
+        self.name = name
+
+        @jax.jit
+        def kernel(batch: Batch) -> Batch:
+            nk, nv, keep = fn(batch.keys, batch.vals)
+            cap = batch.cap
+            f = fanout
+            w = jnp.broadcast_to(batch.weights, (f, cap))
+            w = jnp.where(keep, w, 0).reshape(f * cap)
+            flat_k = tuple(c.reshape(f * cap) for c in nk)
+            flat_v = tuple(c.reshape(f * cap) for c in nv)
+            cols, w = kernels.consolidate_cols((*flat_k, *flat_v), w)
+            return Batch(cols[: len(flat_k)], cols[len(flat_k):], w)
+
+        self._kernel = kernel
+
+    def eval(self, batch: Batch) -> Batch:
+        return self._kernel(batch)
+
+
+# -- Stream sugar -----------------------------------------------------------
+
+
+def _set_schema(s: Stream, key_dtypes, val_dtypes) -> Stream:
+    s.schema = (tuple(key_dtypes), tuple(val_dtypes))
+    return s
+
+
+@stream_method
+def map_rows(self: Stream, fn: RowFn, key_dtypes, val_dtypes=(),
+             name: str = "map", preserves_order: bool = False) -> Stream:
+    """General columnar map; declares the output schema."""
+    out = self.circuit.add_unary_operator(
+        MapOp(fn, name, preserves_order), self)
+    return _set_schema(out, key_dtypes, val_dtypes)
+
+
+@stream_method
+def filter_rows(self: Stream, pred: PredFn, name: str = "filter") -> Stream:
+    out = self.circuit.add_unary_operator(FilterOp(pred, name), self)
+    out.schema = getattr(self, "schema", None)
+    return out
+
+
+@stream_method
+def flat_map_rows(self: Stream, fn, fanout: int, key_dtypes, val_dtypes=(),
+                  name: str = "flat_map") -> Stream:
+    out = self.circuit.add_unary_operator(FlatMapOp(fn, fanout, name), self)
+    return _set_schema(out, key_dtypes, val_dtypes)
+
+
+@stream_method
+def index_by(self: Stream, key_fn: Callable[[Cols, Cols], Cols],
+             key_dtypes, val_fn: Callable[[Cols, Cols], Cols] = None,
+             val_dtypes=None, name: str = "index") -> Stream:
+    """Re-key a Z-set (reference: ``index_with``, operator/index.rs:61).
+
+    The resulting batch's key columns are what joins/aggregates group by.
+    """
+    if val_fn is None:
+        val_fn = lambda k, v: (*k, *v)  # noqa: E731
+        schema = getattr(self, "schema", None)
+        assert schema is not None or val_dtypes is not None, (
+            "index_by needs val_dtypes when the input stream has no schema")
+        if val_dtypes is None:
+            val_dtypes = (*schema[0], *schema[1])
+    fn = lambda k, v: (key_fn(k, v), val_fn(k, v))  # noqa: E731
+    return map_rows(self, fn, key_dtypes, val_dtypes, name=name)

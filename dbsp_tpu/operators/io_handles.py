@@ -1,0 +1,111 @@
+"""Input and output handles: the host <-> circuit data boundary.
+
+Reference: ``operator/input.rs`` (``add_input_zset`` :75,
+``add_input_indexed_zset`` :107, upsert-style ``add_input_set/map``
+:230,313) and ``operator/output.rs:29``.
+
+Differences by design: the reference spreads input across worker threads
+round-robin and merges worker outputs with ``gather``; here a single handle
+owns the (device-resident) batch, and worker distribution is the shard
+operator's hash exchange inside the SPMD step (parallel/exchange.py), so
+handles are worker-count agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Circuit, Stream
+from dbsp_tpu.circuit.operator import SinkOperator, SourceOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.zset.batch import Batch, Row, concat_batches
+
+
+class ZSetInput(SourceOperator):
+    """Source draining a host-side buffer of rows/batches once per tick."""
+
+    name = "input"
+
+    def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = ()):
+        self.key_dtypes = tuple(key_dtypes)
+        self.val_dtypes = tuple(val_dtypes)
+        self._rows: List[Tuple[Row, int]] = []
+        self._batches: List[Batch] = []
+
+    def eval(self) -> Batch:
+        parts = self._batches
+        if self._rows:
+            parts = parts + [Batch.from_tuples(
+                self._rows, self.key_dtypes, self.val_dtypes)]
+        self._rows, self._batches = [], []
+        if not parts:
+            return Batch.empty(self.key_dtypes, self.val_dtypes)
+        if len(parts) == 1:
+            return parts[0].consolidate()
+        return concat_batches(parts).consolidate()
+
+
+class InputHandle:
+    """Host-side feeder for a :class:`ZSetInput` (reference:
+    ``CollectionHandle``, input.rs:591)."""
+
+    def __init__(self, op: ZSetInput):
+        self._op = op
+
+    def push(self, row: Row, weight: int = 1) -> None:
+        self._op._rows.append((row, weight))
+
+    def extend(self, rows: Sequence[Tuple[Row, int]]) -> None:
+        self._op._rows.extend(rows)
+
+    def push_batch(self, batch: Batch) -> None:
+        """Zero-copy path: feed an already-built (device) batch."""
+        self._op._batches.append(batch)
+
+
+class OutputOperator(SinkOperator):
+    name = "output"
+
+    def __init__(self):
+        self.current: Optional[Batch] = None
+
+    def eval(self, v: Batch) -> None:
+        self.current = v
+
+
+class OutputHandle:
+    """Reads the value a stream produced in the latest step (reference:
+    ``OutputHandle::take_from_all/consolidate``, output.rs:173-219)."""
+
+    def __init__(self, op: OutputOperator):
+        self._op = op
+
+    def take(self) -> Optional[Batch]:
+        v, self._op.current = self._op.current, None
+        return v
+
+    def peek(self) -> Optional[Batch]:
+        return self._op.current
+
+    def to_dict(self) -> Dict[Row, int]:
+        v = self._op.current
+        return {} if v is None else v.to_dict()
+
+
+def add_input_zset(circuit: Circuit, key_dtypes: Sequence,
+                   val_dtypes: Sequence = ()) -> Tuple[Stream, InputHandle]:
+    """reference: ``add_input_zset`` (input.rs:75). The returned stream's
+    schema metadata propagates through schema-preserving operators."""
+    op = ZSetInput(key_dtypes, val_dtypes)
+    s = circuit.add_source(op)
+    s.schema = (op.key_dtypes, op.val_dtypes)
+    return s, InputHandle(op)
+
+
+@stream_method
+def output(self: Stream) -> OutputHandle:
+    op = OutputOperator()
+    self.circuit.add_sink(op, self)
+    return OutputHandle(op)

@@ -1,0 +1,159 @@
+"""Incremental equi-join — the bilinear delta form, as TPU merge kernels.
+
+Reference: ``operator/join.rs`` — ``stream_join`` (:52), incremental ``join``
+(:180) / ``join_index`` (:200) / ``join_generic`` (:217), with the math in the
+derivation comment (join.rs:225-265):
+
+    Δ(A ⋈ B)_t = ΔA_t ⋈ T(B)_t  +  ΔB_t ⋈ T(A)_{t-1}
+
+where T(X)_t is the integral of X up to and including t. Each term runs as a
+sorted probe-and-expand kernel against the spine levels of the traced side:
+binary-search probes (delta-proportional), prefix-sum range expansion with a
+host-managed grow-on-demand output capacity (SURVEY.md §7 "join output
+explosion" — count/scan/scatter as static-shape gathers), weight products,
+then one consolidation over all levels' outputs.
+
+The reference re-shards both inputs by key hash before joining
+(join.rs:268-270); here sharding is a property of the stream (parallel/
+exchange.py) and the single-worker path needs none.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import BinaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.operators.trace_op import TraceView
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
+
+# fn(key_cols, left_val_cols, right_val_cols) -> (out_key_cols, out_val_cols)
+JoinFn = Callable[[Tuple, Tuple, Tuple], Tuple[Tuple, Tuple]]
+
+
+@partial(jax.jit, static_argnames=("nk", "fn", "out_cap"))
+def _join_level(delta: Batch, level: Batch, nk: int, fn: JoinFn,
+                out_cap: int) -> Tuple[Batch, jnp.ndarray]:
+    """Join a delta batch against one spine level; static out_cap."""
+    dk = delta.keys[:nk]
+    lk = level.keys[:nk]
+    lo = kernels.lex_probe(lk, dk, side="left")
+    hi = kernels.lex_probe(lk, dk, side="right")
+    # dead delta rows carry sentinel keys, which match the level's dead tail —
+    # zero their ranges instead of emitting weight-0 garbage
+    live = delta.weights != 0
+    lo = jnp.where(live, lo, 0)
+    hi = jnp.where(live, hi, lo)
+    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap)
+    w = jnp.where(valid, delta.weights[row] * level.weights[src], 0)
+    key_cols = tuple(c[row] for c in delta.keys[:nk])
+    lvals = tuple(c[row] for c in delta.vals)
+    rvals = tuple(c[src] for c in level.vals)
+    out_keys, out_vals = fn(key_cols, lvals, rvals)
+    cols, w = kernels.consolidate_cols((*out_keys, *out_vals), w)
+    out = Batch(cols[: len(out_keys)], cols[len(out_keys):], w)
+    return out, total
+
+
+class JoinCore:
+    """Grow-on-demand driver for joining deltas against spine levels.
+
+    Keeps a per-instance output-capacity estimate (monotone, power-of-two) so
+    the common case is one kernel launch per level and zero host syncs beyond
+    the overflow check — the TPU answer to the reference's two-pass
+    count/alloc/fill fan-out.
+    """
+
+    def __init__(self, nk: int, fn: JoinFn, out_schema):
+        self.nk = nk
+        self.fn = fn
+        self.out_schema = out_schema
+        self.caps: Dict[int, int] = {}  # level bucket -> out cap
+
+    def join_levels(self, delta: Batch, levels: Sequence[Batch]) -> List[Batch]:
+        outs: List[Batch] = []
+        for level in levels:
+            cap = self.caps.get(level.cap, max(64, delta.cap))
+            out, total = _join_level(delta, level, self.nk, self.fn, cap)
+            t = int(total)
+            if t > cap:
+                cap = bucket_cap(t)
+                self.caps[level.cap] = cap
+                out, _ = _join_level(delta, level, self.nk, self.fn, cap)
+            outs.append(out)
+        return outs
+
+
+class JoinOp(BinaryOperator):
+    """Consumes the two trace streams; emits the output delta Z-set.
+
+    Reference: the JoinTrace operator pair assembled by join_generic
+    (join.rs:581 + :268-290); both terms and the final sum are fused into one
+    host eval here.
+    """
+
+    def __init__(self, fn: JoinFn, nk: int, out_schema, name="join"):
+        self.name = name
+        self.out_schema = out_schema
+        # Left delta joins the right trace INCLUDING this tick's right delta;
+        # right delta joins the left trace EXCLUDING this tick's (delayed).
+        self._left_core = JoinCore(nk, fn, out_schema)
+        flipped = lambda k, rv, lv: fn(k, lv, rv)  # noqa: E731
+        self._right_core = JoinCore(nk, flipped, out_schema)
+
+    def eval(self, left: TraceView, right: TraceView) -> Batch:
+        outs = self._left_core.join_levels(left.delta, right.spine.batches)
+        outs += self._right_core.join_levels(right.delta, left.pre_levels)
+        if not outs:
+            return Batch.empty(*self.out_schema)
+        if len(outs) == 1:
+            return outs[0]
+        return concat_batches(outs).consolidate().shrink_to_fit()
+
+
+@stream_method
+def join_index(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
+               out_val_dtypes, name: str = "join") -> Stream:
+    """Incremental equi-join on the streams' key columns.
+
+    ``fn(key_cols, left_val_cols, right_val_cols)`` maps each matching pair
+    to output key/value columns (join.rs:200 ``join_index`` semantics; plain
+    ``join`` == identity keys).
+    """
+    ls, rs = getattr(self, "schema", None), getattr(other, "schema", None)
+    assert ls is not None and rs is not None, "join needs schemas on both sides"
+    assert ls[0] == rs[0], f"join key dtypes differ: {ls[0]} vs {rs[0]}"
+    lt = self.trace()
+    rt = other.trace()
+    out = self.circuit.add_binary_operator(
+        JoinOp(fn, len(ls[0]), (tuple(out_key_dtypes), tuple(out_val_dtypes)),
+               name), lt, rt)
+    out.schema = (tuple(out_key_dtypes), tuple(out_val_dtypes))
+    return out
+
+
+@stream_method
+def stream_join(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
+                out_val_dtypes, name: str = "stream_join") -> Stream:
+    """Non-incremental per-tick join: ΔA_t ⋈ ΔB_t only (join.rs:52) — joins
+    the two CURRENT tick values, no state."""
+    core = JoinCore(len(getattr(self, "schema", ((), ()))[0]) or 1, fn, None)
+
+    def eval_fn(a: Batch, b: Batch) -> Batch:
+        core.nk = len(a.keys)  # late-bound; capacity estimates persist
+        outs = core.join_levels(a, [b])
+        return outs[0] if len(outs) == 1 else \
+            concat_batches(outs).consolidate()
+
+    from dbsp_tpu.operators.basic import Apply2
+
+    out = self.circuit.add_binary_operator(
+        Apply2(eval_fn, name), self, other)
+    out.schema = (tuple(out_key_dtypes), tuple(out_val_dtypes))
+    return out

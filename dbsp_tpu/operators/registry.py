@@ -1,0 +1,17 @@
+"""Stream-method registration.
+
+The reference exposes operators as Rust extension-trait methods on ``Stream``
+(e.g. ``operator/filter_map.rs`` impl blocks); the Python analog is attaching
+functions to the Stream class at import time. Every operator module registers
+its sugar through :func:`stream_method` so `dbsp_tpu.operators` import order
+is the only wiring needed.
+"""
+
+from dbsp_tpu.circuit.builder import Stream
+
+
+def stream_method(fn):
+    assert not hasattr(Stream, fn.__name__), (
+        f"Stream.{fn.__name__} registered twice")
+    setattr(Stream, fn.__name__, fn)
+    return fn

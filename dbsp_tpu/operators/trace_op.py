@@ -1,0 +1,80 @@
+"""The trace operator: shares one integrated spine of a stream among
+consumers, with delayed access for bilinear operators.
+
+Reference: ``operator/trace.rs`` — ``Stream::trace`` (:173),
+``integrate_trace`` (:238), ``delay_trace`` (:312), and the circuit-cache
+sharing so a stream's trace is built once (``circuit/cache.rs``).
+
+Design notes vs the reference:
+* ``TraceOp`` appends this tick's delta to a :class:`~dbsp_tpu.trace.Spine`
+  and emits the spine object itself on the stream (operators downstream probe
+  it; spines are host objects owning device batches).
+* The reference splits Z1Trace/UntimedTraceAppend to get "trace as of the
+  previous tick" vs "including this tick". Here ``TraceOp`` emits a
+  ``TraceView`` that exposes both: ``delayed`` (levels before this tick's
+  append — what bilinear join needs for one side) and ``current``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.trace.spine import Spine
+from dbsp_tpu.zset.batch import Batch
+
+
+@dataclasses.dataclass
+class TraceView:
+    """What downstream operators see on a trace stream each tick.
+
+    ``spine``      — the spine AFTER appending this tick's delta.
+    ``delta``      — this tick's delta batch.
+    ``pre_levels`` — snapshot of the spine's level list BEFORE the append
+                     (the z^-1 trace view; batches are immutable so the
+                     snapshot is free).
+    """
+
+    spine: Spine
+    delta: Batch
+    pre_levels: List[Batch]
+
+
+class TraceOp(UnaryOperator):
+    """Maintains the integral of a stream as a spine (integrate_trace)."""
+
+    name = "trace"
+
+    def __init__(self, key_dtypes, val_dtypes):
+        self.spine = Spine(key_dtypes, val_dtypes)
+
+    def eval(self, delta: Batch) -> TraceView:
+        pre = list(self.spine.batches)
+        self.spine.insert(delta)
+        return TraceView(self.spine, delta, pre)
+
+    def metadata(self):
+        return {"levels": len(self.spine.batches),
+                "total_cap": self.spine.total_cap}
+
+    def fixedpoint(self, scope: int) -> bool:
+        return not self.spine.dirty
+
+
+@stream_method
+def trace(self: Stream) -> Stream:
+    """Stream of TraceViews of this stream's integral; built once per source
+    stream via the circuit cache (reference: trace.rs:173 + cache.rs)."""
+    key = ("trace", self.node_index)
+    cached = self.circuit.cache.get(key)
+    if cached is not None:
+        return cached
+    schema = getattr(self, "schema", None)
+    assert schema is not None, "trace() needs stream schema metadata"
+    out = self.circuit.add_unary_operator(TraceOp(*schema), self)
+    out.schema = schema
+    self.circuit.cache[key] = out
+    return out

@@ -1,0 +1,3 @@
+from dbsp_tpu.parallel.mesh import WORKER_AXIS, make_mesh, replicated, worker_sharding
+
+__all__ = ["WORKER_AXIS", "make_mesh", "replicated", "worker_sharding"]

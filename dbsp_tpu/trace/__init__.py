@@ -1,0 +1,3 @@
+from dbsp_tpu.trace.spine import Spine
+
+__all__ = ["Spine"]

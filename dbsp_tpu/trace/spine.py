@@ -1,0 +1,170 @@
+"""Spine: the LSM-style trace of a stream — accumulated state as a small set
+of consolidated batches in geometric size classes.
+
+TPU-native rethink of the reference's fueled spine
+(``crates/dbsp/src/trace/spine_fueled.rs:107``): the reference amortizes merge
+work by carrying "fuel" through partially-completed merges; here a merge is a
+single fused device kernel (concat + sort + segment-sum + compact), so instead
+of fuel we bound *when* merges fire — two batches in the same power-of-two
+capacity bucket merge immediately, giving the same O(log n) level structure
+and O(1) amortized merges per insert, with no partially-merged state to track.
+
+Host-side bookkeeping (which batches exist, their buckets) is Python; all data
+movement is jitted device work. Capacities are power-of-two buckets so the set
+of compiled kernel shapes stays logarithmic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, Row, bucket_cap, concat_batches
+
+
+class Spine:
+    """An append-only Z-set trace with amortized device merges.
+
+    Reference behaviors covered (``trace/mod.rs:86``): ``insert`` (:meth:`insert`),
+    the dirty flag (:attr:`dirty`), lower-bound GC ``truncate_keys_below``
+    (:meth:`truncate_keys_below`), and cursor-style key probes
+    (:meth:`probe_ranges`).
+    """
+
+    def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = ()):
+        self.key_dtypes = tuple(jnp.dtype(d) for d in key_dtypes)
+        self.val_dtypes = tuple(jnp.dtype(d) for d in val_dtypes)
+        self.batches: List[Batch] = []
+        self.dirty = False  # any insert since last clear (fixedpoint checks)
+        self._consolidated: Optional[Batch] = None
+
+    # -- maintenance --------------------------------------------------------
+    def insert(self, batch: Batch) -> None:
+        """Insert a consolidated delta batch; merge equal-sized levels."""
+        batch = _shrink(batch)
+        if batch is None:
+            return
+        self.dirty = True
+        self._consolidated = None
+        self.batches.append(batch)
+        self.batches.sort(key=lambda b: b.cap, reverse=True)
+        # Merge while two levels share a capacity bucket (LSM compaction).
+        merged = True
+        while merged:
+            merged = False
+            for i in range(len(self.batches) - 1):
+                if self.batches[i].cap == self.batches[i + 1].cap:
+                    a = self.batches.pop(i + 1)
+                    b = self.batches.pop(i)
+                    m = _shrink(concat_batches([a, b]).consolidate())
+                    if m is not None:
+                        self.batches.insert(i, m)
+                        self.batches.sort(key=lambda b: b.cap, reverse=True)
+                    merged = True
+                    break
+
+    def is_empty(self) -> bool:
+        return not self.batches
+
+    def clear_dirty(self) -> None:
+        self.dirty = False
+
+    @property
+    def total_cap(self) -> int:
+        return sum(b.cap for b in self.batches)
+
+    def consolidated(self) -> Batch:
+        """All levels merged into one canonical batch (cached until insert).
+
+        O(total state) when (re)built — use :meth:`probe_ranges` /
+        per-level access in per-step hot paths; this is for aggregation
+        snapshots, output handles, and tests.
+        """
+        if self._consolidated is None:
+            if not self.batches:
+                self._consolidated = Batch.empty(self.key_dtypes, self.val_dtypes)
+            elif len(self.batches) == 1:
+                self._consolidated = self.batches[0]
+            else:
+                c = _shrink(concat_batches(self.batches).consolidate())
+                self._consolidated = c if c is not None else Batch.empty(
+                    self.key_dtypes, self.val_dtypes)
+        return self._consolidated
+
+    # -- GC (reference: TraceBound truncation, operator/trace.rs:29-120) ----
+    def truncate_keys_below(self, bound_key: Tuple) -> None:
+        """Drop all rows whose key tuple is lexicographically < ``bound_key``.
+
+        Consumers (windows, GC) declare monotone lower bounds; state below
+        them can never affect future outputs and is reclaimed here.
+        """
+        new: List[Batch] = []
+        for b in self.batches:
+            kept = _shrink(_truncate_batch(b, bound_key))
+            if kept is not None:
+                new.append(kept)
+        self.batches = sorted(new, key=lambda b: b.cap, reverse=True)
+        self._consolidated = None
+
+    # -- probes (cursor equivalents) ----------------------------------------
+    def probe_ranges(self, query_keys: Tuple[jnp.ndarray, ...]
+                     ) -> List[Tuple[Batch, jnp.ndarray, jnp.ndarray]]:
+        """Per-level [lo, hi) ranges of rows matching each query key.
+
+        Delta-proportional (O(m log n) binary-search probes per level); the
+        replacement for the reference's per-batch cursors + CursorList k-way
+        merge (``trace/cursor/cursor_list.rs``) — consumers fan out over the
+        O(log n) levels and combine with segment reductions.
+        """
+        nk = len(self.key_dtypes)
+        out = []
+        for b in self.batches:
+            tk = b.keys[:nk]
+            lo = kernels.lex_probe(tk, query_keys, side="left")
+            hi = kernels.lex_probe(tk, query_keys, side="right")
+            out.append((b, lo, hi))
+        return out
+
+    # -- host views ----------------------------------------------------------
+    def to_dict(self) -> Dict[Row, int]:
+        out: Dict[Row, int] = {}
+        for b in self.batches:
+            for r, w in b.to_dict().items():
+                out[r] = out.get(r, 0) + w
+                if out[r] == 0:
+                    del out[r]
+        return out
+
+
+@jax.jit
+def _truncate_weights(keys, weights, bound):
+    ge = jnp.zeros(weights.shape, jnp.bool_)
+    all_eq = jnp.ones(weights.shape, jnp.bool_)
+    for k, bv in zip(keys, bound):
+        kv = jnp.asarray(bv, k.dtype)
+        ge = ge | (all_eq & (k > kv))
+        all_eq = all_eq & (k == kv)
+    ge = ge | all_eq
+    return jnp.where(ge, weights, 0)
+
+
+def _truncate_batch(b: Batch, bound_key: Tuple) -> Batch:
+    nk = len(bound_key)
+    w = _truncate_weights(b.keys[:nk], b.weights, tuple(bound_key))
+    return Batch(b.keys, b.vals, w).consolidate()
+
+
+def _shrink(batch: Batch) -> Optional[Batch]:
+    """Shrink a consolidated batch to its tight capacity bucket; None if empty.
+
+    The one host<->device sync per insert (a scalar live-row count); keeps
+    level capacities proportional to live data so probe/merge cost tracks
+    actual state size.
+    """
+    live = int(batch.live_count())
+    if live == 0:
+        return None
+    return batch.with_cap(bucket_cap(live))

@@ -158,8 +158,18 @@ class Batch:
         return Batch(self.keys, self.vals, self.weights * c)
 
     def add(self, other: "Batch") -> "Batch":
-        """Z-set group addition (concatenate + consolidate)."""
-        return concat_batches([self, other]).consolidate()
+        """Z-set group addition (concatenate + consolidate + re-bucket).
+
+        The shrink keeps capacities in power-of-two buckets proportional to
+        live rows — without it, iterated adds (the integrator loop) would grow
+        capacity by cap_other per tick and trigger a fresh XLA compile each
+        step. Costs one scalar device->host sync; host-level callers only.
+        """
+        return concat_batches([self, other]).consolidate().shrink_to_fit()
+
+    def shrink_to_fit(self, minimum: int = 8) -> "Batch":
+        """Re-bucket a consolidated batch to bucket_cap(live rows)."""
+        return self.with_cap(bucket_cap(int(self.live_count()), minimum))
 
     # -- host-side views (tests / output handles) ---------------------------
     def to_dict(self) -> Dict[Row, int]:

@@ -181,6 +181,51 @@ def searchsorted1(table: jnp.ndarray, query: jnp.ndarray,
                             ).astype(jnp.int32)
 
 
+def _lex_le_rows(table_cols, idx, query_cols, strict: bool):
+    """Per-query compare: table[idx] < query (strict) or <= query, under the
+    same total order lax.sort uses (NaN ranks greatest, NaN == NaN)."""
+    lt = jnp.zeros(idx.shape, jnp.bool_)
+    all_eq = jnp.ones(idx.shape, jnp.bool_)
+    for t, q in zip(table_cols, query_cols):
+        tv = t[idx]
+        qv = q.astype(t.dtype)
+        col_lt = tv < qv
+        if jnp.issubdtype(t.dtype, jnp.floating):
+            col_lt = col_lt | (jnp.isnan(qv) & ~jnp.isnan(tv))
+        lt = lt | (all_eq & col_lt)
+        all_eq = all_eq & _col_eq(tv, qv)
+    return lt if strict else lt | all_eq
+
+
+def lex_probe(table_cols: Tuple[jnp.ndarray, ...],
+              query_cols: Tuple[jnp.ndarray, ...],
+              side: str = "left") -> jnp.ndarray:
+    """Delta-proportional searchsorted: O(m log n) vectorized binary search.
+
+    The hot-path probe used by incremental operators to look a delta's keys up
+    in a large trace (the analog of the reference's exponential-search
+    ``advance``, ``trace/layers/advance.rs``). Unlike :func:`lex_searchsorted`
+    (which sorts table+query together, O(n+m)), cost here scales with the
+    *delta*, preserving DBSP's per-step cost model; the trace is only gathered
+    at log2(n) probe indices per query. Unrolled loop — n is static under jit.
+    """
+    assert table_cols, "lex_probe requires at least one key column"
+    n = table_cols[0].shape[0]
+    m = query_cols[0].shape[0]
+    lo = jnp.zeros((m,), jnp.int32)
+    hi = jnp.full((m,), n, jnp.int32)
+    # n+1 candidate insertion points [0, n] => ceil(log2(n+1)) halvings
+    steps = n.bit_length()
+    strict = side == "left"
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) >> 1  # < hi <= n on active lanes; clamped gather else
+        go_right = _lex_le_rows(table_cols, mid, query_cols, strict=strict)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
 # ---------------------------------------------------------------------------
 # Range expansion: turn per-row [lo, hi) ranges into a flat gather index list
 # ---------------------------------------------------------------------------

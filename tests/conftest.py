@@ -1,11 +1,29 @@
-# Force tests onto the CPU backend with 8 virtual devices so multi-worker
+# Tests run on the CPU backend with 8 virtual devices so multi-worker
 # sharding (Mesh/shard_map/all_to_all) is exercised without TPU hardware.
-# Must run before jax is imported anywhere.
+#
+# Environment subtlety: the interpreter may start with a TPU PJRT plugin
+# registered by sitecustomize, which also force-sets JAX_PLATFORMS=axon and
+# imports jax BEFORE conftest runs. Env mutation alone is therefore too late —
+# the platform must be overridden through jax.config at runtime, which also
+# keeps CPU-only test runs from dialing the TPU tunnel at all (a wedged
+# tunnel would otherwise hang every test).
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
+    # read at CPU client creation, which happens lazily after conftest
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Compile-once discipline: a persistent compilation cache makes re-runs and
+# cross-test shape reuse cheap (first cold run still compiles).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,148 @@
+"""Circuit core tests: scheduling, feedback, integrate/differentiate, handles.
+
+Pattern follows the reference's engine tests (``circuit/circuit_builder.rs``
+tests and ``circuit/dbsp_handle.rs:313-422``): build a small circuit with
+Generator sources, step it, assert captured outputs.
+"""
+
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit import RootCircuit, Runtime
+from dbsp_tpu.circuit.scheduler import CircuitGraphError
+from dbsp_tpu.operators import Generator, add_input_zset
+from dbsp_tpu.zset import Batch
+
+
+def test_scalar_integrate():
+    got = []
+
+    def build(c):
+        s = c.add_source(Generator(list(range(1, 6)), default=0))
+        s.integrate(zero_factory=lambda: 0).inspect(got.append)
+
+    circuit, _ = RootCircuit.build(build)
+    for _ in range(5):
+        circuit.step()
+    assert got == [1, 3, 6, 10, 15]
+
+
+def test_scalar_differentiate_inverts_integrate():
+    got = []
+
+    def build(c):
+        s = c.add_source(Generator([3, 1, 4, 1, 5], default=0))
+        s.integrate(zero_factory=lambda: 0) \
+         .differentiate(zero_factory=lambda: 0).inspect(got.append)
+
+    circuit, _ = RootCircuit.build(build)
+    for _ in range(5):
+        circuit.step()
+    assert got == [3, 1, 4, 1, 5]
+
+
+def test_delay_shifts_by_one():
+    got = []
+
+    def build(c):
+        s = c.add_source(Generator([10, 20, 30], default=0))
+        s.delay(zero_factory=lambda: 0).inspect(got.append)
+
+    circuit, _ = RootCircuit.build(build)
+    for _ in range(4):
+        circuit.step()
+    assert got == [0, 10, 20, 30]
+
+
+def test_zset_integrate_via_handles():
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [])
+        return h, s.integrate().output()
+
+    circuit, (h, out) = RootCircuit.build(build)
+    h.push((1,), 1)
+    h.push((2,), 2)
+    circuit.step()
+    assert out.to_dict() == {(1,): 1, (2,): 2}
+    h.push((1,), -1)
+    h.push((3,), 5)
+    circuit.step()
+    assert out.to_dict() == {(2,): 2, (3,): 5}
+    circuit.step()  # no input: integral unchanged
+    assert out.to_dict() == {(2,): 2, (3,): 5}
+
+
+def test_zset_differentiate_recovers_deltas():
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [])
+        integ = s.integrate()
+        return h, integ.differentiate().output()
+
+    circuit, (h, out) = RootCircuit.build(build)
+    h.push((7,), 3)
+    circuit.step()
+    assert out.to_dict() == {(7,): 3}
+    h.push((8,), 1)
+    circuit.step()
+    assert out.to_dict() == {(8,): 1}
+    circuit.step()
+    assert out.to_dict() == {}
+
+
+def test_plus_minus_neg_sum():
+    def build(c):
+        a, ha = add_input_zset(c, [jnp.int64], [])
+        b, hb = add_input_zset(c, [jnp.int64], [])
+        d, hd = add_input_zset(c, [jnp.int64], [])
+        return ha, hb, hd, a.plus(b).output(), a.minus(b).output(), \
+            a.neg().output(), a.sum_with([b, d]).output()
+
+    circuit, (ha, hb, hd, plus_o, minus_o, neg_o, sum_o) = \
+        RootCircuit.build(build)
+    ha.extend([((1,), 2), ((2,), 1)])
+    hb.extend([((1,), -2), ((3,), 4)])
+    hd.extend([((9,), 1)])
+    circuit.step()
+    assert plus_o.to_dict() == {(2,): 1, (3,): 4}
+    assert minus_o.to_dict() == {(1,): 4, (2,): 1, (3,): -4}
+    assert neg_o.to_dict() == {(1,): -2, (2,): -1}
+    assert sum_o.to_dict() == {(2,): 1, (3,): 4, (9,): 1}
+
+
+def test_nonstrict_cycle_rejected():
+    # a cycle that does not pass through a strict (z^-1) node must be rejected
+    from dbsp_tpu.operators.basic import Plus
+
+    c = RootCircuit()
+    s = c.add_source(Generator([1], default=0))
+    n = c._add_node(Plus(), "binary", [s.node_index])
+    n.inputs.append(n.index)  # self-loop
+    with pytest.raises(CircuitGraphError):
+        c.step()
+
+
+def test_runtime_init_circuit_and_step_latency():
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [])
+        return h, s.integrate().output()
+
+    handle, (h, out) = Runtime.init_circuit(1, build)
+    h.push((1,), 1)
+    handle.step()
+    assert out.to_dict() == {(1,): 1}
+    assert len(handle.step_times_ns) == 1 and handle.step_times_ns[0] > 0
+
+
+def test_scheduler_events_fire():
+    events = []
+
+    def build(c):
+        c.register_scheduler_event_handler(lambda e: events.append(e.kind))
+        s, h = add_input_zset(c, [jnp.int64], [])
+        return h, s.output()
+
+    circuit, _ = RootCircuit.build(build)
+    assert events == ["clock_start"]  # fired when the root clock started
+    circuit.step()
+    assert events[1] == "step_start" and events[-1] == "step_end"
+    assert "eval_start" in events and "eval_end" in events

@@ -1,0 +1,106 @@
+"""Stage-4 end-to-end slice: generator -> input handles -> jitted linear ops
+-> output handles, verified against a pure-Python oracle (the differential
+pattern of SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit import RootCircuit
+from dbsp_tpu.nexmark import NexmarkGenerator, GeneratorConfig, build_inputs, queries
+from dbsp_tpu.nexmark import model as M
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return NexmarkGenerator(GeneratorConfig(seed=42, first_event_rate=1000))
+
+
+def test_generator_deterministic_and_batch_invariant(gen):
+    whole = gen.generate(0, 200)
+    split_a, split_b = gen.generate(0, 77), gen.generate(77, 200)
+    for rel in ("persons", "auctions", "bids"):
+        for col in whole[rel]:
+            merged = np.concatenate([split_a[rel][col], split_b[rel][col]])
+            np.testing.assert_array_equal(whole[rel][col], merged, err_msg=f"{rel}.{col}")
+
+
+def test_generator_proportions(gen):
+    cols = gen.generate(0, 5000)
+    assert len(cols["persons"]["id"]) == 100
+    assert len(cols["auctions"]["id"]) == 300
+    assert len(cols["bids"]["auction"]) == 4600
+    # dense monotone ids
+    np.testing.assert_array_equal(cols["persons"]["id"],
+                                  1000 + np.arange(100))
+    np.testing.assert_array_equal(cols["auctions"]["id"],
+                                  np.sort(cols["auctions"]["id"]))
+    # bids reference existing auctions only
+    assert cols["bids"]["auction"].max() <= cols["auctions"]["id"].max()
+    assert cols["bids"]["auction"].min() >= 1000
+    # event time is monotone at the configured rate
+    ts = cols["bids"]["date_time"]
+    assert (np.diff(ts) >= 0).all()
+
+
+def _run_query(build_query, gen, n_events=2000, steps=4):
+    def build(c):
+        (p, a, b), handles = build_inputs(c)
+        return handles, build_query(p, a, b).output()
+
+    circuit, (handles, out) = RootCircuit.build(build)
+    per = n_events // steps
+    results = []
+    for i in range(steps):
+        gen.feed(handles, i * per, (i + 1) * per)
+        circuit.step()
+        results.append(out.to_dict())
+    return results
+
+
+def test_q0_passthrough(gen):
+    results = _run_query(queries.q0, gen)
+    cols = gen.generate(0, 2000)["bids"]
+    want = {}
+    for i in range(len(cols["auction"])):
+        row = (int(cols["auction"][i]), int(cols["bidder"][i]),
+               int(cols["price"][i]), int(cols["channel"][i]),
+               int(cols["date_time"][i]))
+        want[row] = want.get(row, 0) + 1
+    got = {}
+    for r in results:
+        for row, w in r.items():
+            got[row] = got.get(row, 0) + w
+    assert got == want
+
+
+def test_q1_currency(gen):
+    results = _run_query(queries.q1, gen, n_events=1000, steps=2)
+    cols = gen.generate(0, 1000)["bids"]
+    want = {}
+    for i in range(len(cols["auction"])):
+        row = (int(cols["auction"][i]), int(cols["bidder"][i]),
+               int(cols["price"][i]) * 908 // 1000, int(cols["channel"][i]),
+               int(cols["date_time"][i]))
+        want[row] = want.get(row, 0) + 1
+    got = {}
+    for r in results:
+        for row, w in r.items():
+            got[row] = got.get(row, 0) + w
+    assert got == want
+
+
+def test_q2_filter_project(gen):
+    results = _run_query(queries.q2, gen, n_events=4000, steps=2)
+    cols = gen.generate(0, 4000)["bids"]
+    want = {}
+    for i in range(len(cols["auction"])):
+        a = int(cols["auction"][i])
+        if a % 123 == 0:
+            row = (a, int(cols["price"][i]))
+            want[row] = want.get(row, 0) + 1
+    got = {}
+    for r in results:
+        for row, w in r.items():
+            got[row] = got.get(row, 0) + w
+    assert got == want

@@ -1,0 +1,151 @@
+"""Spine (trace) tests vs a dict oracle — the model-checking pattern of the
+reference's spine/trace proptests (``trace/test_batch.rs``)."""
+
+import random
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.trace import Spine
+from dbsp_tpu.zset import Batch, kernels
+
+
+def random_rows(rng, n, key_range=20):
+    return [((rng.randrange(key_range), rng.randrange(5)),
+             rng.choice([-2, -1, 1, 2])) for _ in range(n)]
+
+
+def oracle_add(d, rows):
+    for r, w in rows:
+        d[r] = d.get(r, 0) + w
+        if d[r] == 0:
+            del d[r]
+    return d
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_spine_accumulates_inserts(seed):
+    rng = random.Random(seed)
+    spine = Spine([jnp.int64], [jnp.int32])
+    want = {}
+    for _ in range(12):
+        rows = random_rows(rng, rng.randrange(1, 30))
+        spine.insert(Batch.from_tuples(rows, [jnp.int64], [jnp.int32]))
+        oracle_add(want, rows)
+        assert spine.to_dict() == want
+    assert spine.consolidated().to_dict() == want
+    # level structure: strictly decreasing capacity buckets, O(log n) levels
+    caps = [b.cap for b in spine.batches]
+    assert caps == sorted(caps, reverse=True)
+    assert len(set(caps)) == len(caps)
+
+
+def test_spine_cancellation_empties():
+    spine = Spine([jnp.int64], [])
+    b = Batch.from_tuples([((1,), 1), ((2,), 3)], [jnp.int64], [])
+    spine.insert(b)
+    spine.insert(b.neg())
+    assert spine.to_dict() == {}
+
+
+def test_spine_dirty_flag():
+    spine = Spine([jnp.int64], [])
+    assert not spine.dirty
+    spine.insert(Batch.from_tuples([((1,), 1)], [jnp.int64], []))
+    assert spine.dirty
+    spine.clear_dirty()
+    assert not spine.dirty
+    # inserting an empty batch keeps it clean
+    spine.insert(Batch.empty([jnp.int64]))
+    assert not spine.dirty
+
+
+def test_truncate_keys_below():
+    spine = Spine([jnp.int64], [jnp.int32])
+    rows = [((k, k * 10), 1) for k in range(10)]
+    spine.insert(Batch.from_tuples(rows, [jnp.int64], [jnp.int32]))
+    spine.truncate_keys_below((4,))
+    assert spine.to_dict() == {(k, k * 10): 1 for k in range(4, 10)}
+    spine.truncate_keys_below((100,))
+    assert spine.to_dict() == {}
+
+
+def test_probe_ranges_finds_groups():
+    rng = random.Random(7)
+    spine = Spine([jnp.int64], [jnp.int32])
+    want = {}
+    for _ in range(6):
+        rows = random_rows(rng, 25, key_range=8)
+        spine.insert(Batch.from_tuples(rows, [jnp.int64], [jnp.int32]))
+        oracle_add(want, rows)
+    queries = jnp.asarray([0, 3, 7, 99], jnp.int64)
+    got = {}
+    for b, lo, hi in spine.probe_ranges((queries,)):
+        bk = np.asarray(b.keys[0])
+        bv = np.asarray(b.vals[0])
+        bw = np.asarray(b.weights)
+        for qi, q in enumerate([0, 3, 7, 99]):
+            for j in range(int(lo[qi]), int(hi[qi])):
+                assert bk[j] == q
+                got[(q, int(bv[j]))] = got.get((q, int(bv[j])), 0) + int(bw[j])
+    got = {k: w for k, w in got.items() if w != 0}
+    want_q = {k: w for k, w in want.items() if k[0] in (0, 3, 7, 99)}
+    assert got == want_q
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("seed", range(2))
+def test_lex_probe_matches_numpy(side, seed):
+    rng = np.random.RandomState(seed)
+    table = np.sort(rng.randint(0, 50, size=41).astype(np.int64))
+    query = rng.randint(-5, 55, size=23).astype(np.int64)
+    got = kernels.lex_probe((jnp.asarray(table),), (jnp.asarray(query),),
+                            side=side)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.searchsorted(table, query, side=side))
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_lex_probe_two_cols(side):
+    import bisect
+    rows = sorted([(1, 2), (1, 5), (2, 1), (2, 1), (2, 9), (5, 0), (7, 3)])
+    queries = [(0, 0), (1, 5), (2, 1), (2, 2), (5, 0), (9, 9), (2, 0)]
+    t0 = jnp.asarray([r[0] for r in rows], jnp.int64)
+    t1 = jnp.asarray([r[1] for r in rows], jnp.int64)
+    q0 = jnp.asarray([q[0] for q in queries], jnp.int64)
+    q1 = jnp.asarray([q[1] for q in queries], jnp.int64)
+    got = kernels.lex_probe((t0, t1), (q0, q1), side=side)
+    fn = bisect.bisect_left if side == "left" else bisect.bisect_right
+    np.testing.assert_array_equal(np.asarray(got),
+                                  [fn(rows, q) for q in queries])
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8, 16])
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_lex_probe_power_of_two_tables(n, side):
+    # regression: bucketed (power-of-two) capacities are the common case and
+    # need ceil(log2(n+1)) binary-search steps, not log2(n)
+    rng = np.random.RandomState(n)
+    table = np.sort(rng.randint(0, 30, size=n).astype(np.int64))
+    query = np.arange(-1, 31).astype(np.int64)
+    got = kernels.lex_probe((jnp.asarray(table),), (jnp.asarray(query),), side=side)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.searchsorted(table, query, side=side))
+
+
+def test_lex_probe_nan_ranks_greatest():
+    table = jnp.asarray([1.0, 2.0, 5.0, float("nan")], jnp.float32)
+    q = jnp.asarray([float("nan")], jnp.float32)
+    assert int(kernels.lex_probe((table,), (q,), side="left")[0]) == 3
+    assert int(kernels.lex_probe((table,), (q,), side="right")[0]) == 4
+    assert int(kernels.lex_searchsorted((table,), (q,), side="left")[0]) == 3
+
+
+def test_add_keeps_capacity_bucketed():
+    z = Batch.from_tuples([((1,), 1)], [jnp.int64], [])
+    a = Batch.from_tuples([((1,), 0), ((2,), 1), ((2,), -1)], [jnp.int64], [])
+    for _ in range(6):
+        z = z.add(a)
+        assert z.cap == 8  # 1 live row stays in the smallest bucket
+    assert z.to_dict() == {(1,): 1}

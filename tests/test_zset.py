@@ -42,7 +42,7 @@ def oracle(rows):
     return d
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(3))
 @pytest.mark.parametrize("n", [0, 1, 7, 64])
 def test_from_tuples_consolidates(seed, n):
     rng = random.Random(seed)
@@ -71,7 +71,7 @@ def test_consolidated_invariants():
     assert int(b.live_count()) == n_live
 
 
-@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("seed", range(2))
 def test_add_neg(seed):
     rng = random.Random(seed)
     ra, rb = random_rows(rng, 40), random_rows(rng, 30)
@@ -106,7 +106,7 @@ def test_with_cap_grow_shrink():
 
 
 @pytest.mark.parametrize("side", ["left", "right"])
-@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("seed", range(2))
 def test_lex_searchsorted_matches_numpy_single_col(side, seed):
     rng = np.random.RandomState(seed)
     table = np.sort(rng.randint(0, 20, size=30).astype(np.int64))
