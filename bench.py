@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Nexmark benchmark harness.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...detail}.
+
+Protocol (BASELINE.md): the reference measures elapsed wall-clock ->
+events/sec on Nexmark; its CI config streams 100M events at a 10M/s
+first-event rate. This harness streams generated events through the headline
+incremental query (q4: join + per-auction max + per-category average) in
+large per-tick batches, after a warmup phase that lets capacity buckets and
+XLA compilation stabilize, and reports steady-state events/sec plus p50/p99
+per-step latency (the latency metric BASELINE.md notes the reference lacks).
+
+vs_baseline is events/sec divided by the reference protocol's 10M events/s
+offered rate (the closest in-tree number; BASELINE.json publishes no absolute
+reference results).
+
+Env knobs: BENCH_EVENTS (total, default 2_000_000), BENCH_BATCH (events/tick,
+default 100_000), BENCH_QUERY (default q4), BENCH_WARM_TICKS (default 4).
+"""
+
+import json
+import os
+import sys
+import time
+
+# Persistent compile cache: TPU compiles are tens of seconds; cache them
+# across bench invocations.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_bench_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+
+def main():
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        # virtual-CPU-mesh convention (see __graft_entry__): run on host CPU
+        # even if a TPU plugin site hook force-set the platform
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    total = int(os.environ.get("BENCH_EVENTS", 2_000_000))
+    batch = int(os.environ.get("BENCH_BATCH", 100_000))
+    qname = os.environ.get("BENCH_QUERY", "q4")
+    warm_ticks = int(os.environ.get("BENCH_WARM_TICKS", 4))
+    query = getattr(queries, qname)
+
+    platform = jax.devices()[0].platform
+    gen = NexmarkGenerator(GeneratorConfig(seed=1))
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, query(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+
+    # Warmup: compile shapes along the trace-growth curve.
+    n = 0
+    for _ in range(warm_ticks):
+        gen.feed(handles, n, n + batch)
+        handle.step()
+        out.take()
+        n += batch
+    handle.step_times_ns.clear()
+
+    # Measured run.
+    t0 = time.perf_counter()
+    measured = 0
+    while measured < total:
+        gen.feed(handles, n, n + batch)
+        handle.step()
+        out.take()
+        n += batch
+        measured += batch
+    elapsed = time.perf_counter() - t0
+
+    eps = measured / elapsed
+    lat = sorted(handle.step_times_ns)
+    p50 = lat[len(lat) // 2] / 1e6
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] / 1e6
+    print(json.dumps({
+        "metric": f"nexmark_{qname}_throughput",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(eps / 10_000_000, 4),
+        "detail": {
+            "platform": platform,
+            "events": measured,
+            "elapsed_s": round(elapsed, 3),
+            "batch_per_tick": batch,
+            "p50_step_ms": round(p50, 2),
+            "p99_step_ms": round(p99, 2),
+            "ticks": len(lat),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

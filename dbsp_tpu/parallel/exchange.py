@@ -1,0 +1,162 @@
+"""Shard / exchange / gather: worker parallelism as XLA collectives.
+
+Reference components replaced (SURVEY.md §2.7, §5):
+  * ``shard()`` — key-hash repartition across workers
+    (``operator/communication/shard.rs:89``);
+  * ``Exchange`` — the N²-mailbox shared-memory fabric with atomic
+    ready-counters (``operator/communication/exchange.rs:45``);
+  * ``gather()`` — all-to-one collection (``communication/gather.rs:41``).
+
+TPU-native design: a sharded Z-set is a :class:`Batch` whose arrays carry a
+leading ``[W, cap_local]`` worker axis laid out over the 1-D device mesh
+(parallel/mesh.py). ``exchange`` runs INSIDE the jitted SPMD step as a bucket
++ ``lax.all_to_all`` over ICI — the reference's mailbox handshakes, ready
+callbacks, and sender/receiver operator split all disappear because the
+compiler schedules communication/compute overlap, and its per-step barrier
+semantics (shard.rs:80-88) are exactly SPMD program semantics.
+
+Routing invariant: rows are routed by a hash of the FIRST key column, so all
+rows sharing a full key land on one worker (equal full keys share the first
+column) — the same contract the reference's shard() gives join/aggregate/
+distinct. Dead rows route nowhere (weight 0, dropped scatter).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dbsp_tpu.parallel.mesh import WORKER_AXIS, worker_sharding
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch
+
+
+def _hash_key(col: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64-style mix of the first key column (any int dtype)."""
+    z = col.astype(jnp.uint64) * jnp.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = z ^ (z >> jnp.uint64(27))
+    return z
+
+
+def worker_of(col: jnp.ndarray, nworkers: int) -> jnp.ndarray:
+    return (_hash_key(col) % jnp.uint64(nworkers)).astype(jnp.int32)
+
+
+def _bucketize(batch: Batch, nworkers: int) -> Batch:
+    """Scatter local rows into [W, cap] bins by key hash (dead rows dropped).
+
+    Rows keep their relative order within a bin; bins are zero-padded with
+    sentinel keys so each bin is a valid (unconsolidated) batch slice.
+    """
+    cap = batch.cap
+    dest = jnp.where(batch.weights != 0,
+                     worker_of(batch.keys[0], nworkers),
+                     jnp.int32(nworkers))  # out-of-range -> dropped scatter
+    onehot = dest[None, :] == jnp.arange(nworkers, dtype=jnp.int32)[:, None]
+    rank_by_worker = jnp.cumsum(onehot, axis=1) - 1        # [W, cap]
+    rank = jnp.take_along_axis(
+        rank_by_worker, jnp.clip(dest, 0, nworkers - 1)[None, :], axis=0)[0]
+
+    def scatter(col, fill):
+        out = jnp.full((nworkers, cap), fill, col.dtype)
+        return out.at[dest, rank].set(col, mode="drop")
+
+    keys = tuple(scatter(c, kernels.sentinel_for(c.dtype)) for c in batch.keys)
+    vals = tuple(scatter(c, kernels.sentinel_for(c.dtype)) for c in batch.vals)
+    w = scatter(batch.weights, jnp.zeros((), batch.weights.dtype))
+    return Batch(keys, vals, w)
+
+
+# ---------------------------------------------------------------------------
+# In-SPMD-context primitives (call inside shard_map; axis name = "workers")
+# ---------------------------------------------------------------------------
+
+
+def exchange_local(batch: Batch, nworkers: int) -> Batch:
+    """Repartition the local batch by key hash; per-worker view.
+
+    Local [cap] rows are bucketed into ``nworkers`` bins of the full local
+    capacity (worst-case skew = all rows to one peer), all_to_all'd over ICI,
+    and consolidated. Output capacity is ``nworkers * cap``; callers
+    re-bucket outside the jit boundary when they care (spine insert does).
+    """
+    binned = _bucketize(batch, nworkers)
+
+    def a2a(x):
+        return lax.all_to_all(x, WORKER_AXIS, split_axis=0, concat_axis=0,
+                              tiled=True).reshape(nworkers * batch.cap)
+
+    cols, w = kernels.consolidate_cols(
+        tuple(a2a(c) for c in binned.cols), a2a(binned.weights))
+    nk = len(batch.keys)
+    return Batch(cols[:nk], cols[nk:], w)
+
+
+def gather_local(batch: Batch) -> Batch:
+    """All-gather + consolidate: every worker ends with the full union
+    (the reference's gather targets one worker; replication is the SPMD
+    equivalent and what output handles consume). The peer group is the
+    mesh axis itself — no worker count to pass (or get wrong)."""
+    def ag(x):
+        return lax.all_gather(x, WORKER_AXIS, tiled=True)
+
+    cols = tuple(ag(c) for c in batch.cols)
+    w = ag(batch.weights)
+    cols, w = kernels.consolidate_cols(cols, w)
+    nk = len(batch.keys)
+    return Batch(cols[:nk], cols[nk:], w)
+
+
+# ---------------------------------------------------------------------------
+# Host-level helpers (outside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def spmd(mesh: Mesh, fn):
+    """Lift a per-worker function over 1-D batches to [W, ...] sharded
+    batches via shard_map (leading worker axis squeezed inside)."""
+    from jax import shard_map
+
+    def lifted(*args):
+        def body(*local):
+            sq = jax.tree.map(lambda a: a[0], local)
+            out = fn(*sq)
+            return jax.tree.map(lambda a: a[None], out)
+
+        return shard_map(body, mesh=mesh, in_specs=P(WORKER_AXIS),
+                         out_specs=P(WORKER_AXIS))(*args)
+
+    return lifted
+
+
+@partial(jax.jit, static_argnames=("nworkers",))
+def _shard_kernel(batch: Batch, nworkers: int) -> Batch:
+    return _bucketize(batch, nworkers)
+
+
+@lru_cache(maxsize=None)
+def _sharded_consolidate(mesh: Mesh):
+    return jax.jit(spmd(mesh, lambda b: b.consolidate()))
+
+
+def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
+    """Distribute a 1-D batch into the [W, cap_local] sharded layout by key
+    hash (the input-handle -> sharded-circuit boundary), consolidated
+    per-worker."""
+    nworkers = mesh.devices.size
+    binned = _shard_kernel(batch, nworkers)
+    binned = jax.device_put(binned, worker_sharding(mesh))
+    return _sharded_consolidate(mesh)(binned)
+
+
+def unshard_batch(sharded: Batch) -> Batch:
+    """Collapse a [W, cap_local] sharded batch to one consolidated 1-D batch
+    on the host driver (output-handle boundary)."""
+    flat = jax.tree.map(lambda a: a.reshape(-1), sharded)
+    return flat.consolidate()
