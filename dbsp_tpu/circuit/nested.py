@@ -1,0 +1,123 @@
+"""Nested circuits: subcircuits, cross-clock imports, iterative execution.
+
+Reference: ``circuit_builder.rs:2287`` (``subcircuit``), ``:2307`` (``iterate``),
+``:2332`` (``fixedpoint``), ``operator/delta0.rs`` (cross-clock import),
+``schedule/mod.rs:100-139`` (``IterativeExecutor``) and the fixedpoint
+contract (``operator_traits.rs:148-196``).
+
+Scope note (deliberate round-1 simplification): the reference's nested
+circuits are *incremental across parent ticks* via nested timestamps
+(``time/nested_ts32.rs``) — child state persists and per-parent-tick work is
+proportional to the parent delta. Here child state RESETS each parent tick
+(``clock_start``), so recursion is re-evaluated per parent tick, incremental
+only within the iteration (semi-naive). The exported results are identical;
+the cross-epoch incrementality is an optimization planned for the nested-
+timestamp round. Each child evaluation is still pure device work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from dbsp_tpu.circuit.builder import Circuit, CircuitEvent, Stream
+from dbsp_tpu.circuit.operator import ImportOperator, Operator
+from dbsp_tpu.zset.batch import Batch
+
+
+class Delta0(ImportOperator):
+    """Emits the parent value on the child's first tick, zero afterwards
+    (operator/delta0.rs)."""
+
+    name = "delta0"
+
+    def __init__(self, zero_factory: Callable[[], Any]):
+        self.zero_factory = zero_factory
+        self.value: Any = None
+        self.first = True
+
+    def import_value(self, value: Any) -> None:
+        self.value = value
+        self.first = True
+
+    def eval(self) -> Any:
+        if self.first:
+            self.first = False
+            return self.value
+        return self.zero_factory()
+
+
+class SubcircuitOp(Operator):
+    """Parent-side node owning a child circuit; value = tuple of exports."""
+
+    name = "subcircuit"
+
+    def __init__(self, child: "ChildCircuit"):
+        self.child = child
+
+
+class ChildCircuit(Circuit):
+    """A circuit one clock level below its parent.
+
+    Construction: ``parent.subcircuit(constructor)`` — the constructor adds
+    child operators and declares imports (``child.import_stream``), exports
+    (``child.export``) and termination conditions (``child.add_condition``).
+    """
+
+    def __init__(self, parent: Circuit, iterative: bool):
+        super().__init__(parent=parent, iterative=iterative)
+        self.imports: List[Tuple[int, Delta0]] = []   # (parent node, import op)
+        self.exports: List[int] = []                   # child node indices
+        self.conditions: List[int] = []                # child node indices
+        self.max_iterations = 10_000
+
+    def import_stream(self, parent_stream: Stream,
+                      zero_factory: Optional[Callable[[], Any]] = None
+                      ) -> Stream:
+        """delta0 import of a parent stream into this clock domain."""
+        assert parent_stream.circuit is self.parent, \
+            "import_stream takes a stream of the immediate parent"
+        if zero_factory is None:
+            schema = getattr(parent_stream, "schema", None)
+            assert schema is not None, \
+                "import_stream needs schema metadata or zero_factory"
+            zero_factory = lambda: Batch.empty(*schema)  # noqa: E731
+        op = Delta0(zero_factory)
+        node = self._add_node(op, "import", [])
+        self.imports.append((parent_stream.node_index, op))
+        s = Stream(self, node.index)
+        s.schema = getattr(parent_stream, "schema", None)
+        return s
+
+    def export(self, child_stream: Stream) -> int:
+        """Mark a child stream for export; returns its export slot index.
+
+        The exported value is the stream's value on the FINAL child tick
+        (reference: ``subcircuit``'s export streams)."""
+        assert child_stream.circuit is self
+        self.exports.append(child_stream.node_index)
+        return len(self.exports) - 1
+
+    def add_condition(self, child_stream: Stream) -> None:
+        """Register a termination condition: a stream of Z-set batches; the
+        iteration stops when ALL condition batches are empty on the same tick
+        (reference: ``operator/condition.rs``)."""
+        assert child_stream.circuit is self
+        self.conditions.append(child_stream.node_index)
+
+
+def subcircuit(parent: Circuit, constructor: Callable[[ChildCircuit], Any],
+               iterative: bool = True) -> Tuple[Stream, Any]:
+    """Build a nested circuit; returns (exports stream, constructor result).
+
+    The exports stream carries a tuple of the child's exported values, one
+    entry per ``child.export`` call, produced after the child clock reaches
+    its fixedpoint each parent tick."""
+    child = ChildCircuit(parent, iterative)
+    result = constructor(child)
+    node = parent._add_node(
+        SubcircuitOp(child), "subcircuit",
+        [pidx for (pidx, _) in child.imports], child=child)
+    child._index_in_parent = node.index
+    parent._emit_circuit_event(CircuitEvent(
+        kind="subcircuit", node_id=parent.global_id(node.index)))
+    return Stream(parent, node.index), result

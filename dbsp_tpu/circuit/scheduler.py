@@ -82,9 +82,8 @@ def _eval_node(circuit: Circuit, node: Node) -> None:
     elif node.kind == "strict_input":
         op.eval_strict(vals[0])
     elif node.kind == "subcircuit":
-        raise NotImplementedError(
-            "nested circuits are evaluated by the IterativeExecutor "
-            "(fixedpoint/recursive support); see operators/recursive.py")
+        circuit._values[node.index] = IterativeExecutor.run_child(
+            node.child, vals, scope=circuit.scope_depth() + 1)
     else:  # pragma: no cover
         raise AssertionError(f"unknown node kind {node.kind}")
     circuit._emit_scheduler_event(SchedulerEvent(
@@ -106,3 +105,45 @@ class OnceExecutor:
         circuit._values.clear()
         circuit._emit_scheduler_event(SchedulerEvent(
             kind="step_end", time_ns=time.perf_counter_ns()))
+
+
+class IterativeExecutor:
+    """Run a child circuit's clock to a fixedpoint once per parent tick
+    (reference: schedule/mod.rs:100-139).
+
+    Termination: every registered condition stream produced an empty batch on
+    the tick (host-checked scalar), matching the reference's Condition
+    operator; operators additionally report ``fixedpoint()`` which guards
+    against dirty traces.
+    """
+
+    @staticmethod
+    def run_child(child, parent_vals, scope: int):
+        # fresh epoch: reset child state (see nested.py scope note)
+        child.clock_start(scope)
+        for (_, op), v in zip(child.imports, parent_vals):
+            op.import_value(v)
+        if child._executor is None:
+            child._executor = OnceExecutor(child)
+
+        exports = None
+        for _ in range(child.max_iterations):
+            # evaluate one child tick, capturing export/condition values
+            child._emit_scheduler_event(SchedulerEvent(kind="step_start"))
+            for node in child._executor.order:
+                _eval_node(child, node)
+            exports = tuple(child._values[i] for i in child.exports)
+            done = all(
+                int(child._values[i].live_count()) == 0
+                for i in child.conditions) if child.conditions else True
+            child._values.clear()
+            child._emit_scheduler_event(SchedulerEvent(kind="step_end"))
+            if done and all(n.operator.fixedpoint(scope)
+                            for n in child.nodes):
+                break
+        else:
+            raise RuntimeError(
+                f"nested circuit did not reach a fixedpoint within "
+                f"{child.max_iterations} iterations")
+        child.clock_end(scope)
+        return exports

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.nexmark import model as M
+from dbsp_tpu.operators.aggregate import Average, Count, Max  # noqa: F401 (queries use all three)
 
 
 def q0(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
@@ -74,6 +75,101 @@ def q3(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
         [jnp.int64], [jnp.int32, jnp.int32, jnp.int32], name="q3-join")
 
 
+Q5_WINDOW_MS = 10_000
+Q5_HOP_MS = 2_000
+
+
+def q5(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Hot items: auctions with the most bids per hopping window
+    (10s window, 2s hop — queries/q5.rs). Hopping windows are expressed
+    TPU-style as a static flat_map: each bid belongs to exactly
+    window/hop = 5 windows, so fan-out is a fixed [5, cap] expansion instead
+    of a data-dependent iterator. Output: (window_start, auction) for
+    auctions whose bid count equals the window maximum."""
+    fanout = Q5_WINDOW_MS // Q5_HOP_MS
+
+    def assign(k, v):
+        ts = v[M.B_DATE]
+        first = (ts // Q5_HOP_MS) * Q5_HOP_MS - (fanout - 1) * Q5_HOP_MS
+        starts = jnp.stack([first + i * Q5_HOP_MS for i in range(fanout)])
+        auction = jnp.broadcast_to(k[0], starts.shape)
+        keep = jnp.ones(starts.shape, bool)
+        return (starts, auction), (), keep
+
+    per_window = bids.flat_map_rows(
+        assign, fanout, (jnp.int64, jnp.int64), (), name="q5-windows")
+    counts = per_window.aggregate(Count(), name="q5-count")
+    # counts: key=(window, auction) val=(n). Max n per window:
+    by_window = counts.index_by(
+        lambda k, v: (k[0],), (jnp.int64,),
+        val_fn=lambda k, v: (k[1], v[0]), val_dtypes=(jnp.int64, jnp.int64),
+        name="q5-by-window")
+    maxes = by_window.aggregate(Max(1), name="q5-max")
+    hot = by_window.join_index(
+        maxes,
+        lambda k, cv, mv: (k, (cv[0], cv[1], mv[0])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q5-join")
+    winners = hot.filter_rows(lambda k, v: v[1] == v[2], name="q5-winners")
+    return winners.map_rows(lambda k, v: ((k[0], v[0]), ()),
+                            (jnp.int64, jnp.int64), (), name="q5-project")
+
+
+Q7_WINDOW_MS = 10_000
+
+
+def q7(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Highest bid of the latest completed tumbling window (queries/q7.rs):
+    a watermark on bid event time drives monotone window bounds; the window
+    operator maintains the bids of the last complete period, and a Max
+    aggregate reduces them. Output: (window_end, max_price)."""
+    wm = bids.watermark_monotonic(lambda k, v: v[M.B_DATE], lateness=0)
+
+    def to_bounds(w):
+        if w is None:
+            return None
+        end = (w // Q7_WINDOW_MS) * Q7_WINDOW_MS
+        return (end - Q7_WINDOW_MS, end)
+
+    bounds = wm.apply(to_bounds, name="q7-bounds")
+    by_time = bids.index_by(
+        lambda k, v: (v[M.B_DATE],), (jnp.int64,),
+        val_fn=lambda k, v: (v[M.B_PRICE],), val_dtypes=(jnp.int64,),
+        name="q7-by-time")
+    windowed = by_time.window(bounds)
+    # all rows of the (single-period) window share a window end — key by it
+    keyed = windowed.map_rows(
+        lambda k, v: (((k[0] // Q7_WINDOW_MS) * Q7_WINDOW_MS + Q7_WINDOW_MS,),
+                      (v[0],)),
+        (jnp.int64,), (jnp.int64,), name="q7-rekey")
+    return keyed.aggregate(Max(0), name="q7-max")
+
+
+Q8_WINDOW_MS = 10_000
+
+
+def q8(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Monitor new users (queries/q8.rs:48-70): persons who created an
+    auction in the same tumbling 10s window they registered in. The
+    reference builds this from watermark_monotonic + window + join; the
+    tumbling-window equality is expressed by making the window start a join
+    key component. Output: (person_id, window_start, name)."""
+    p_keyed = persons.index_by(
+        lambda k, v: (k[0], (v[M.P_DATE] // Q8_WINDOW_MS) * Q8_WINDOW_MS),
+        (jnp.int64, jnp.int64),
+        val_fn=lambda k, v: (v[M.P_NAME],), val_dtypes=(jnp.int32,),
+        name="q8-persons")
+    a_keyed = auctions.index_by(
+        lambda k, v: (v[M.A_SELLER],
+                      (v[M.A_DATE] // Q8_WINDOW_MS) * Q8_WINDOW_MS),
+        (jnp.int64, jnp.int64),
+        val_fn=lambda k, v: (), val_dtypes=(),
+        name="q8-auctions")
+    joined = p_keyed.join_index(
+        a_keyed, lambda k, pv, av: (k, (pv[0],)),
+        (jnp.int64, jnp.int64), (jnp.int32,), name="q8-join")
+    return joined.distinct()
+
+
 def q4(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     """Average final (max) bid price per category over closed auctions
     (queries/q4.rs:43): bids within [auction.date_time, auction.expires]
@@ -93,8 +189,6 @@ def q4(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     in_window = joined.filter_rows(
         lambda k, v: (v[1] >= v[2]) & (v[1] <= v[3]), name="q4-window")
     # max price per (auction, category)
-    from dbsp_tpu.operators.aggregate import Average, Max
-
     per_auction = in_window.map_rows(
         lambda k, v: (k, (v[0],)), (jnp.int64, jnp.int64), (jnp.int64,),
         name="q4-price").aggregate(Max(0), name="q4-max")

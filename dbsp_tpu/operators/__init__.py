@@ -3,7 +3,9 @@
 operator extension traits into scope."""
 
 from dbsp_tpu.operators import (  # noqa: F401  (Stream-method registration)
-    aggregate, basic, distinct, filter_map, io_handles, join, trace_op, z1)
+    aggregate, basic, distinct, filter_map, io_handles, join, recursive,
+    trace_op, z1)
+import dbsp_tpu.timeseries  # noqa: F401, E402  (register window/watermark)
 from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
 from dbsp_tpu.operators.basic import Generator
 from dbsp_tpu.operators.io_handles import InputHandle, OutputHandle, add_input_zset

@@ -242,6 +242,10 @@ class AggregateOp(UnaryOperator):
         self._group_gather = GroupGather()
         self._old_gather = GroupGather()
 
+    def clock_start(self, scope: int) -> None:
+        if scope > 0:  # nested clock: reset per parent tick (nested.py)
+            self.out_spine = Spine(self.key_dtypes, tuple(self.agg.out_dtypes))
+
     def eval(self, view: TraceView) -> Batch:
         delta = view.delta
         nk = len(self.key_dtypes)

@@ -1,0 +1,84 @@
+"""Recursive (fixedpoint) queries — semi-naive iteration in a nested clock.
+
+Reference: ``operator/recursive.rs:255`` with the circuit shape documented at
+recursive.rs:260-276:
+
+        ┌── delta0 (import I) ──┐
+        ▼                       │
+      plus ─► distinct ─► δ ────┴─► z^-1 ─► f ──► (back to plus)
+                           │
+                           └─► integrate ─► export (accumulated relation)
+
+Per child tick i: δ_{i+1} = distinct_new(f(δ_i) + [i==0]·I), where
+``distinct_new`` (the incremental distinct against the child-local trace)
+keeps exactly the rows not yet derived — semi-naive evaluation. The clock
+terminates when δ is empty (the Condition), and the accumulated trace is
+exported to the parent.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from dbsp_tpu.circuit.builder import Circuit, Stream
+from dbsp_tpu.circuit.nested import ChildCircuit, subcircuit
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.operators.z1 import Z1
+from dbsp_tpu.zset.batch import Batch
+
+
+def recursive(parent: Circuit, input_stream: Stream,
+              f: Callable[[ChildCircuit, Stream], Stream]) -> Stream:
+    """Least fixedpoint of R = distinct(f(R) ∪ I), as a parent stream.
+
+    ``f(child, delta_stream) -> stream`` builds the recursive step inside the
+    child circuit (it may use any operators, including joins against other
+    imported streams). The result is the full accumulated relation, exported
+    once the iteration converges — re-derived per parent tick (see
+    circuit/nested.py scope note).
+    """
+    schema = getattr(input_stream, "schema", None)
+    assert schema is not None, "recursive needs schema metadata on the input"
+
+    # Child state resets each parent tick (nested.py scope note), so the
+    # child must see the FULL current relation, not the tick's delta: import
+    # the integral. (The reference instead keeps child state across ticks
+    # via nested timestamps and imports deltas — the future optimization.)
+    # Auxiliary streams used inside ``f`` must likewise be imported
+    # integrated: child.import_stream(aux.integrate()).
+    full_input = input_stream.integrate()
+
+    def ctor(child: ChildCircuit):
+        i0 = child.import_stream(full_input)
+        fb = child.add_feedback(Z1(lambda: Batch.empty(*schema)))
+        fb.stream.schema = schema
+        step = f(child, fb.stream)
+        assert getattr(step, "schema", None) == schema, (
+            f"f must preserve the relation schema {schema}, got "
+            f"{getattr(step, 'schema', None)}")
+        new = step.plus(i0)
+        new.schema = schema
+        delta = new.distinct()      # incremental: only not-yet-seen rows
+        delta.schema = schema
+        fb.connect(delta)
+        child.add_condition(delta)
+        acc = delta.integrate()
+        child.export(acc)
+        return None
+
+    exports, _ = subcircuit(parent, ctor, iterative=True)
+    snapshot = exports.apply(lambda t: t[0], name="export0")
+    snapshot.schema = schema
+    # The child exports the full re-derived relation each parent tick;
+    # differentiate restores the framework-wide delta-stream convention so
+    # stateful consumers (traces, aggregates, joins) see changes, not
+    # snapshots.
+    out = snapshot.differentiate()
+    out.schema = schema
+    return out
+
+
+@stream_method
+def recurse(self: Stream, f) -> Stream:
+    """Sugar: ``edges.recurse(lambda child, R: ...)``."""
+    return recursive(self.circuit, self, f)

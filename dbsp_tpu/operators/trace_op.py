@@ -49,10 +49,18 @@ class TraceOp(UnaryOperator):
     name = "trace"
 
     def __init__(self, key_dtypes, val_dtypes):
+        self.key_dtypes = key_dtypes
+        self.val_dtypes = val_dtypes
         self.spine = Spine(key_dtypes, val_dtypes)
+
+    def clock_start(self, scope: int) -> None:
+        if scope > 0:
+            # nested clock: child state resets each parent tick (nested.py)
+            self.spine = Spine(self.key_dtypes, self.val_dtypes)
 
     def eval(self, delta: Batch) -> TraceView:
         pre = list(self.spine.batches)
+        self.spine.clear_dirty()  # dirty == "this tick's delta was nonempty"
         self.spine.insert(delta)
         return TraceView(self.spine, delta, pre)
 

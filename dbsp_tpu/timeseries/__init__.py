@@ -1,0 +1,5 @@
+from dbsp_tpu.timeseries import watermark, window  # noqa: F401  (register)
+from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
+from dbsp_tpu.timeseries.window import WindowOp
+
+__all__ = ["WatermarkMonotonic", "WindowOp"]

@@ -1,0 +1,61 @@
+"""Watermarks: lateness bounds over event-time columns.
+
+Reference: ``operator/time_series/watermark.rs:33`` (``watermark_monotonic``):
+given a monotone timestamp extraction, the watermark at tick t is
+``max(event_time seen so far) - lateness`` — a host scalar stream used to
+drive window bounds and trace GC.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.zset.batch import Batch
+
+
+@jax.jit
+def _max_live(col: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    lo = jnp.iinfo(col.dtype).min if jnp.issubdtype(col.dtype, jnp.integer) \
+        else -jnp.inf
+    return jnp.max(jnp.where(weights != 0, col, lo))
+
+
+class WatermarkMonotonic(UnaryOperator):
+    """Emits the running max of a timestamp column minus lateness.
+
+    The reference requires the extracted timestamp to be monotone over
+    *inserted* rows; we take the running max so late (but allowed) rows and
+    retractions are tolerated — the watermark never regresses either way.
+    """
+
+    name = "watermark"
+
+    def __init__(self, ts_fn: Callable[[Tuple, Tuple], jnp.ndarray],
+                 lateness: int):
+        self.ts_fn = ts_fn
+        self.lateness = lateness
+        self._wm = None
+
+    def clock_start(self, scope: int) -> None:
+        self._wm = None
+
+    def eval(self, batch: Batch) -> int:
+        if int(batch.live_count()) > 0:
+            m = int(_max_live(self.ts_fn(batch.keys, batch.vals),
+                              batch.weights))
+            cand = m - self.lateness
+            self._wm = cand if self._wm is None else max(self._wm, cand)
+        return self._wm  # None until the first event arrives
+
+
+@stream_method
+def watermark_monotonic(self: Stream, ts_fn, lateness: int = 0) -> Stream:
+    """Host-scalar stream of the current watermark (or None pre-first-event)."""
+    return self.circuit.add_unary_operator(
+        WatermarkMonotonic(ts_fn, lateness), self)

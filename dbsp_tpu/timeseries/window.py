@@ -1,0 +1,135 @@
+"""The window operator: retain rows whose (leading) key lies in moving bounds.
+
+Reference: ``operator/time_series/window.rs:75-130`` — a ternary operator over
+(bounds, delta, trace) emitting the Z-set delta of the window contents as the
+bounds move monotonically.
+
+Per tick, with previous bounds [a0, b0) and new bounds [a1, b1) (monotone:
+a1 >= a0, b1 >= b0):
+
+    out = Δin ∩ [a1, b1)                      (new rows inside the window)
+        - trace_pre ∩ [a0, min(a1, b0))       (rows that slid out)
+        + trace_pre ∩ [max(b0, a1), b1)       (rows that slid in)
+
+Range extraction is a scalar searchsorted pair + masked slice per spine level
+(grow-on-demand capacity) — O(log n + |range delta|), the same cost class the
+reference gets from its trace cursors.
+
+When ``gc=True`` the operator also truncates the shared trace below the new
+lower bound (the reference's TraceBound lower-bound GC, operator/trace.rs:29),
+which keeps state proportional to the window span.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import BinaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.operators.trace_op import TraceView
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _slice_range(level: Batch, a, b, out_cap: int):
+    """Rows of a consolidated level with first-key in [a, b); masked slice."""
+    k0 = level.keys[0]
+    a = jnp.asarray(a, k0.dtype)
+    b = jnp.asarray(b, k0.dtype)
+    lo = jnp.searchsorted(k0, a, side="left")
+    hi = jnp.searchsorted(k0, b, side="left")
+    total = hi - lo
+    j = jnp.arange(out_cap)
+    idx = jnp.clip(lo + j, 0, level.cap - 1)
+    valid = j < total
+    cols = tuple(
+        jnp.where(valid, c[idx], kernels.sentinel_for(c.dtype))
+        for c in level.cols)
+    w = jnp.where(valid, level.weights[idx], 0)
+    nk = len(level.keys)
+    return Batch(cols[:nk], cols[nk:], w), total
+
+
+@jax.jit
+def _filter_window(batch: Batch, a, b) -> Batch:
+    k0 = batch.keys[0]
+    keep = (batch.weights != 0) & (k0 >= jnp.asarray(a, k0.dtype)) & \
+        (k0 < jnp.asarray(b, k0.dtype))
+    cols, w = kernels.compact(batch.cols, batch.weights, keep)
+    nk = len(batch.keys)
+    return Batch(cols[:nk], cols[nk:], w)
+
+
+class RangeExtract:
+    """Grow-on-demand host driver for [a, b) slices across spine levels."""
+
+    def __init__(self):
+        self.caps: Dict[int, int] = {}
+
+    def __call__(self, levels, a, b) -> List[Batch]:
+        outs = []
+        for level in levels:
+            cap = self.caps.get(level.cap, 64)
+            out, total = _slice_range(level, a, b, cap)
+            t = int(total)
+            if t > cap:
+                cap = bucket_cap(t)
+                self.caps[level.cap] = cap
+                out, _ = _slice_range(level, a, b, cap)
+            outs.append(out)
+        return outs
+
+
+class WindowOp(BinaryOperator):
+    name = "window"
+
+    def __init__(self, schema, gc: bool = False):
+        self.schema = schema
+        self.gc = gc
+        self.prev: Optional[Tuple[int, int]] = None
+        self._extract = RangeExtract()
+
+    def clock_start(self, scope: int) -> None:
+        self.prev = None
+
+    def eval(self, view: TraceView, bounds) -> Batch:
+        if bounds is None:
+            return Batch.empty(*self.schema)
+        a1, b1 = bounds
+        a0, b0 = self.prev if self.prev is not None else (a1, a1)
+        assert a1 >= a0 and b1 >= b0, (
+            f"window bounds must be monotone: {(a0, b0)} -> {(a1, b1)}")
+        self.prev = (a1, b1)
+
+        parts = [_filter_window(view.delta, a1, b1)]
+        parts += [b.neg() for b in
+                  self._extract(view.pre_levels, a0, min(a1, b0))]
+        parts += self._extract(view.pre_levels, max(b0, a1), b1)
+        out = parts[0] if len(parts) == 1 else \
+            concat_batches(parts).consolidate().shrink_to_fit()
+        if self.gc:
+            view.spine.truncate_keys_below((a1,))
+        return out
+
+
+@stream_method
+def window(self: Stream, bounds: Stream, gc: bool = False) -> Stream:
+    """Windowed view of this stream: rows whose first key column is inside
+    the (monotone) bounds emitted by ``bounds`` this tick.
+
+    ``gc=True`` reclaims trace state below the lower bound; enable only when
+    this window is the sole consumer of the stream's trace (shared traces use
+    the tightest common bound — reference TraceBounds semantics — which the
+    host driver must coordinate)."""
+    schema = getattr(self, "schema", None)
+    assert schema is not None, "window needs stream schema metadata"
+    t = self.trace()
+    out = self.circuit.add_binary_operator(WindowOp(schema, gc), t, bounds)
+    out.schema = schema
+    return out
