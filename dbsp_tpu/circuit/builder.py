@@ -223,7 +223,10 @@ class Circuit:
         self._executor.run(self)
 
     def clock_start(self, scope: int = 0) -> None:
-        self._emit_scheduler_event(SchedulerEvent(kind="clock_start"))
+        if self.parent is None:
+            # child clocks start once per parent tick — only the root clock
+            # is a monitor-visible event (reference: one clock per scope)
+            self._emit_scheduler_event(SchedulerEvent(kind="clock_start"))
         for n in self.nodes:
             if n.kind != "strict_input":  # one call per operator instance
                 n.operator.clock_start(scope)
@@ -236,7 +239,8 @@ class Circuit:
                 n.operator.clock_end(scope)
             if n.child is not None:
                 n.child.clock_end(scope + 1)
-        self._emit_scheduler_event(SchedulerEvent(kind="clock_end"))
+        if self.parent is None:
+            self._emit_scheduler_event(SchedulerEvent(kind="clock_end"))
 
 
 class RootCircuit(Circuit):

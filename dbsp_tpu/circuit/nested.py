@@ -111,13 +111,21 @@ def subcircuit(parent: Circuit, constructor: Callable[[ChildCircuit], Any],
 
     The exports stream carries a tuple of the child's exported values, one
     entry per ``child.export`` call, produced after the child clock reaches
-    its fixedpoint each parent tick."""
+    its fixedpoint each parent tick.
+
+    The parent node is created BEFORE the constructor runs so child nodes
+    have their global path (monitor/profiler event ids depend on it); import
+    edges are attached — and their edge events emitted — once the
+    constructor has declared them.
+    """
     child = ChildCircuit(parent, iterative)
-    result = constructor(child)
-    node = parent._add_node(
-        SubcircuitOp(child), "subcircuit",
-        [pidx for (pidx, _) in child.imports], child=child)
+    node = parent._add_node(SubcircuitOp(child), "subcircuit", [], child=child)
     child._index_in_parent = node.index
-    parent._emit_circuit_event(CircuitEvent(
-        kind="subcircuit", node_id=parent.global_id(node.index)))
+    result = constructor(child)
+    node.inputs = [pidx for (pidx, _) in child.imports]
+    for pidx in node.inputs:
+        parent._emit_circuit_event(CircuitEvent(
+            kind="edge", from_id=parent.global_id(pidx),
+            to_id=parent.global_id(node.index)))
+    parent._executor = None  # inputs changed; rebuild the schedule
     return Stream(parent, node.index), result

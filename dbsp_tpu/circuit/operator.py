@@ -43,6 +43,15 @@ class Operator:
         """Profiling metadata (sizes, counts) — reference: ``circuit/metadata.rs``."""
         return {}
 
+    # -- checkpoint protocol (no reference analog; SURVEY.md §5 notes the
+    # reference only has RocksDB state *spilling*, not restartability) -----
+    def state_dict(self) -> dict:
+        """Serializable operator state; stateless operators return {}."""
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        assert not state, f"{self.name} got unexpected checkpoint state"
+
 
 class SourceOperator(Operator):
     """Produces one value per tick (reference: ``operator_traits.rs:202``)."""

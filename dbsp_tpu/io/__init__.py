@@ -1,0 +1,14 @@
+from dbsp_tpu.io.catalog import Catalog
+from dbsp_tpu.io.controller import Controller, ControllerConfig
+from dbsp_tpu.io.format import (CsvEncoder, CsvParser, JsonEncoder,
+                                JsonParser)
+from dbsp_tpu.io.server import CircuitServer
+from dbsp_tpu.io.transport import (FileInputTransport, FileOutputTransport,
+                                   KafkaInputTransport, KafkaOutputTransport)
+
+__all__ = [
+    "Catalog", "Controller", "ControllerConfig", "CircuitServer",
+    "CsvParser", "CsvEncoder", "JsonParser", "JsonEncoder",
+    "FileInputTransport", "FileOutputTransport",
+    "KafkaInputTransport", "KafkaOutputTransport",
+]

@@ -1,0 +1,61 @@
+"""Catalog: named, schema'd registry of a circuit's input/output handles.
+
+Reference: ``adapters/src/catalog.rs:15`` plus the serde bridge
+(``DeCollectionHandle``, adapters/src/deinput.rs:128, and ``SerBatch``,
+seroutput.rs:14): the untyped boundary where parsers push rows into typed
+handles and encoders read batches out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dbsp_tpu.operators.io_handles import InputHandle, OutputHandle
+from dbsp_tpu.io.format import WeightedRow
+
+
+@dataclasses.dataclass
+class InputCollection:
+    name: str
+    handle: InputHandle
+    dtypes: Tuple  # (key..., val...) column dtypes, parser order
+
+    def push_rows(self, rows: List[WeightedRow]) -> int:
+        self.handle.extend(rows)
+        return len(rows)
+
+
+@dataclasses.dataclass
+class OutputCollection:
+    name: str
+    handle: OutputHandle
+    dtypes: Tuple
+
+
+class Catalog:
+    def __init__(self):
+        self.inputs: Dict[str, InputCollection] = {}
+        self.outputs: Dict[str, OutputCollection] = {}
+
+    def register_input(self, name: str, handle: InputHandle,
+                       dtypes: Sequence) -> None:
+        assert name not in self.inputs, f"duplicate input {name}"
+        self.inputs[name] = InputCollection(name, handle, tuple(dtypes))
+
+    def register_output(self, name: str, handle: OutputHandle,
+                        dtypes: Sequence) -> None:
+        assert name not in self.outputs, f"duplicate output {name}"
+        self.outputs[name] = OutputCollection(name, handle, tuple(dtypes))
+
+    def input(self, name: str) -> InputCollection:
+        if name not in self.inputs:
+            raise KeyError(
+                f"unknown input collection {name!r}; have {sorted(self.inputs)}")
+        return self.inputs[name]
+
+    def output(self, name: str) -> OutputCollection:
+        if name not in self.outputs:
+            raise KeyError(
+                f"unknown output collection {name!r}; have {sorted(self.outputs)}")
+        return self.outputs[name]

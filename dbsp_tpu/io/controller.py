@@ -1,0 +1,253 @@
+"""Controller: drives a circuit from transport endpoints with backpressure.
+
+Reference: ``adapters/src/controller/mod.rs`` — ``Controller::with_config``
+(:119), the circuit thread ("calls dbsp.step() when input buffered", :1-14),
+the backpressure thread (pauses endpoints over threshold, :11-15),
+``start/pause/stop`` (:196-246) — and the stats module
+(``controller/stats.rs:129``: per-endpoint + global atomic counters).
+
+One difference by design: the reference needs a separate backpressure thread
+because endpoints buffer inside foreign-threaded callbacks; here endpoint
+buffers are checked on the same circuit loop that drains them (pause/resume
+transitions happen at drain points), which keeps the protocol identical
+(pause over threshold, resume at half) with one fewer moving thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dbsp_tpu.circuit.runtime import CircuitHandle
+from dbsp_tpu.io.catalog import Catalog
+from dbsp_tpu.io.format import INPUT_FORMATS, OUTPUT_FORMATS
+from dbsp_tpu.io.transport import InputTransport, OutputTransport
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    """Reference: ``PipelineConfig`` (controller/config.rs:28-131)."""
+
+    min_batch_records: int = 1_000     # step as soon as this many buffered
+    max_buffered_records: int = 100_000  # pause endpoint above this
+    flush_interval_s: float = 0.25     # step at least this often when idle
+
+
+class _InputEndpoint:
+    def __init__(self, name: str, collection, transport: InputTransport,
+                 parser):
+        self.name = name
+        self.collection = collection
+        self.transport = transport
+        self.parser = parser
+        self.lock = threading.Lock()
+        self.rows: List = []
+        self.eoi = False
+        self.paused = False
+        self.error = None
+        self.total_records = 0
+        self.total_bytes = 0
+
+    def on_chunk(self, chunk: bytes) -> None:
+        with self.lock:
+            self.total_bytes += len(chunk)
+            try:
+                self.parser.feed(chunk)
+                self.rows.extend(self.parser.take())
+            except Exception as e:  # bad data must not kill the reader
+                # record, surface via stats, and terminate the endpoint so
+                # eoi_reached() cannot hang on a dead feed
+                self.error = f"{type(e).__name__}: {e}"
+                self.rows.extend(self.parser.take())
+                self.eoi = True
+                self.transport.stop()
+
+    def on_eoi(self) -> None:
+        with self.lock:
+            try:
+                self.parser.eoi()
+                self.rows.extend(self.parser.take())
+            except Exception as e:
+                self.error = f"{type(e).__name__}: {e}"
+            self.eoi = True
+
+    def drain(self) -> List:
+        with self.lock:
+            rows, self.rows = self.rows, []
+            self.total_records += len(rows)
+            return rows
+
+    def buffered(self) -> int:
+        with self.lock:
+            return len(self.rows)
+
+
+class _OutputEndpoint:
+    def __init__(self, name: str, collection, transport: OutputTransport,
+                 encoder):
+        self.name = name
+        self.collection = collection
+        self.transport = transport
+        self.encoder = encoder
+        self.total_records = 0
+        self.total_bytes = 0
+
+
+class Controller:
+    """Owns the circuit thread; endpoints feed it, outputs drain from it."""
+
+    def __init__(self, handle: CircuitHandle, catalog: Catalog,
+                 config: ControllerConfig = ControllerConfig()):
+        self.handle = handle
+        self.catalog = catalog
+        self.config = config
+        self.inputs: Dict[str, _InputEndpoint] = {}
+        self.outputs: Dict[str, _OutputEndpoint] = {}
+        self.state = "initializing"  # reference PipelineState
+        self.steps = 0
+        self._stop = threading.Event()
+        self._running = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step_lock = threading.Lock()
+
+    # -- endpoint wiring ----------------------------------------------------
+    def add_input_endpoint(self, name: str, collection: str,
+                           transport: InputTransport,
+                           fmt: str = "csv") -> None:
+        col = self.catalog.input(collection)
+        parser = INPUT_FORMATS[fmt](col.dtypes)
+        ep = _InputEndpoint(name, col, transport, parser)
+        self.inputs[name] = ep
+        transport.start(ep.on_chunk, ep.on_eoi)
+
+    def add_output_endpoint(self, name: str, collection: str,
+                            transport: OutputTransport,
+                            fmt: str = "csv") -> None:
+        col = self.catalog.output(collection)
+        self.outputs[name] = _OutputEndpoint(name, col, transport,
+                                             OUTPUT_FORMATS[fmt]())
+
+    # push-style input (HTTP endpoints on the server use this)
+    def push(self, collection: str, rows) -> int:
+        col = self.catalog.input(collection)
+        return col.push_rows(rows)
+
+    # -- lifecycle (reference: start/pause/stop, controller/mod.rs:196-246) -
+    def start(self) -> None:
+        self.state = "running"
+        self._running.set()
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._circuit_loop,
+                                            daemon=True, name="circuit")
+            self._thread.start()
+
+    def pause(self) -> None:
+        self.state = "paused"
+        self._running.clear()
+        with self._step_lock:  # quiesce: wait out any in-flight step
+            pass
+
+    def stop(self) -> None:
+        self.state = "shutdown"
+        self._stop.set()
+        self._running.set()  # unblock
+        for ep in self.inputs.values():
+            ep.transport.stop()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def eoi_reached(self) -> bool:
+        """All inputs exhausted AND fully processed.
+
+        Buffers drain at the START of a step, so emptiness alone races with
+        an in-flight step (its results aren't visible yet); taking the step
+        lock serializes against it.
+        """
+        if not all(ep.eoi and ep.buffered() == 0
+                   for ep in self.inputs.values()):
+            return False
+        with self._step_lock:
+            return all(ep.eoi and ep.buffered() == 0
+                       for ep in self.inputs.values())
+
+    # -- the circuit thread ---------------------------------------------------
+    def _circuit_loop(self) -> None:
+        last_flush = time.monotonic()
+        while not self._stop.is_set():
+            if not self._running.wait(timeout=0.1):
+                continue
+            if self._stop.is_set():
+                break
+            stepped = False
+            # the running re-check happens UNDER the step lock: once pause()
+            # holds the lock, no new step can slip in after it returns
+            with self._step_lock:
+                if self._running.is_set():
+                    buffered = sum(ep.buffered()
+                                   for ep in self.inputs.values())
+                    now = time.monotonic()
+                    if buffered >= self.config.min_batch_records or (
+                            buffered > 0 and
+                            now - last_flush >= self.config.flush_interval_s):
+                        self._step_locked()
+                        last_flush = now
+                        stepped = True
+            if not stepped:
+                time.sleep(0.005)
+            self._backpressure()
+
+    def step(self) -> None:
+        """One controller-driven tick: drain buffers -> step -> emit outputs."""
+        with self._step_lock:
+            self._step_locked()
+
+    def _step_locked(self) -> None:
+        for ep in self.inputs.values():
+            rows = ep.drain()
+            if rows:
+                ep.collection.push_rows(rows)
+        self.handle.step()
+        self.steps += 1
+        for out in self.outputs.values():
+            batch = out.collection.handle.take()
+            if batch is not None and int(batch.live_count()) > 0:
+                data = out.encoder.encode(batch)
+                out.transport.write(data)
+                out.transport.flush()
+                out.total_bytes += len(data)
+                out.total_records += len(batch.to_dict())
+
+    def _backpressure(self) -> None:
+        for ep in self.inputs.values():
+            n = ep.buffered()
+            if not ep.paused and n > self.config.max_buffered_records:
+                ep.paused = True
+                ep.transport.pause()
+            elif ep.paused and n < self.config.max_buffered_records // 2:
+                ep.paused = False
+                ep.transport.resume()
+
+    # -- stats (reference: ControllerStatus, controller/stats.rs) -----------
+    def stats(self) -> dict:
+        return {
+            "state": self.state,
+            "steps": self.steps,
+            "inputs": {
+                name: {
+                    "total_records": ep.total_records,
+                    "total_bytes": ep.total_bytes,
+                    "buffered_records": ep.buffered(),
+                    "paused": ep.paused,
+                    "eoi": ep.eoi,
+                    "error": ep.error,
+                } for name, ep in self.inputs.items()
+            },
+            "outputs": {
+                name: {
+                    "total_records": out.total_records,
+                    "total_bytes": out.total_bytes,
+                } for name, out in self.outputs.items()
+            },
+        }

@@ -1,0 +1,145 @@
+"""Data formats: parsers (bytes -> weighted rows) and encoders (batches ->
+bytes).
+
+Reference: ``adapters/src/lib.rs:91-101`` (InputFormat/Parser/OutputFormat/
+Encoder traits) and the CSV implementation (``adapters/src/format/csv.rs``).
+JSON here is newline-delimited with explicit insert/delete envelopes, which
+the reference gained later; CSV rows are inserts with an optional trailing
+weight column.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from dbsp_tpu.zset.batch import Batch, Row
+
+WeightedRow = Tuple[Row, int]
+
+
+class Parser:
+    """Incremental parser: feed chunks, take parsed weighted rows."""
+
+    def feed(self, chunk: bytes) -> None:
+        raise NotImplementedError
+
+    def take(self) -> List[WeightedRow]:
+        raise NotImplementedError
+
+    def eoi(self) -> None:
+        """End of input: flush any buffered partial record."""
+
+
+class _LineParser(Parser):
+    def __init__(self):
+        self._buf = b""
+        self._rows: List[WeightedRow] = []
+
+    def feed(self, chunk: bytes) -> None:
+        self._buf += chunk
+        *lines, self._buf = self._buf.split(b"\n")
+        for line in lines:
+            line = line.strip()
+            if line:
+                self._parse_line(line.decode())
+
+    def eoi(self) -> None:
+        if self._buf.strip():
+            self._parse_line(self._buf.decode())
+            self._buf = b""
+
+    def take(self) -> List[WeightedRow]:
+        rows, self._rows = self._rows, []
+        return rows
+
+    def _parse_line(self, line: str) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _coerce(values: Sequence[str], dtypes) -> Row:
+    out = []
+    for v, d in zip(values, dtypes):
+        out.append(float(v) if np.issubdtype(np.dtype(d), np.floating)
+                   else int(v))
+    return tuple(out)
+
+
+class CsvParser(_LineParser):
+    """One record per line; columns ordered (keys..., vals...[, weight])."""
+
+    def __init__(self, dtypes: Sequence):
+        super().__init__()
+        self.dtypes = tuple(dtypes)
+
+    def _parse_line(self, line: str) -> None:
+        fields = next(csv.reader([line]))
+        n = len(self.dtypes)
+        if len(fields) == n + 1:
+            w = int(fields[n])
+        elif len(fields) == n:
+            w = 1
+        else:
+            raise ValueError(
+                f"CSV record has {len(fields)} fields, schema has {n}")
+        self._rows.append((_coerce(fields[:n], self.dtypes), w))
+
+
+class JsonParser(_LineParser):
+    """NDJSON with envelopes: {"insert": [..cols..]} or {"delete": [...]};
+    a bare array is an insert."""
+
+    def __init__(self, dtypes: Sequence):
+        super().__init__()
+        self.dtypes = tuple(dtypes)
+
+    def _parse_line(self, line: str) -> None:
+        obj = json.loads(line)
+        if isinstance(obj, dict):
+            if "insert" in obj:
+                row, w = obj["insert"], 1
+            elif "delete" in obj:
+                row, w = obj["delete"], -1
+            else:
+                raise ValueError(f"JSON record needs insert/delete: {line}")
+        else:
+            row, w = obj, 1
+        if len(row) != len(self.dtypes):
+            raise ValueError(
+                f"JSON record has {len(row)} fields, schema has "
+                f"{len(self.dtypes)}")
+        # coerce to schema dtypes NOW so type errors surface at the parse
+        # boundary (HTTP 400 / endpoint error), not inside the circuit thread
+        self._rows.append((_coerce(row, self.dtypes), w))
+
+
+class Encoder:
+    def encode(self, batch: Batch) -> bytes:
+        raise NotImplementedError
+
+
+class CsvEncoder(Encoder):
+    def encode(self, batch: Batch) -> bytes:
+        out = io.StringIO()
+        writer = csv.writer(out, lineterminator="\n")
+        for row, w in sorted(batch.to_dict().items()):
+            writer.writerow([*row, w])
+        return out.getvalue().encode()
+
+
+class JsonEncoder(Encoder):
+    def encode(self, batch: Batch) -> bytes:
+        lines = []
+        for row, w in sorted(batch.to_dict().items()):
+            env = "insert" if w > 0 else "delete"
+            for _ in range(abs(w)):
+                lines.append(json.dumps({env: list(row)}))
+        return ("\n".join(lines) + "\n").encode() if lines else b""
+
+
+INPUT_FORMATS = {"csv": CsvParser, "json": JsonParser}
+OUTPUT_FORMATS = {"csv": CsvEncoder, "json": JsonEncoder}

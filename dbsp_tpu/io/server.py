@@ -1,0 +1,143 @@
+"""Per-pipeline HTTP server: control, stats, metrics, data endpoints.
+
+Reference: ``adapters/src/server/mod.rs:250-378`` — the actix service every
+compiled pipeline embeds: /start /pause /shutdown /status /stats /metrics
+/dump_profile plus push/pull data endpoints /input_endpoint/{name} and
+/output_endpoint/{name} — and the Prometheus export
+(``server/prometheus.rs``). stdlib ThreadingHTTPServer; no web framework.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from dbsp_tpu.io.controller import Controller
+from dbsp_tpu.io.format import INPUT_FORMATS, OUTPUT_FORMATS
+
+
+class CircuitServer:
+    def __init__(self, controller: Controller, host: str = "127.0.0.1",
+                 port: int = 0, profiler=None):
+        self.controller = controller
+        self.profiler = profiler
+        self._outputs: Dict[str, list] = {}
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code=200):
+                self._reply(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                route = url.path.rstrip("/")
+                c = server.controller
+                if route == "/status":
+                    self._json({"state": c.state})
+                elif route == "/stats":
+                    self._json(c.stats())
+                elif route == "/metrics":
+                    self._reply(200, server.prometheus().encode(),
+                                "text/plain; version=0.0.4")
+                elif route == "/dump_profile":
+                    if server.profiler is None:
+                        self._json({"error": "profiler not enabled"}, 400)
+                    else:
+                        self._reply(200, server.profiler.dump_json().encode())
+                elif route.startswith("/output_endpoint/"):
+                    name = route.rsplit("/", 1)[1]
+                    try:
+                        col = c.catalog.output(name)
+                    except KeyError as e:
+                        return self._json({"error": str(e)}, 404)
+                    fmt = parse_qs(url.query).get("format", ["json"])[0]
+                    batch = col.handle.peek()
+                    if batch is None:
+                        self._reply(200, b"")
+                    else:
+                        self._reply(200, OUTPUT_FORMATS[fmt]().encode(batch),
+                                    "text/plain")
+                else:
+                    self._json({"error": f"no route {route}"}, 404)
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                route = url.path.rstrip("/")
+                c = server.controller
+                if route == "/start":
+                    c.start()
+                    self._json({"state": c.state})
+                elif route == "/pause":
+                    c.pause()
+                    self._json({"state": c.state})
+                elif route == "/shutdown":
+                    threading.Thread(target=c.stop, daemon=True).start()
+                    self._json({"state": "shutdown"})
+                elif route == "/step":
+                    c.step()
+                    self._json({"steps": c.steps})
+                elif route.startswith("/input_endpoint/"):
+                    name = route.rsplit("/", 1)[1]
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    fmt = parse_qs(url.query).get("format", ["json"])[0]
+                    try:
+                        col = c.catalog.input(name)
+                    except KeyError as e:
+                        return self._json({"error": str(e)}, 404)
+                    parser = INPUT_FORMATS[fmt](col.dtypes)
+                    try:
+                        parser.feed(body)
+                        parser.eoi()
+                        rows = parser.take()
+                    except (ValueError, KeyError) as e:
+                        return self._json({"error": f"parse error: {e}"}, 400)
+                    col.push_rows(rows)
+                    self._json({"records": len(rows)})
+                else:
+                    self._json({"error": f"no route {route}"}, 404)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def prometheus(self) -> str:
+        s = self.controller.stats()
+        lines = [
+            "# TYPE dbsp_steps counter",
+            f"dbsp_steps {s['steps']}",
+        ]
+        for name, ep in s["inputs"].items():
+            lines.append(
+                f'dbsp_input_records{{endpoint="{name}"}} '
+                f'{ep["total_records"]}')
+            lines.append(
+                f'dbsp_input_buffered{{endpoint="{name}"}} '
+                f'{ep["buffered_records"]}')
+        for name, out in s["outputs"].items():
+            lines.append(
+                f'dbsp_output_records{{endpoint="{name}"}} '
+                f'{out["total_records"]}')
+        return "\n".join(lines) + "\n"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="circuit-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()

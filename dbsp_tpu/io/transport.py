@@ -1,0 +1,224 @@
+"""Transports: byte sources/sinks feeding parsers/encoders.
+
+Reference: ``adapters/src/lib.rs:74-90`` (factory traits) and the file /
+Kafka / HTTP implementations under ``adapters/src/transport/``.
+
+Kafka is gated on an installed client library (``confluent_kafka`` or
+``kafka-python``) — the environment bakes neither, so construction raises a
+clear error instead of import-failing the package; the wiring (poll thread ->
+parser callback, producer flush) is complete and activates when a client is
+present. HTTP input/output endpoints live on the circuit server
+(``io/server.py``), matching the reference's embedded HTTP transport.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+ChunkCallback = Callable[[bytes], None]
+
+
+class InputTransport:
+    name = "input"
+
+    def start(self, on_chunk: ChunkCallback, on_eoi: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def pause(self) -> None:
+        """Backpressure hook: stop producing chunks until resume()."""
+
+    def resume(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class OutputTransport:
+    name = "output"
+
+    def write(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+class FileInputTransport(InputTransport):
+    """Streams a file in chunks on a reader thread; optional tail-follow."""
+
+    name = "file_input"
+
+    def __init__(self, path: str, chunk_size: int = 1 << 16,
+                 follow: bool = False):
+        self.path = path
+        self.chunk_size = chunk_size
+        self.follow = follow
+        self._paused = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, on_chunk, on_eoi) -> None:
+        def run():
+            with open(self.path, "rb") as f:
+                while not self._stop.is_set():
+                    while self._paused.is_set() and not self._stop.is_set():
+                        time.sleep(0.01)
+                    chunk = f.read(self.chunk_size)
+                    if chunk:
+                        on_chunk(chunk)
+                    elif self.follow:
+                        time.sleep(0.05)
+                    else:
+                        break
+            on_eoi()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"file-input-{self.path}")
+        self._thread.start()
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout=None) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+
+class FileOutputTransport(OutputTransport):
+    name = "file_output"
+
+    def __init__(self, path: str):
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            self._f.write(data)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._f.flush()
+
+
+def _kafka_client():
+    try:
+        import confluent_kafka  # type: ignore
+
+        return ("confluent", confluent_kafka)
+    except ImportError:
+        pass
+    try:
+        import kafka  # type: ignore
+
+        return ("kafka-python", kafka)
+    except ImportError:
+        return None
+
+
+class KafkaInputTransport(InputTransport):
+    """Consumes topics and feeds message payloads to the parser (reference:
+    adapters/src/transport/kafka/input.rs). Requires a Kafka client lib."""
+
+    name = "kafka_input"
+
+    def __init__(self, brokers: str, topics, group_id: str = "dbsp_tpu",
+                 poll_timeout: float = 0.5):
+        client = _kafka_client()
+        if client is None:
+            raise RuntimeError(
+                "Kafka transport needs confluent_kafka or kafka-python "
+                "installed; neither is available in this environment")
+        self._kind, self._mod = client
+        self.brokers = brokers
+        self.topics = list(topics)
+        self.group_id = group_id
+        self.poll_timeout = poll_timeout
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+
+    def start(self, on_chunk, on_eoi) -> None:
+        if self._kind == "confluent":
+            consumer = self._mod.Consumer({
+                "bootstrap.servers": self.brokers,
+                "group.id": self.group_id,
+                "auto.offset.reset": "earliest",
+            })
+            consumer.subscribe(self.topics)
+
+            def run():
+                while not self._stop.is_set():
+                    if self._paused.is_set():
+                        time.sleep(0.05)
+                        continue
+                    msg = consumer.poll(self.poll_timeout)
+                    if msg is not None and msg.error() is None:
+                        on_chunk(msg.value() + b"\n")
+                consumer.close()
+                on_eoi()
+        else:
+            consumer = self._mod.KafkaConsumer(
+                *self.topics, bootstrap_servers=self.brokers,
+                group_id=self.group_id, auto_offset_reset="earliest")
+
+            def run():
+                while not self._stop.is_set():
+                    if self._paused.is_set():
+                        time.sleep(0.05)
+                        continue
+                    polled = consumer.poll(timeout_ms=int(self.poll_timeout * 1000))
+                    for records in polled.values():
+                        for r in records:
+                            on_chunk(r.value + b"\n")
+                consumer.close()
+                on_eoi()
+
+        threading.Thread(target=run, daemon=True, name="kafka-input").start()
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class KafkaOutputTransport(OutputTransport):
+    name = "kafka_output"
+
+    def __init__(self, brokers: str, topic: str):
+        client = _kafka_client()
+        if client is None:
+            raise RuntimeError(
+                "Kafka transport needs confluent_kafka or kafka-python "
+                "installed; neither is available in this environment")
+        self._kind, self._mod = client
+        self.topic = topic
+        if self._kind == "confluent":
+            self._producer = self._mod.Producer(
+                {"bootstrap.servers": brokers})
+        else:
+            self._producer = self._mod.KafkaProducer(bootstrap_servers=brokers)
+
+    def write(self, data: bytes) -> None:
+        for line in data.splitlines():
+            if not line:
+                continue
+            if self._kind == "confluent":
+                self._producer.produce(self.topic, line)
+            else:
+                self._producer.send(self.topic, line)
+
+    def flush(self) -> None:
+        self._producer.flush()

@@ -1,0 +1,96 @@
+"""TraceMonitor: validates the engine's event streams and renders circuits.
+
+Reference: ``monitor/mod.rs:131`` — a state machine over CircuitEvents and
+SchedulerEvents that panics on protocol violations (eval outside a step,
+unbalanced start/end, events for unknown nodes), used as a test oracle inside
+engine tests; plus ``visualize_circuit`` (:167) rendering the circuit graph
+to graphviz.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from dbsp_tpu.circuit.builder import Circuit, CircuitEvent, SchedulerEvent
+
+
+class TraceMonitorError(AssertionError):
+    pass
+
+
+class TraceMonitor:
+    """Attach before building operators to observe construction too."""
+
+    def __init__(self, circuit: Circuit, panic: bool = True):
+        self.panic = panic
+        self.errors: List[str] = []
+        self.known_nodes: Set[tuple] = set()
+        self.edges: List[tuple] = []
+        self.names: Dict[tuple, str] = {}
+        self._step_depth = 0  # nested circuits interleave their own steps
+        self._evaluating: Set[tuple] = set()
+        self._clock_running = False
+        circuit.register_circuit_event_handler(self._on_circuit_event)
+        circuit.register_scheduler_event_handler(self._on_scheduler_event)
+
+    def _fail(self, msg: str) -> None:
+        self.errors.append(msg)
+        if self.panic:
+            raise TraceMonitorError(msg)
+
+    # -- construction events ------------------------------------------------
+    def _on_circuit_event(self, ev: CircuitEvent) -> None:
+        if ev.kind in ("operator", "subcircuit"):
+            if ev.node_id in self.known_nodes:
+                self._fail(f"duplicate node id {ev.node_id}")
+            self.known_nodes.add(ev.node_id)
+            self.names[ev.node_id] = ev.name or ev.kind
+        elif ev.kind == "edge":
+            if ev.from_id not in self.known_nodes:
+                self._fail(f"edge from unknown node {ev.from_id}")
+            self.edges.append((ev.from_id, ev.to_id))
+
+    # -- runtime events -----------------------------------------------------
+    def _on_scheduler_event(self, ev: SchedulerEvent) -> None:
+        if ev.kind == "clock_start":
+            if self._clock_running:
+                self._fail("clock started twice")
+            self._clock_running = True
+        elif ev.kind == "clock_end":
+            if not self._clock_running:
+                self._fail("clock_end without clock_start")
+            self._clock_running = False
+        elif ev.kind == "step_start":
+            self._step_depth += 1
+        elif ev.kind == "step_end":
+            if self._step_depth == 0:
+                self._fail("step_end without step_start")
+            else:
+                self._step_depth -= 1
+            if self._step_depth == 0 and self._evaluating:
+                self._fail(f"step ended while evaluating {self._evaluating}")
+        elif ev.kind == "eval_start":
+            if self._step_depth == 0:
+                self._fail(f"eval of {ev.node_id} outside a step")
+            if ev.node_id in self._evaluating:
+                self._fail(f"re-entrant eval of {ev.node_id}")
+            if ev.node_id not in self.known_nodes:
+                self._fail(f"eval of unknown node {ev.node_id}")
+            self._evaluating.add(ev.node_id)
+        elif ev.kind == "eval_end":
+            if ev.node_id not in self._evaluating:
+                self._fail(f"eval_end without eval_start for {ev.node_id}")
+            self._evaluating.discard(ev.node_id)
+
+    # -- visualization (reference: visualize_circuit, monitor/mod.rs:167) ---
+    def visualize(self) -> str:
+        lines = ["digraph circuit {", '  rankdir="LR";']
+        for gid in sorted(self.known_nodes):
+            name = "n" + "_".join(map(str, gid))
+            lines.append(f'  {name} [label="{self.names[gid]}"];')
+        for frm, to in self.edges:
+            a = "n" + "_".join(map(str, frm))
+            b = "n" + "_".join(map(str, to))
+            lines.append(f"  {a} -> {b};")
+        lines.append("}")
+        return "\n".join(lines)

@@ -4,12 +4,14 @@ operator extension traits into scope."""
 
 from dbsp_tpu.operators import (  # noqa: F401  (Stream-method registration)
     aggregate, basic, distinct, filter_map, io_handles, join, recursive,
-    trace_op, z1)
+    semijoin, trace_op, upsert, z1)
 import dbsp_tpu.timeseries  # noqa: F401, E402  (register window/watermark)
 from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
 from dbsp_tpu.operators.basic import Generator
 from dbsp_tpu.operators.io_handles import InputHandle, OutputHandle, add_input_zset
+from dbsp_tpu.operators.upsert import UpsertHandle, add_input_map, add_input_set
 from dbsp_tpu.operators.z1 import Z1
 
 __all__ = ["Generator", "InputHandle", "OutputHandle", "add_input_zset", "Z1",
-           "Count", "Sum", "Min", "Max", "Average"]
+           "Count", "Sum", "Min", "Max", "Average",
+           "UpsertHandle", "add_input_map", "add_input_set"]

@@ -284,6 +284,12 @@ class AggregateOp(UnaryOperator):
     def fixedpoint(self, scope: int) -> bool:
         return True
 
+    def state_dict(self):
+        return {"out_spine": self.out_spine}
+
+    def load_state_dict(self, state):
+        self.out_spine = state["out_spine"]
+
 
 @dataclasses.dataclass(frozen=True)
 class _TupleMax(Aggregator):

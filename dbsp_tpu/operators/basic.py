@@ -112,6 +112,12 @@ class Generator(SourceOperator):
             return self.default
         raise StopIteration("Generator exhausted and no default value set")
 
+    def state_dict(self):
+        return {"pos": self.pos}
+
+    def load_state_dict(self, state):
+        self.pos = state["pos"]
+
 
 # -- Stream sugar -----------------------------------------------------------
 
@@ -132,21 +138,29 @@ def inspect(self: Stream, cb) -> Stream:
     return self
 
 
+def _with_schema(out: Stream, like: Stream) -> Stream:
+    out.schema = getattr(like, "schema", None)
+    return out
+
+
 @stream_method
 def plus(self: Stream, other: Stream) -> Stream:
-    return self.circuit.add_binary_operator(Plus(), self, other)
+    return _with_schema(
+        self.circuit.add_binary_operator(Plus(), self, other), self)
 
 
 @stream_method
 def minus(self: Stream, other: Stream) -> Stream:
-    return self.circuit.add_binary_operator(Minus(), self, other)
+    return _with_schema(
+        self.circuit.add_binary_operator(Minus(), self, other), self)
 
 
 @stream_method
 def neg(self: Stream) -> Stream:
-    return self.circuit.add_unary_operator(Neg(), self)
+    return _with_schema(self.circuit.add_unary_operator(Neg(), self), self)
 
 
 @stream_method
 def sum_with(self: Stream, others: Sequence[Stream]) -> Stream:
-    return self.circuit.add_nary_operator(SumN(), [self, *others])
+    return _with_schema(
+        self.circuit.add_nary_operator(SumN(), [self, *others]), self)

@@ -1,0 +1,78 @@
+"""Semijoin, antijoin, and stream_fold — derived relational operators.
+
+Reference: ``operator/semijoin.rs:38`` (``semijoin_stream``), ``antijoin``
+(``operator/join.rs:298``), ``stream_fold``.
+
+Composed from the core incremental operators (the reference does the same:
+antijoin = A - A ⋉ distinct(keys(B))), so they inherit incrementality and
+sharding for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.zset.batch import Batch
+
+
+@stream_method
+def keys_distinct(self: Stream) -> Stream:
+    """Distinct set of this indexed Z-set's keys (drops value columns)."""
+    schema = getattr(self, "schema", None)
+    assert schema is not None
+    key_dtypes = schema[0]
+    projected = self.map_rows(lambda k, v: (k, ()), key_dtypes, (),
+                              name="keys")
+    return projected.distinct()
+
+
+@stream_method
+def semijoin(self: Stream, other: Stream) -> Stream:
+    """Rows of self whose key appears in other (semijoin.rs:38) —
+    incremental; preserves self's weights (multiplied by key presence)."""
+    schema = getattr(self, "schema", None)
+    assert schema is not None
+    return self.join_index(
+        other.keys_distinct(),
+        lambda k, lv, rv: (k, lv),
+        schema[0], schema[1], name="semijoin")
+
+
+@stream_method
+def antijoin(self: Stream, other: Stream) -> Stream:
+    """Rows of self whose key does NOT appear in other (join.rs:298)."""
+    return self.minus(self.semijoin(other))
+
+
+class StreamFold(UnaryOperator):
+    """Host-side running fold over the stream's per-tick batches
+    (reference: ``stream_fold``); the accumulator is any Python/device value.
+    """
+
+    name = "stream_fold"
+
+    def __init__(self, init: Any, fold: Callable[[Any, Batch], Any]):
+        self.init = init
+        self.fold = fold
+        self.acc = init
+
+    def clock_start(self, scope: int) -> None:
+        self.acc = self.init
+
+    def eval(self, batch: Batch) -> Any:
+        self.acc = self.fold(self.acc, batch)
+        return self.acc
+
+    def state_dict(self):
+        return {"acc": self.acc}
+
+    def load_state_dict(self, state):
+        self.acc = state["acc"]
+
+
+@stream_method
+def stream_fold(self: Stream, init: Any, fold) -> Stream:
+    return self.circuit.add_unary_operator(StreamFold(init, fold), self)

@@ -71,6 +71,12 @@ class TraceOp(UnaryOperator):
     def fixedpoint(self, scope: int) -> bool:
         return not self.spine.dirty
 
+    def state_dict(self):
+        return {"spine": self.spine}
+
+    def load_state_dict(self, state):
+        self.spine = state["spine"]
+
 
 @stream_method
 def trace(self: Stream) -> Stream:

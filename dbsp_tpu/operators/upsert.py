@@ -1,0 +1,130 @@
+"""Upsert inputs: keyed set/map semantics over the Z-set engine.
+
+Reference: ``operator/input.rs`` ``add_input_set`` (:230) / ``add_input_map``
+(:313) and the upsert->delta conversion in ``operator/upsert.rs:37``: the
+host pushes (key, new value | delete) commands; the operator diffs them
+against the maintained state to emit exact Z-set deltas (retract old value,
+insert new).
+
+TPU shape: touched keys probe the internal spine (same grow-on-demand group
+gather as aggregates); retractions are the gathered live rows negated; the
+inserts are the new values; one consolidation fuses both.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbsp_tpu.circuit.builder import Circuit, Stream
+from dbsp_tpu.circuit.operator import SourceOperator
+from dbsp_tpu.operators.aggregate import GroupGather
+from dbsp_tpu.trace.spine import Spine
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, Row, bucket_cap, concat_batches
+
+
+@jax.jit
+def _retractions(qrow: jnp.ndarray, qkeys, val_cols, w: jnp.ndarray) -> Batch:
+    """Gathered (qrow, vals, w) rows -> negated live rows keyed by qkeys[qrow]."""
+    cols, w = kernels.consolidate_cols((qrow, *val_cols), w)
+    qrow, val_cols = cols[0], cols[1:]
+    live = w > 0
+    keys = tuple(
+        jnp.where(live, k[jnp.clip(qrow, 0, k.shape[0] - 1)],
+                  kernels.sentinel_for(k.dtype))
+        for k in qkeys)
+    out_cols, out_w = kernels.compact((*keys, *val_cols),
+                                      jnp.where(live, -w, 0), live)
+    return Batch(out_cols[: len(keys)], out_cols[len(keys):], out_w)
+
+
+class UpsertInput(SourceOperator):
+    """Source converting host upserts into deltas against maintained state."""
+
+    name = "upsert_input"
+
+    def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence):
+        self.key_dtypes = tuple(key_dtypes)
+        self.val_dtypes = tuple(val_dtypes)
+        self.spine = Spine(self.key_dtypes, self.val_dtypes)
+        self._pending: Dict[Row, Optional[Row]] = {}
+        self._gather = GroupGather()
+
+    def eval(self) -> Batch:
+        if not self._pending:
+            return Batch.empty(self.key_dtypes, self.val_dtypes)
+        items = list(self._pending.items())
+        self._pending.clear()
+
+        # touched keys (sorted batch of unique keys)
+        qcap = bucket_cap(len(items))
+        kcols = [np.empty((len(items),), jnp.dtype(d)) for d in self.key_dtypes]
+        for i, (k, _) in enumerate(items):
+            for j, c in enumerate(kcols):
+                c[i] = k[j]
+        order = sorted(range(len(items)), key=lambda i: items[i][0])
+        qkeys = tuple(
+            jnp.concatenate([jnp.asarray(c[order]),
+                             kernels.sentinel_fill((qcap - len(items),),
+                                                   c.dtype)])
+            for c in kcols)
+        qlive = jnp.arange(qcap) < len(items)
+
+        parts = []
+        gathered = self._gather(qkeys, qlive, self.spine.batches, qcap)
+        if gathered is not None:
+            parts.append(_retractions(gathered[0], qkeys, gathered[1],
+                                      gathered[2]))
+        inserts = [((*(k), *(v)), 1) for k, v in items if v is not None]
+        if inserts:
+            parts.append(Batch.from_tuples(inserts, self.key_dtypes,
+                                           self.val_dtypes))
+        if not parts:
+            return Batch.empty(self.key_dtypes, self.val_dtypes)
+        delta = parts[0] if len(parts) == 1 else \
+            concat_batches(parts).consolidate().shrink_to_fit()
+        self.spine.insert(delta)
+        return delta
+
+
+    def state_dict(self):
+        assert not self._pending, (
+            "cannot checkpoint with undrained upserts pending — step() first")
+        return {"spine": self.spine}
+
+    def load_state_dict(self, state):
+        self.spine = state["spine"]
+
+
+class UpsertHandle:
+    """Host feeder (reference: ``UpsertHandle``, input.rs:747)."""
+
+    def __init__(self, op: UpsertInput):
+        self._op = op
+
+    def upsert(self, key: Row, val: Optional[Row]) -> None:
+        """Insert/replace the value under ``key``; None deletes (last write
+        per key within a tick wins)."""
+        self._op._pending[tuple(key)] = None if val is None else tuple(val)
+
+    def delete(self, key: Row) -> None:
+        self.upsert(key, None)
+
+
+def add_input_map(circuit: Circuit, key_dtypes: Sequence,
+                  val_dtypes: Sequence) -> Tuple[Stream, UpsertHandle]:
+    """Keyed map input: at most one live value per key (input.rs:313)."""
+    op = UpsertInput(key_dtypes, val_dtypes)
+    s = circuit.add_source(op)
+    s.schema = (op.key_dtypes, op.val_dtypes)
+    return s, UpsertHandle(op)
+
+
+def add_input_set(circuit: Circuit, key_dtypes: Sequence
+                  ) -> Tuple[Stream, UpsertHandle]:
+    """Set input: membership toggled by upsert/delete (input.rs:230)."""
+    return add_input_map(circuit, key_dtypes, ())

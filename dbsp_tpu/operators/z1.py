@@ -48,6 +48,12 @@ class Z1(StrictOperator):
         # itself reports True — its output converges when its input does.
         return True
 
+    def state_dict(self):
+        return {"state": self.state}
+
+    def load_state_dict(self, state):
+        self.state = state["state"]
+
 
 def _zero_like_factory(example_schema):
     key_dtypes, val_dtypes = example_schema

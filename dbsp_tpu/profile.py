@@ -1,0 +1,94 @@
+"""CPU/step profiler: per-operator wall time, eval counts, state sizes.
+
+Reference: ``profile/cpu.rs:120`` (``CPUProfiler`` consuming SchedulerEvents)
++ ``profile/mod.rs:21-50`` (graphviz dump) + per-operator ``OperatorMeta``
+(``circuit/metadata.rs:18``), surfaced through
+``DBSPHandle::{enable_cpu_profiler,dump_profile}`` (dbsp_handle.rs:256,268).
+
+Here the profiler subscribes to the circuit's scheduler-event stream and
+joins timings with each operator's ``metadata()`` (e.g. spine level sizes).
+Note the timings are host wall-clock around operator eval: they include
+kernel dispatch and any host<->device syncs, but XLA may still be executing
+asynchronously — per-step latency (CircuitHandle.step_times_ns) is the
+end-to-end truth; per-operator numbers locate where time is *submitted*.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from dbsp_tpu.circuit.builder import Circuit, SchedulerEvent
+
+
+class CPUProfiler:
+    def __init__(self, circuit: Circuit):
+        self.circuit = circuit
+        self.elapsed_ns: Dict[tuple, int] = {}
+        self.counts: Dict[tuple, int] = {}
+        self.steps = 0
+        self._open: Dict[tuple, int] = {}
+        circuit.register_scheduler_event_handler(self._on_event)
+
+    def _on_event(self, ev: SchedulerEvent) -> None:
+        if ev.kind == "eval_start":
+            self._open[ev.node_id] = ev.time_ns
+        elif ev.kind == "eval_end" and ev.node_id in self._open:
+            dt = ev.time_ns - self._open.pop(ev.node_id)
+            self.elapsed_ns[ev.node_id] = self.elapsed_ns.get(ev.node_id, 0) + dt
+            self.counts[ev.node_id] = self.counts.get(ev.node_id, 0) + 1
+        elif ev.kind == "step_end":
+            self.steps += 1
+
+    # -- reports ------------------------------------------------------------
+    def _node(self, gid):
+        c = self.circuit
+        for idx in gid[:-1]:
+            c = c.nodes[idx].child
+        return c.nodes[gid[-1]]
+
+    def profile(self) -> list:
+        """Rows sorted by total time: (node id, name, ms, evals, metadata)."""
+        rows = []
+        for gid, ns in sorted(self.elapsed_ns.items(),
+                              key=lambda kv: -kv[1]):
+            node = self._node(gid)
+            rows.append({
+                "node": list(gid),
+                "name": node.operator.name,
+                "total_ms": round(ns / 1e6, 3),
+                "evals": self.counts[gid],
+                "meta": node.operator.metadata(),
+            })
+        return rows
+
+    def dump_json(self) -> str:
+        return json.dumps({"steps": self.steps, "operators": self.profile()})
+
+    def dump_dot(self) -> str:
+        """Graphviz rendering: nodes annotated with time, edges = dataflow
+        (reference: per-worker .dot profiles)."""
+        lines = ["digraph profile {", '  rankdir="LR";']
+        total = sum(self.elapsed_ns.values()) or 1
+
+        def emit(circuit: Circuit, prefix):
+            for node in circuit.nodes:
+                gid = (*prefix, node.index)
+                ns = self.elapsed_ns.get(gid, 0)
+                pct = 100.0 * ns / total
+                label = (f"{node.operator.name}\\n{ns / 1e6:.1f}ms "
+                         f"({pct:.0f}%)")
+                shade = min(9, 1 + int(pct / 12))
+                name = "n" + "_".join(map(str, gid))
+                lines.append(
+                    f'  {name} [label="{label}", style=filled, '
+                    f'colorscheme=reds9, fillcolor={shade}];')
+                for i in node.inputs:
+                    src = "n" + "_".join(map(str, (*prefix, i)))
+                    lines.append(f"  {src} -> {name};")
+                if node.child is not None:
+                    emit(node.child, gid)
+
+        emit(self.circuit, ())
+        lines.append("}")
+        return "\n".join(lines)

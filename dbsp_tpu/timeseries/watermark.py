@@ -54,6 +54,13 @@ class WatermarkMonotonic(UnaryOperator):
         return self._wm  # None until the first event arrives
 
 
+    def state_dict(self):
+        return {"wm": self._wm}
+
+    def load_state_dict(self, state):
+        self._wm = state["wm"]
+
+
 @stream_method
 def watermark_monotonic(self: Stream, ts_fn, lateness: int = 0) -> Stream:
     """Host-scalar stream of the current watermark (or None pre-first-event)."""

@@ -118,6 +118,13 @@ class WindowOp(BinaryOperator):
         return out
 
 
+    def state_dict(self):
+        return {"prev": self.prev}
+
+    def load_state_dict(self, state):
+        self.prev = tuple(state["prev"]) if state["prev"] is not None else None
+
+
 @stream_method
 def window(self: Stream, bounds: Stream, gc: bool = False) -> Stream:
     """Windowed view of this stream: rows whose first key column is inside

@@ -1,0 +1,139 @@
+"""Upsert inputs, semijoin/antijoin, stream_fold, and checkpoint/resume."""
+
+import random
+
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu import checkpoint
+from dbsp_tpu.circuit import RootCircuit, Runtime
+from dbsp_tpu.operators import (add_input_map, add_input_set, add_input_zset,
+                                Count)
+
+
+def test_upsert_map_semantics():
+    def build(c):
+        s, h = add_input_map(c, [jnp.int64], [jnp.int32])
+        return h, s.integrate().output()
+
+    circuit, (h, out) = RootCircuit.build(build)
+    h.upsert((1,), (10,))
+    h.upsert((2,), (20,))
+    circuit.step()
+    assert out.to_dict() == {(1, 10): 1, (2, 20): 1}
+    h.upsert((1,), (11,))          # replace
+    h.delete((2,))                 # delete
+    h.upsert((3,), (30,))          # insert
+    circuit.step()
+    assert out.to_dict() == {(1, 11): 1, (3, 30): 1}
+    # last write per tick wins
+    h.upsert((3,), (31,))
+    h.upsert((3,), (32,))
+    circuit.step()
+    assert out.to_dict() == {(1, 11): 1, (3, 32): 1}
+    # deleting a missing key is a no-op
+    h.delete((99,))
+    circuit.step()
+    assert out.to_dict() == {(1, 11): 1, (3, 32): 1}
+
+
+def test_upsert_set_random_vs_dict(seed=3):
+    rng = random.Random(seed)
+
+    def build(c):
+        s, h = add_input_set(c, [jnp.int64])
+        return h, s.integrate().output()
+
+    circuit, (h, out) = RootCircuit.build(build)
+    model = set()
+    for _ in range(6):
+        for _ in range(rng.randrange(1, 8)):
+            k = rng.randrange(10)
+            if rng.random() < 0.4:
+                h.delete((k,))
+                model.discard(k)
+            else:
+                h.upsert((k,), ())
+                model.add(k)
+        circuit.step()
+        assert out.to_dict() == {(k,): 1 for k in model}
+
+
+def test_semijoin_antijoin():
+    def build(c):
+        a, ha = add_input_zset(c, [jnp.int64], [jnp.int32])
+        b, hb = add_input_zset(c, [jnp.int64], [jnp.int32])
+        return (ha, hb, a.semijoin(b).integrate().output(),
+                a.antijoin(b).integrate().output())
+
+    circuit, (ha, hb, semi, anti) = RootCircuit.build(build)
+    ha.extend([((1, 10), 1), ((2, 20), 2), ((3, 30), 1)])
+    hb.extend([((1, 99), 1), ((1, 98), 1)])  # key 1 present (twice distinct)
+    circuit.step()
+    assert semi.to_dict() == {(1, 10): 1}
+    assert anti.to_dict() == {(2, 20): 2, (3, 30): 1}
+    hb.push((2, 50), 1)   # key 2 appears -> moves from anti to semi
+    circuit.step()
+    assert semi.to_dict() == {(1, 10): 1, (2, 20): 2}
+    assert anti.to_dict() == {(3, 30): 1}
+    hb.push((1, 99), -1)  # key 1 still present via (1,98)
+    circuit.step()
+    assert semi.to_dict() == {(1, 10): 1, (2, 20): 2}
+
+
+def test_stream_fold():
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [])
+        folded = s.stream_fold(0, lambda acc, b: acc + int(b.live_count()))
+        got = []
+        folded.inspect(got.append)
+        return h, got
+
+    circuit, (h, got) = RootCircuit.build(build)
+    h.extend([((1,), 1), ((2,), 1)])
+    circuit.step()
+    h.push((3,), 1)
+    circuit.step()
+    assert got == [2, 3]
+
+
+def _ckpt_build(c):
+    s, h = add_input_zset(c, [jnp.int64], [jnp.int32])
+    counts = s.aggregate(Count())
+    return h, counts.integrate().output()
+
+
+def test_checkpoint_resume(tmp_path):
+    path = str(tmp_path / "ckpt")
+    handle, (h, out) = Runtime.init_circuit(1, _ckpt_build)
+    h.extend([((1, 5), 1), ((1, 6), 1), ((2, 7), 1)])
+    handle.step()
+    assert out.to_dict() == {(1, 2): 1, (2, 1): 1}
+    checkpoint.save(handle, path)
+
+    # fresh process equivalent: rebuild the same circuit, restore, continue
+    handle2, (h2, out2) = Runtime.init_circuit(1, _ckpt_build)
+    checkpoint.restore(handle2, path)
+    h2.push((1, 8), 1)       # third value under key 1
+    handle2.step()
+    assert out2.to_dict() == {(1, 3): 1, (2, 1): 1}
+
+    # the original instance continues identically (state was copied, not moved)
+    h.push((1, 8), 1)
+    handle.step()
+    assert out.to_dict() == {(1, 3): 1, (2, 1): 1}
+
+
+def test_checkpoint_structure_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ckpt")
+    handle, (h, out) = Runtime.init_circuit(1, _ckpt_build)
+    handle.step()
+    checkpoint.save(handle, path)
+
+    def other_build(c):
+        s, h = add_input_zset(c, [jnp.int64], [jnp.int32])
+        return h, s.distinct().output()
+
+    handle2, _ = Runtime.init_circuit(1, other_build)
+    with pytest.raises(AssertionError, match="structure differs"):
+        checkpoint.restore(handle2, path)
