@@ -1,0 +1,113 @@
+"""Python client for the pipeline manager + per-pipeline servers.
+
+Reference: ``python/dbsp`` (DBSPConnection/Project/Pipeline wrapping the
+manager REST API). Same shape: a connection object for the manager, pipeline
+handles for data/control endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _req(url: str, data: Optional[bytes] = None, method: str = "GET"):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = r.read()
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read()).get("error", str(e))
+        except Exception:
+            detail = str(e)
+        raise RuntimeError(detail) from None
+    return json.loads(body) if body else None
+
+
+class PipelineHandle:
+    """Talks to one running pipeline's embedded server."""
+
+    def __init__(self, host: str, port: int):
+        self.base = f"http://{host}:{port}"
+
+    def status(self) -> dict:
+        return _req(self.base + "/status")
+
+    def stats(self) -> dict:
+        return _req(self.base + "/stats")
+
+    def metrics(self) -> str:
+        with urllib.request.urlopen(self.base + "/metrics", timeout=30) as r:
+            return r.read().decode()
+
+    def profile(self) -> dict:
+        return _req(self.base + "/dump_profile")
+
+    def push(self, collection: str, rows: List[list], deletes: bool = False
+             ) -> int:
+        env = "delete" if deletes else "insert"
+        body = "\n".join(json.dumps({env: list(r)}) for r in rows).encode()
+        out = _req(f"{self.base}/input_endpoint/{collection}?format=json",
+                   data=body, method="POST")
+        return out["records"]
+
+    def step(self) -> None:
+        _req(self.base + "/step", data=b"", method="POST")
+
+    def read(self, view: str) -> Dict[tuple, int]:
+        with urllib.request.urlopen(
+                f"{self.base}/output_endpoint/{view}?format=json",
+                timeout=30) as r:
+            out: Dict[tuple, int] = {}
+            for line in r.read().decode().splitlines():
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if "insert" in obj:
+                    row = tuple(obj["insert"])
+                    out[row] = out.get(row, 0) + 1
+                else:
+                    row = tuple(obj["delete"])
+                    out[row] = out.get(row, 0) - 1
+            return {r: w for r, w in out.items() if w != 0}
+
+    def start(self) -> None:
+        _req(self.base + "/start", data=b"", method="POST")
+
+    def pause(self) -> None:
+        _req(self.base + "/pause", data=b"", method="POST")
+
+
+class Connection:
+    """Manager-level API (reference: DBSPConnection)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8080):
+        self.host = host
+        self.base = f"http://{host}:{port}"
+
+    def create_program(self, name: str, tables: dict, sql: Dict[str, str]
+                       ) -> None:
+        _req(self.base + "/programs",
+             data=json.dumps({"name": name, "tables": tables,
+                              "sql": sql}).encode(), method="POST")
+
+    def programs(self) -> List[str]:
+        return _req(self.base + "/programs")
+
+    def start_pipeline(self, name: str, program: str) -> PipelineHandle:
+        desc = _req(self.base + "/pipelines",
+                    data=json.dumps({"name": name,
+                                     "program": program}).encode(),
+                    method="POST")
+        if desc.get("error"):
+            raise RuntimeError(desc["error"])
+        return PipelineHandle(self.host, desc["port"])
+
+    def pipelines(self) -> List[dict]:
+        return _req(self.base + "/pipelines")
+
+    def shutdown_pipeline(self, name: str) -> None:
+        _req(f"{self.base}/pipelines/{name}/shutdown", data=b"",
+             method="POST")

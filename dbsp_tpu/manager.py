@@ -1,0 +1,190 @@
+"""Pipeline manager: a control-plane service for SQL pipelines.
+
+Reference: ``crates/pipeline_manager`` — a REST API over a project DB that
+compiles SQL programs and runs pipeline processes (main.rs:76-194,
+compiler.rs, runner.rs). Differences by design: "compilation" here is
+planning SQL onto a circuit in-process (no cargo build / subprocess chain),
+pipelines run as in-process controllers each with their own embedded HTTP
+server (the reference spawns binaries), and program storage is a JSON file
+instead of Postgres — the REST surface (programs/pipelines CRUD, compile
+status, start/stop, per-pipeline port discovery) is preserved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+DTYPES = {"int32": jnp.int32, "int64": jnp.int64, "float32": jnp.float32}
+
+
+class Pipeline:
+    """One deployed program: circuit + controller + embedded server."""
+
+    def __init__(self, name: str, program: dict):
+        self.name = name
+        self.program = program
+        self.status = "created"
+        self.controller = None
+        self.server = None
+        self.port: Optional[int] = None
+        self.error: Optional[str] = None
+
+    def compile_and_start(self) -> None:
+        from dbsp_tpu.circuit import Runtime
+        from dbsp_tpu.io import Catalog, CircuitServer, Controller
+        from dbsp_tpu.profile import CPUProfiler
+        from dbsp_tpu.sql import SqlContext
+
+        tables = self.program["tables"]
+        views = self.program["sql"]
+
+        def build(c):
+            from dbsp_tpu.operators import add_input_zset
+
+            ctx = SqlContext(c)
+            handles = {}
+            for tname, spec in tables.items():
+                dts = [DTYPES[d] for d in spec["dtypes"]]
+                nkeys = spec.get("key_columns", 1)
+                s, h = add_input_zset(c, dts[:nkeys], dts[nkeys:])
+                ctx.register_table(tname, s, spec["columns"])
+                handles[tname] = (h, dts)
+            outs = {}
+            for vname, sql in views.items():
+                outs[vname] = ctx.query(sql).integrate().output()
+            return handles, outs
+
+        self.status = "compiling"
+        handle, (handles, outs) = Runtime.init_circuit(1, build)
+        catalog = Catalog()
+        for tname, (h, dts) in handles.items():
+            catalog.register_input(tname, h, tuple(dts))
+        for vname, out in outs.items():
+            catalog.register_output(vname, out, ())
+        profiler = CPUProfiler(handle.circuit)
+        self.controller = Controller(handle, catalog)
+        self.server = CircuitServer(self.controller, profiler=profiler)
+        self.server.start()
+        self.port = self.server.port
+        self.controller.start()
+        self.status = "running"
+
+    def stop(self) -> None:
+        if self.controller:
+            self.controller.stop()
+        if self.server:
+            self.server.stop()
+        if self.status != "failed":
+            self.status = "shutdown"
+
+    def describe(self) -> dict:
+        return {"name": self.name, "status": self.status, "port": self.port,
+                "error": self.error}
+
+
+class PipelineManager:
+    """REST service: /programs and /pipelines CRUD."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
+        self.programs: Dict[str, dict] = {}
+        self.pipelines: Dict[str, Pipeline] = {}
+        self.storage_path = storage_path
+        if storage_path and os.path.exists(storage_path):
+            with open(storage_path) as f:
+                self.programs = json.load(f)
+        mgr = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                parts = self.path.rstrip("/").split("/")
+                if self.path.rstrip("/") == "/programs":
+                    self._json(sorted(mgr.programs))
+                elif self.path.rstrip("/") == "/pipelines":
+                    self._json([p.describe() for p in mgr.pipelines.values()])
+                elif len(parts) == 3 and parts[1] == "pipelines":
+                    p = mgr.pipelines.get(parts[2])
+                    if p is None:
+                        self._json({"error": "not found"}, 404)
+                    else:
+                        self._json(p.describe())
+                else:
+                    self._json({"error": "no route"}, 404)
+
+            def do_POST(self):
+                parts = self.path.rstrip("/").split("/")
+                try:
+                    if self.path.rstrip("/") == "/programs":
+                        body = self._body()
+                        mgr.programs[body["name"]] = body
+                        mgr._persist()
+                        self._json({"name": body["name"]})
+                    elif self.path.rstrip("/") == "/pipelines":
+                        body = self._body()
+                        name = body["name"]
+                        if name in mgr.pipelines and \
+                                mgr.pipelines[name].status == "running":
+                            return self._json(
+                                {"error": f"pipeline {name} already running"},
+                                409)
+                        prog = mgr.programs[body["program"]]
+                        p = Pipeline(name, prog)
+                        try:
+                            p.compile_and_start()
+                        except Exception as e:
+                            p.error = f"{type(e).__name__}: {e}"
+                            p.status = "failed"
+                            p.stop()  # release any partially started parts
+                            mgr.pipelines[name] = p
+                            return self._json({"error": p.error}, 400)
+                        mgr.pipelines[name] = p
+                        self._json(p.describe())
+                    elif len(parts) == 4 and parts[1] == "pipelines" and \
+                            parts[3] == "shutdown":
+                        mgr.pipelines[parts[2]].stop()
+                        self._json(mgr.pipelines[parts[2]].describe())
+                    else:
+                        self._json({"error": "no route"}, 404)
+                except Exception as e:  # surface as API error, keep serving
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _persist(self):
+        if self.storage_path:
+            with open(self.storage_path, "w") as f:
+                json.dump(self.programs, f)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="manager")
+        self._thread.start()
+
+    def stop(self):
+        for p in self.pipelines.values():
+            if p.status == "running":
+                p.stop()
+        self.httpd.shutdown()

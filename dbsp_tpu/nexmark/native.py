@@ -1,0 +1,130 @@
+"""ctypes bridge to the native C++ Nexmark generator (native/nexmark_gen.cpp).
+
+Builds the shared library on first use (g++ -O3; no pybind11 in this image —
+plain C ABI + ctypes, per the repo's native-binding policy). The native path
+must be bit-identical to the numpy implementation — the test suite compares
+them column by column, so either can generate any sub-range of the stream.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Optional
+
+import numpy as np
+
+from dbsp_tpu.nexmark.generator import GeneratorConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "nexmark_gen.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libnexmark_gen.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+class _CConfig(ctypes.Structure):
+    _fields_ = [(name, ctypes.c_int64) for name in (
+        "seed", "base_time_ms", "first_event_rate", "hot_auction_pm",
+        "hot_bidder_pm", "hot_window", "num_channels", "num_name_codes",
+        "num_city_codes", "num_state_codes", "expire_min_ms",
+        "expire_max_ms")]
+
+
+_build_error: Optional[str] = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path.
+
+    A failed build is cached (raised again without re-spawning g++) so hot
+    paths with a numpy fallback don't fork a failing compiler per batch."""
+    global _build_error
+    if _build_error is not None and not force:
+        raise RuntimeError(_build_error)
+    if force or not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        try:
+            r = subprocess.run(
+                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                 "-o", _SO, _SRC],
+                check=True, capture_output=True, text=True)
+        except FileNotFoundError:
+            _build_error = "g++ not found; native generator unavailable"
+            raise RuntimeError(_build_error) from None
+        except subprocess.CalledProcessError as e:
+            _build_error = f"native generator build failed:\n{e.stderr}"
+            raise RuntimeError(_build_error) from None
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        lib.nx_counts.argtypes = [ctypes.c_int64] * 2 + \
+            [ctypes.POINTER(ctypes.c_int64)] * 3
+        # explicit argtypes: without them ctypes truncates int args to
+        # 32-bit C ints, desynchronizing the generated range from the
+        # nx_counts-sized buffers
+        lib.nx_generate.argtypes = [
+            ctypes.POINTER(_CConfig), ctypes.c_int64, ctypes.c_int64,
+        ] + [ctypes.c_void_p] * 19
+        lib.nx_generate.restype = None
+        _lib = lib
+    return _lib
+
+
+def counts(n0: int, n1: int):
+    lib = _load()
+    np_, na, nb = (ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64())
+    lib.nx_counts(n0, n1, ctypes.byref(np_), ctypes.byref(na),
+                  ctypes.byref(nb))
+    return np_.value, na.value, nb.value
+
+
+def generate(cfg: GeneratorConfig, n0: int, n1: int
+             ) -> Dict[str, Dict[str, np.ndarray]]:
+    """Columnar events [n0, n1), same layout as NexmarkGenerator.generate."""
+    lib = _load()
+    n_p, n_a, n_b = counts(n0, n1)
+    c = _CConfig(
+        seed=cfg.seed, base_time_ms=cfg.base_time_ms,
+        first_event_rate=cfg.first_event_rate,
+        hot_auction_pm=int(cfg.hot_auction_ratio * 1000),
+        hot_bidder_pm=int(cfg.hot_bidder_ratio * 1000),
+        hot_window=cfg.hot_window, num_channels=cfg.num_channels,
+        num_name_codes=cfg.num_name_codes, num_city_codes=cfg.num_city_codes,
+        num_state_codes=cfg.num_state_codes,
+        expire_min_ms=cfg.auction_expire_min_ms,
+        expire_max_ms=cfg.auction_expire_max_ms)
+
+    def buf(n, dt):
+        return np.empty((n,), dt)
+
+    p = {"id": buf(n_p, np.int64), "name": buf(n_p, np.int32),
+         "city": buf(n_p, np.int32), "state": buf(n_p, np.int32),
+         "email": buf(n_p, np.int32), "date_time": buf(n_p, np.int64)}
+    a = {"id": buf(n_a, np.int64), "item": buf(n_a, np.int32),
+         "seller": buf(n_a, np.int64), "category": buf(n_a, np.int64),
+         "initial_bid": buf(n_a, np.int64), "reserve": buf(n_a, np.int64),
+         "date_time": buf(n_a, np.int64), "expires": buf(n_a, np.int64)}
+    b = {"auction": buf(n_b, np.int64), "bidder": buf(n_b, np.int64),
+         "price": buf(n_b, np.int64), "channel": buf(n_b, np.int32),
+         "date_time": buf(n_b, np.int64)}
+
+    def ptr(arr):
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    lib.nx_generate(
+        ctypes.byref(c), n0, n1,
+        ptr(p["id"]), ptr(p["name"]), ptr(p["city"]), ptr(p["state"]),
+        ptr(p["email"]), ptr(p["date_time"]),
+        ptr(a["id"]), ptr(a["item"]), ptr(a["seller"]), ptr(a["category"]),
+        ptr(a["initial_bid"]), ptr(a["reserve"]), ptr(a["date_time"]),
+        ptr(a["expires"]),
+        ptr(b["auction"]), ptr(b["bidder"]), ptr(b["price"]),
+        ptr(b["channel"]), ptr(b["date_time"]))
+    return {"persons": p, "auctions": a, "bids": b}

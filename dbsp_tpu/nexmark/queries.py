@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.nexmark import model as M
-from dbsp_tpu.operators.aggregate import Average, Count, Max  # noqa: F401 (queries use all three)
+from dbsp_tpu.operators.aggregate import Average, Count, Max, Min  # noqa: F401
 
 
 def q0(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
@@ -198,3 +198,250 @@ def q4(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
         val_fn=lambda k, v: (v[0],), val_dtypes=(jnp.int64,),
         name="q4-by-category")
     return by_category.aggregate(Average(0), name="q4-avg")
+
+
+# ---------------------------------------------------------------------------
+# q6 / q9: winning bids (join + in-window max with tie-break) and rolling
+# per-seller averages (top-K by close time)
+# ---------------------------------------------------------------------------
+
+
+def _winning_bids(auctions: Stream, bids: Stream) -> Stream:
+    """(auction) -> (price, neg_ts, bidder, seller, expires) for the winning
+    (highest-price, earliest-time) in-window bid of each auction — the core
+    of q9/q6 (queries/q9.rs). Tie-break encoded by ranking on
+    (price, -ts): lexicographic top-1 picks max price then min ts."""
+    by_auction = auctions.index_by(
+        lambda k, v: (k[0],), M.AUCTION_KEY,
+        val_fn=lambda k, v: (v[M.A_SELLER], v[M.A_DATE], v[M.A_EXPIRES]),
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q9-auctions")
+    joined = bids.join_index(
+        by_auction,
+        lambda k, bv, av: (
+            (k[0],),
+            (bv[M.B_PRICE], -bv[M.B_DATE], bv[M.B_BIDDER], av[0],
+             bv[M.B_DATE], av[1], av[2])),
+        (jnp.int64,),
+        (jnp.int64, jnp.int64, jnp.int64, jnp.int64, jnp.int64, jnp.int64,
+         jnp.int64), name="q9-join")
+    in_window = joined.filter_rows(
+        lambda k, v: (v[4] >= v[5]) & (v[4] <= v[6]), name="q9-window")
+    ranked = in_window.map_rows(
+        lambda k, v: (k, (v[0], v[1], v[2], v[3], v[6])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+        name="q9-rank")
+    return ranked.topk(1, largest=True, name="q9-top1")
+
+
+def q9(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Winning bid of each auction: (auction, price, ts, bidder)."""
+    return _winning_bids(auctions, bids).map_rows(
+        lambda k, v: (k, (v[0], -v[1], v[2])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q9-project")
+
+
+def q6(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Average winning price of each seller's last 10 closed auctions
+    (queries/q6.rs): winning bids -> per-seller top-10 by expiry -> average.
+    Output: (seller, avg_price)."""
+    winners = _winning_bids(auctions, bids)
+    by_seller = winners.map_rows(
+        lambda k, v: ((v[3],), (v[4], k[0], v[0])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q6-by-seller")
+    last10 = by_seller.topk(10, largest=True, name="q6-last10")
+    prices = last10.map_rows(lambda k, v: (k, (v[2],)),
+                             (jnp.int64,), (jnp.int64,), name="q6-prices")
+    return prices.aggregate(Average(0), name="q6-avg")
+
+
+# ---------------------------------------------------------------------------
+# q12-q22
+# ---------------------------------------------------------------------------
+
+Q12_WINDOW_TICKS = 10
+
+
+def q12(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Bid count per bidder per PROCESSING-time window (queries/q12.rs).
+
+    Processing time on a deterministic engine is the tick index: each
+    circuit step is one processing unit, windows span 10 ticks. The tick
+    counter is a stream_fold (no wall clock — reproducible runs)."""
+    import jax.numpy as _jnp
+
+    from dbsp_tpu.operators.basic import Apply2
+    from dbsp_tpu.zset.batch import Batch
+
+    tick = bids.stream_fold(0, lambda acc, b: acc + 1)
+
+    def attach(batch: Batch, t: int) -> Batch:
+        win = (t - 1) // Q12_WINDOW_TICKS
+        bidder = batch.vals[M.B_BIDDER]
+        wcol = _jnp.full((batch.cap,), win, _jnp.int64)
+        return Batch((bidder, wcol), (), batch.weights).consolidate()
+
+    keyed = bids.circuit.add_binary_operator(
+        Apply2(attach, "q12-procwin"), bids, tick)
+    keyed.schema = ((jnp.int64, jnp.int64), ())
+    return keyed.aggregate(Count(), name="q12-count")
+
+
+def q13(persons: Stream, auctions: Stream, bids: Stream,
+        side: Stream = None) -> Stream:
+    """Bounded side-input join (queries/q13.rs): enrich bids from a static
+    keyed table. Default side input: channel -> boosted id table."""
+    from dbsp_tpu.operators.basic import Generator
+    from dbsp_tpu.zset.batch import Batch
+
+    c = bids.circuit
+    if side is None:
+        table = Batch.from_tuples(
+            [((ch, 1000 + ch), 1) for ch in range(16)],
+            (jnp.int64,), (jnp.int64,))
+        side = c.add_source(Generator(
+            [table], default=Batch.empty((jnp.int64,), (jnp.int64,))))
+        side.schema = ((jnp.int64,), (jnp.int64,))
+    by_channel = bids.index_by(
+        lambda k, v: (v[M.B_CHANNEL].astype(jnp.int64),), (jnp.int64,),
+        val_fn=lambda k, v: (k[0], v[M.B_BIDDER], v[M.B_PRICE], v[M.B_DATE]),
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+        name="q13-by-channel")
+    return by_channel.join_index(
+        side, lambda k, bv, sv: ((bv[0],), (bv[1], bv[2], bv[3], sv[0])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+        name="q13-join")
+
+
+def q14(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Calculation + filter (queries/q14.rs): euro price > 1M, bucketed
+    bid-time-of-day. Output key (auction), vals (bidder, eur, timetype, ts);
+    timetype: 0=day [8,18), 1=night [0,6)|[20,24), 2=other."""
+    def conv(k, v):
+        eur = v[M.B_PRICE] * 908 // 1000
+        hour = (v[M.B_DATE] // 3_600_000) % 24
+        night = ((hour < 6) | (hour >= 20)).astype(jnp.int64)
+        day = ((hour >= 8) & (hour < 18)).astype(jnp.int64)
+        timetype = jnp.where(day == 1, 0, jnp.where(night == 1, 1, 2))
+        return k, (v[M.B_BIDDER], eur, timetype, v[M.B_DATE])
+
+    mapped = bids.map_rows(conv, M.BID_KEY,
+                           (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+                           name="q14-calc")
+    return mapped.filter_rows(lambda k, v: v[1] > 1_000_000, name="q14-filter")
+
+
+DAY_MS = 86_400_000
+
+
+def q15(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Distinct bidders per day (queries/q15.rs): (day, n_distinct)."""
+    day_bidder = bids.map_rows(
+        lambda k, v: ((v[M.B_DATE] // DAY_MS, v[M.B_BIDDER]), ()),
+        (jnp.int64, jnp.int64), (), name="q15-daybidder")
+    uniq = day_bidder.distinct()
+    by_day = uniq.index_by(lambda k, v: (k[0],), (jnp.int64,),
+                           val_fn=lambda k, v: (k[1],),
+                           val_dtypes=(jnp.int64,), name="q15-by-day")
+    return by_day.aggregate(Count(), name="q15-count")
+
+
+def q16(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Channel statistics per day (queries/q16.rs, simplified to the core
+    aggregates): (channel, day) -> (total_bids, distinct_bidders)."""
+    keyed = bids.map_rows(
+        lambda k, v: ((v[M.B_CHANNEL].astype(jnp.int64),
+                       v[M.B_DATE] // DAY_MS), (v[M.B_BIDDER],)),
+        (jnp.int64, jnp.int64), (jnp.int64,), name="q16-key")
+    totals = keyed.aggregate(Count(), name="q16-total")
+    uniq_bidders = keyed.distinct().aggregate(Count(), name="q16-distinct")
+    return totals.join_index(
+        uniq_bidders, lambda k, tv, uv: (k, (tv[0], uv[0])),
+        (jnp.int64, jnp.int64), (jnp.int64, jnp.int64), name="q16-join")
+
+
+def q17(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Auction bid statistics per day (queries/q17.rs): (auction, day) ->
+    (count, min, max, avg price)."""
+    keyed = bids.map_rows(
+        lambda k, v: ((k[0], v[M.B_DATE] // DAY_MS), (v[M.B_PRICE],)),
+        (jnp.int64, jnp.int64), (jnp.int64,), name="q17-key")
+    cnt = keyed.aggregate(Count(), name="q17-count")
+    mn = keyed.aggregate(Min(0), name="q17-min")
+    mx = keyed.aggregate(Max(0), name="q17-max")
+    avg = keyed.aggregate(Average(0), name="q17-avg")
+    j1 = cnt.join_index(mn, lambda k, a, b: (k, (a[0], b[0])),
+                        (jnp.int64, jnp.int64), (jnp.int64, jnp.int64),
+                        name="q17-j1")
+    j2 = j1.join_index(mx, lambda k, a, b: (k, (a[0], a[1], b[0])),
+                       (jnp.int64, jnp.int64),
+                       (jnp.int64, jnp.int64, jnp.int64), name="q17-j2")
+    return j2.join_index(avg, lambda k, a, b: (k, (a[0], a[1], a[2], b[0])),
+                         (jnp.int64, jnp.int64),
+                         (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+                         name="q17-j3")
+
+
+def q18(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Last bid of each bidder (queries/q18.rs): (bidder, ts, auction, price)."""
+    by_bidder = bids.index_by(
+        lambda k, v: (v[M.B_BIDDER],), (jnp.int64,),
+        val_fn=lambda k, v: (v[M.B_DATE], k[0], v[M.B_PRICE]),
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q18-by-bidder")
+    return by_bidder.topk(1, largest=True, name="q18-last")
+
+
+def q19(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Top-10 bids by price per auction (queries/q19.rs): the window-function
+    query; ranking = (price, ts) lexicographic."""
+    ranked = bids.index_by(
+        lambda k, v: (k[0],), M.BID_KEY,
+        val_fn=lambda k, v: (v[M.B_PRICE], v[M.B_DATE], v[M.B_BIDDER]),
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q19-rank")
+    return ranked.topk(10, largest=True, name="q19-top10")
+
+
+def q20(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Expand bids with their auction's info, category 10 only
+    (queries/q20.rs): (auction) -> (bidder, price, item, seller)."""
+    cat = auctions.filter_rows(lambda k, v: v[M.A_CATEGORY] == Q3_CATEGORY,
+                               name="q20-cat")
+    by_id = cat.index_by(
+        lambda k, v: (k[0],), M.AUCTION_KEY,
+        val_fn=lambda k, v: (v[M.A_ITEM].astype(jnp.int64), v[M.A_SELLER]),
+        val_dtypes=(jnp.int64, jnp.int64), name="q20-auctions")
+    return bids.join_index(
+        by_id, lambda k, bv, av: (k, (bv[M.B_BIDDER], bv[M.B_PRICE],
+                                      av[0], av[1])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+        name="q20-join")
+
+
+def q21(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """Channel id classification (queries/q21.rs): channels 0-3 map to fixed
+    ids (the reference's Google/Facebook/Baidu/Apple CASE), others derive
+    from the channel code (its url-hash arm). Strings are dictionary codes
+    (generator.py); the CASE is arithmetic on codes."""
+    def classify(k, v):
+        ch = v[M.B_CHANNEL].astype(jnp.int64)
+        chan_id = jnp.where(ch < 4, ch, 100 + ch)
+        return k, (v[M.B_BIDDER], v[M.B_PRICE], ch, chan_id)
+
+    return bids.map_rows(classify, M.BID_KEY,
+                         (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
+                         name="q21")
+
+
+def q22(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
+    """URL split (queries/q22.rs): dir1/dir2/dir3 of the bid url. Urls are
+    dictionary-coded; the synthetic generator derives part codes from the
+    url code arithmetically (host dictionaries own the real strings)."""
+    def split(k, v):
+        url = v[M.B_CHANNEL].astype(jnp.int64)  # channel doubles as url code
+        dir1 = url % 7
+        dir2 = (url // 7) % 11
+        dir3 = (url // 77) % 13
+        return k, (v[M.B_BIDDER], v[M.B_PRICE], dir1, dir2, dir3)
+
+    return bids.map_rows(split, M.BID_KEY,
+                         (jnp.int64, jnp.int64, jnp.int64, jnp.int64,
+                          jnp.int64), name="q22")

@@ -4,7 +4,7 @@ operator extension traits into scope."""
 
 from dbsp_tpu.operators import (  # noqa: F401  (Stream-method registration)
     aggregate, basic, distinct, filter_map, io_handles, join, recursive,
-    semijoin, trace_op, upsert, z1)
+    semijoin, topk, trace_op, upsert, z1)
 import dbsp_tpu.timeseries  # noqa: F401, E402  (register window/watermark)
 from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
 from dbsp_tpu.operators.basic import Generator

@@ -1,0 +1,4 @@
+from dbsp_tpu.sql.parser import parse
+from dbsp_tpu.sql.planner import SqlContext, SqlError
+
+__all__ = ["parse", "SqlContext", "SqlError"]

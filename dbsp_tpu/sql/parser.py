@@ -1,0 +1,266 @@
+"""A small SQL parser: SELECT queries over registered streams.
+
+Reference context: the reference's SQL frontend is an out-of-tree Apache
+Calcite compiler invoked as a subprocess (SURVEY.md L5; the submodule is not
+even checked out there). This is the smallest viable in-tree equivalent: a
+hand-rolled tokenizer + recursive-descent parser for the subset that covers
+incremental view maintenance over streams:
+
+    SELECT [DISTINCT] expr [AS name], ...
+    FROM table [alias] [JOIN table [alias] ON col = col]
+    [WHERE predicate]
+    [GROUP BY col, ...]
+
+with integer/float literals, + - * / %, comparisons, AND/OR/NOT, and
+aggregates COUNT(*) / COUNT / SUM / MIN / MAX / AVG. The planner
+(``sql/planner.py``) lowers the AST onto circuit operators, so every query
+is maintained incrementally like any hand-built circuit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple, Union
+
+TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<id>[A-Za-z_][A-Za-z_0-9]*)"
+    r"|(?P<op><=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.))")
+
+KEYWORDS = {"select", "distinct", "from", "join", "on", "where", "group",
+            "by", "as", "and", "or", "not", "count", "sum", "min", "max",
+            "avg"}
+
+
+def tokenize(sql: str) -> List[Tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = TOKEN_RE.match(sql, pos)
+        if not m:
+            if sql[pos:].strip():
+                raise SyntaxError(f"bad SQL at: {sql[pos:pos+20]!r}")
+            break
+        pos = m.end()
+        if m.group("num"):
+            out.append(("num", m.group("num")))
+        elif m.group("id"):
+            word = m.group("id")
+            out.append(("kw", word.lower()) if word.lower() in KEYWORDS
+                       else ("id", word))
+        else:
+            out.append(("op", m.group("op")))
+    return out
+
+
+# -- AST --------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Col:
+    table: Optional[str]
+    name: str
+
+
+@dataclasses.dataclass
+class Lit:
+    value: Union[int, float]
+
+
+@dataclasses.dataclass
+class BinOp:
+    op: str
+    left: "Expr"
+    right: "Expr"
+
+
+@dataclasses.dataclass
+class NotOp:
+    expr: "Expr"
+
+
+@dataclasses.dataclass
+class Agg:
+    fn: str               # count|sum|min|max|avg
+    arg: Optional["Expr"]  # None for COUNT(*)
+
+
+Expr = Union[Col, Lit, BinOp, NotOp, Agg]
+
+
+@dataclasses.dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str]
+
+
+@dataclasses.dataclass
+class TableRef:
+    name: str
+    alias: str
+
+
+@dataclasses.dataclass
+class Select:
+    items: List[SelectItem]
+    distinct: bool
+    table: TableRef
+    join: Optional[TableRef]
+    join_on: Optional[Tuple[Col, Col]]
+    where: Optional[Expr]
+    group_by: List[Col]
+
+
+class Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, kind, val=None):
+        t = self.next()
+        if t[0] != kind or (val is not None and t[1] != val):
+            raise SyntaxError(f"expected {val or kind}, got {t}")
+        return t
+
+    def accept(self, kind, val=None):
+        t = self.peek()
+        if t[0] == kind and (val is None or t[1] == val):
+            self.i += 1
+            return True
+        return False
+
+    # -- grammar ------------------------------------------------------------
+    def parse_select(self) -> Select:
+        self.expect("kw", "select")
+        distinct = self.accept("kw", "distinct")
+        items = [self.select_item()]
+        while self.accept("op", ","):
+            items.append(self.select_item())
+        self.expect("kw", "from")
+        table = self.table_ref()
+        join = join_on = None
+        if self.accept("kw", "join"):
+            join = self.table_ref()
+            self.expect("kw", "on")
+            left = self.column()
+            self.expect("op", "=")
+            right = self.column()
+            join_on = (left, right)
+        where = None
+        if self.accept("kw", "where"):
+            where = self.disjunction()
+        group_by: List[Col] = []
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            group_by.append(self.column())
+            while self.accept("op", ","):
+                group_by.append(self.column())
+        if self.peek()[0] != "eof":
+            raise SyntaxError(f"trailing tokens: {self.toks[self.i:]}")
+        return Select(items, distinct, table, join, join_on, where, group_by)
+
+    def select_item(self) -> SelectItem:
+        if self.peek() == ("op", "*"):
+            self.next()
+            return SelectItem(Col(None, "*"), None)
+        e = self.disjunction()
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.expect("id")[1]
+        return SelectItem(e, alias)
+
+    def table_ref(self) -> TableRef:
+        name = self.expect("id")[1]
+        alias = name
+        if self.peek()[0] == "id":
+            alias = self.next()[1]
+        return TableRef(name, alias)
+
+    def column(self) -> Col:
+        first = self.expect("id")[1]
+        if self.accept("op", "."):
+            return Col(first, self.expect("id")[1])
+        return Col(None, first)
+
+    # precedence: OR < AND < NOT < comparison < add < mul < atom
+    def disjunction(self) -> Expr:
+        e = self.conjunction()
+        while self.accept("kw", "or"):
+            e = BinOp("or", e, self.conjunction())
+        return e
+
+    def conjunction(self) -> Expr:
+        e = self.negation()
+        while self.accept("kw", "and"):
+            e = BinOp("and", e, self.negation())
+        return e
+
+    def negation(self) -> Expr:
+        if self.accept("kw", "not"):
+            return NotOp(self.negation())
+        return self.comparison()
+
+    def comparison(self) -> Expr:
+        e = self.additive()
+        t = self.peek()
+        if t[0] == "op" and t[1] in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            return BinOp(t[1], e, self.additive())
+        return e
+
+    def additive(self) -> Expr:
+        e = self.multiplicative()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("+", "-"):
+                self.next()
+                e = BinOp(t[1], e, self.multiplicative())
+            else:
+                return e
+
+    def multiplicative(self) -> Expr:
+        e = self.atom()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("*", "/", "%"):
+                self.next()
+                e = BinOp(t[1], e, self.atom())
+            else:
+                return e
+
+    def atom(self) -> Expr:
+        t = self.peek()
+        if t[0] == "num":
+            self.next()
+            return Lit(float(t[1]) if "." in t[1] else int(t[1]))
+        if t[0] == "op" and t[1] == "(":
+            self.next()
+            e = self.disjunction()
+            self.expect("op", ")")
+            return e
+        if t[0] == "op" and t[1] == "-":
+            self.next()
+            return BinOp("-", Lit(0), self.atom())
+        if t[0] == "kw" and t[1] in ("count", "sum", "min", "max", "avg"):
+            fn = self.next()[1]
+            self.expect("op", "(")
+            if fn == "count" and self.accept("op", "*"):
+                arg = None
+            else:
+                arg = self.disjunction()
+            self.expect("op", ")")
+            return Agg(fn, arg)
+        if t[0] == "id":
+            return self.column()
+        raise SyntaxError(f"unexpected token {t}")
+
+
+def parse(sql: str) -> Select:
+    return Parser(tokenize(sql)).parse_select()

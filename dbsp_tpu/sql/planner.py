@@ -1,0 +1,274 @@
+"""SQL planner: lowers parsed SELECTs onto incremental circuit operators.
+
+The in-tree stand-in for the reference's out-of-tree Calcite->Rust compiler
+(``pipeline_manager/src/compiler.rs`` invokes it as a subprocess; SURVEY.md
+L5): here SQL plans straight into the same Stream operators hand-built
+queries use, so every registered view is incrementally maintained — inserts
+and retractions on base tables propagate deltas through WHERE/JOIN/GROUP BY.
+
+Lowering map:
+    WHERE                -> filter_rows (columnar predicate)
+    JOIN ... ON a = b    -> index_by + incremental bilinear join
+    GROUP BY + agg       -> index_by + incremental aggregate (one per agg,
+                            joined on the group key — reference's multi-agg
+                            plans share the same shape)
+    DISTINCT             -> incremental distinct
+    plain SELECT         -> map_rows projection
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dbsp_tpu.circuit.builder import Circuit, Stream
+from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
+from dbsp_tpu.sql import parser as P
+
+AGG_CLASSES = {"count": Count, "sum": Sum, "min": Min, "max": Max,
+               "avg": Average}
+
+
+class SqlError(ValueError):
+    pass
+
+
+class _Scope:
+    """Column-name resolution over a stream's (key+val) columns."""
+
+    def __init__(self, names: List[str], dtypes: List):
+        self.names = list(names)
+        self.dtypes = list(dtypes)
+
+    def index_of(self, col: P.Col) -> int:
+        want = f"{col.table}.{col.name}" if col.table else col.name
+        hits = [i for i, n in enumerate(self.names)
+                if n == want or (col.table is None and
+                                 n.split(".")[-1] == col.name)]
+        if not hits:
+            raise SqlError(f"unknown column {want}; have {self.names}")
+        if len(hits) > 1:
+            raise SqlError(f"ambiguous column {want}")
+        return hits[0]
+
+
+def _compile_expr(expr, scope: _Scope):
+    """Expr -> fn(flat_cols_tuple) -> array; plus the result dtype."""
+
+    def fn(cols):
+        return _eval(expr, scope, cols)
+
+    samples = tuple(jnp.zeros((1,), d) for d in scope.dtypes)
+    out_dtype = np.asarray(fn(samples)).dtype
+    return fn, out_dtype
+
+
+def _eval(expr, scope: _Scope, cols):
+    if isinstance(expr, P.Lit):
+        return jnp.asarray(expr.value)
+    if isinstance(expr, P.Col):
+        return cols[scope.index_of(expr)]
+    if isinstance(expr, P.NotOp):
+        return ~_eval(expr.expr, scope, cols)
+    if isinstance(expr, P.BinOp):
+        a = _eval(expr.left, scope, cols)
+        b = _eval(expr.right, scope, cols)
+        op = expr.op
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a // b if jnp.issubdtype(jnp.result_type(a, b),
+                                            jnp.integer) else a / b
+        if op == "%":
+            return a % b
+        if op == "=":
+            return a == b
+        if op in ("<>", "!="):
+            return a != b
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+        if op == "and":
+            return a & b
+        if op == "or":
+            return a | b
+    raise SqlError(f"cannot evaluate {expr}")
+
+
+class SqlContext:
+    """Registry of named streams + the SQL entry point."""
+
+    def __init__(self, circuit: Circuit):
+        self.circuit = circuit
+        self.tables: Dict[str, Tuple[Stream, List[str]]] = {}
+
+    def register_table(self, name: str, stream: Stream,
+                       columns: List[str]) -> None:
+        schema = getattr(stream, "schema", None)
+        assert schema is not None, "registered streams need schema metadata"
+        ncols = len(schema[0]) + len(schema[1])
+        assert len(columns) == ncols, (
+            f"{name}: {len(columns)} column names for {ncols} columns")
+        self.tables[name] = (stream, list(columns))
+
+    # -- planning -----------------------------------------------------------
+    def query(self, sql: str) -> Stream:
+        ast = P.parse(sql)
+        stream, scope = self._plan_from(ast)
+        if ast.where is not None:
+            pred, dt = _compile_expr(ast.where, scope)
+            if dt != np.bool_:
+                raise SqlError("WHERE must be boolean")
+            stream = stream.filter_rows(
+                lambda k, v, _p=pred: _p((*k, *v)), name="sql-where")
+        has_aggs = any(isinstance(i.expr, P.Agg) for i in ast.items)
+        if has_aggs or ast.group_by:
+            stream = self._plan_aggregate(ast, stream, scope)
+        else:
+            stream = self._plan_project(ast, stream, scope)
+        if ast.distinct:
+            stream = stream.distinct()
+        return stream
+
+    def _table_scope(self, ref: P.TableRef) -> Tuple[Stream, _Scope]:
+        if ref.name not in self.tables:
+            raise SqlError(f"unknown table {ref.name}")
+        stream, cols = self.tables[ref.name]
+        schema = stream.schema
+        dtypes = [*schema[0], *schema[1]]
+        return stream, _Scope([f"{ref.alias}.{c}" for c in cols], dtypes)
+
+    def _plan_from(self, ast: P.Select) -> Tuple[Stream, _Scope]:
+        left, ls = self._table_scope(ast.table)
+        if ast.join is None:
+            return left, ls
+        right, rs = self._table_scope(ast.join)
+        lcol, rcol = ast.join_on
+        # resolve which side each ON column belongs to
+        try:
+            li = ls.index_of(lcol)
+        except SqlError:
+            lcol, rcol = rcol, lcol
+            li = ls.index_of(lcol)
+        ri = rs.index_of(rcol)
+        key_dt = ls.dtypes[li]
+
+        def rekey(idx, n):
+            def key_fn(k, v, _i=idx):
+                return ((*k, *v)[_i],)
+
+            def val_fn(k, v, _n=n):
+                return tuple((*k, *v))
+
+            return key_fn, val_fn
+
+        lk, lv = rekey(li, len(ls.names))
+        rk, rv = rekey(ri, len(rs.names))
+        lkeyed = left.index_by(lk, (key_dt,), val_fn=lv,
+                               val_dtypes=tuple(ls.dtypes), name="sql-lkey")
+        rkeyed = right.index_by(rk, (key_dt,), val_fn=rv,
+                                val_dtypes=tuple(rs.dtypes), name="sql-rkey")
+        joined = lkeyed.join_index(
+            rkeyed, lambda k, lvs, rvs: (k, (*lvs, *rvs)),
+            (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-join")
+        scope = _Scope(["__jk__", *ls.names, *rs.names],
+                       [key_dt, *ls.dtypes, *rs.dtypes])
+        return joined, scope
+
+    def _plan_project(self, ast: P.Select, stream: Stream, scope: _Scope
+                      ) -> Stream:
+        if len(ast.items) == 1 and isinstance(ast.items[0].expr, P.Col) \
+                and ast.items[0].expr.name == "*":
+            return stream
+        fns, dts = [], []
+        for item in ast.items:
+            fn, dt = _compile_expr(item.expr, scope)
+            fns.append(fn)
+            dts.append(dt)
+
+        def project(k, v):
+            cols = (*k, *v)
+            outs = tuple(jnp.broadcast_to(f(cols), cols[0].shape)
+                         for f in fns)
+            return outs, ()
+
+        return stream.map_rows(project, tuple(dts), (), name="sql-project")
+
+    def _plan_aggregate(self, ast: P.Select, stream: Stream, scope: _Scope
+                        ) -> Stream:
+        group_idx = [scope.index_of(c) for c in ast.group_by]
+        key_dts = [scope.dtypes[i] for i in group_idx] or [jnp.int64]
+
+        aggs: List[Tuple[int, P.Agg]] = []
+        for pos, item in enumerate(ast.items):
+            if isinstance(item.expr, P.Agg):
+                aggs.append((pos, item.expr))
+            elif isinstance(item.expr, P.Col):
+                if scope.index_of(item.expr) not in group_idx:
+                    raise SqlError(
+                        f"{item.expr} must appear in GROUP BY or an aggregate")
+            else:
+                raise SqlError("non-aggregate select items must be columns")
+
+        def keyed_stream(agg: P.Agg) -> Stream:
+            if agg.arg is None:
+                arg_fn, arg_dt = (lambda cols: jnp.ones_like(cols[0])), \
+                    np.dtype(np.int64)
+            else:
+                arg_fn, arg_dt = _compile_expr(agg.arg, scope)
+
+            def mapper(k, v, _f=arg_fn):
+                cols = (*k, *v)
+                keys = tuple(cols[i] for i in group_idx) or \
+                    (jnp.zeros_like(cols[0]),)
+                return keys, (jnp.broadcast_to(_f(cols), cols[0].shape),)
+
+            return stream.map_rows(mapper, tuple(key_dts), (arg_dt,),
+                                   name="sql-keyed")
+
+        results = []
+        for pos, agg in aggs:
+            ks = keyed_stream(agg)
+            cls = AGG_CLASSES[agg.fn]
+            inst = cls() if agg.fn == "count" else cls(0)
+            results.append(ks.aggregate(inst, name=f"sql-{agg.fn}"))
+        combined = results[0]
+        for extra in results[1:]:
+            n = len(combined.schema[1])
+            combined = combined.join_index(
+                extra, lambda k, a, b: (k, (*a, *b)),
+                tuple(key_dts),
+                (*combined.schema[1], *extra.schema[1]), name="sql-aggjoin")
+
+        # order output columns as selected: group cols come from the key
+        agg_positions = {pos: i for i, (pos, _) in enumerate(aggs)}
+
+        def finalize(k, v):
+            outs = []
+            for pos, item in enumerate(ast.items):
+                if pos in agg_positions:
+                    outs.append(v[agg_positions[pos]])
+                else:
+                    outs.append(k[group_idx.index(
+                        scope.index_of(item.expr))])
+            return tuple(outs), ()
+
+        out_dts = []
+        for pos, item in enumerate(ast.items):
+            if pos in agg_positions:
+                out_dts.append(jnp.int64)
+            else:
+                out_dts.append(scope.dtypes[scope.index_of(item.expr)])
+        return combined.map_rows(finalize, tuple(out_dts), (),
+                                 name="sql-finalize")

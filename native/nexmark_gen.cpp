@@ -1,0 +1,169 @@
+// Native Nexmark event generator — the data-loader hot path.
+//
+// The reference's generator is native Rust
+// (crates/nexmark/src/generator/mod.rs); this is the C++ equivalent for the
+// TPU engine's host side: columnar output, stateless splitmix64 randomness
+// keyed by absolute event index (bit-identical to the Python/numpy
+// implementation in dbsp_tpu/nexmark/generator.py, which is the test
+// oracle), OpenMP-parallel across the event range.
+//
+// C ABI: caller allocates column buffers sized via nx_counts(); generation
+// fills persons/auctions/bids columns for events [n0, n1).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int64_t PERSON_PROPORTION = 1;
+constexpr int64_t AUCTION_PROPORTION = 3;
+constexpr int64_t PROPORTION_DENOMINATOR = 50;
+constexpr int64_t FIRST_PERSON_ID = 1000;
+constexpr int64_t FIRST_AUCTION_ID = 1000;
+constexpr int64_t FIRST_CATEGORY_ID = 10;
+constexpr int64_t NUM_CATEGORIES = 5;
+
+struct Config {
+  int64_t seed;
+  int64_t base_time_ms;
+  int64_t first_event_rate;
+  int64_t hot_auction_pm;    // per-mille (compared against r % 1000)
+  int64_t hot_bidder_pm;
+  int64_t hot_window;
+  int64_t num_channels;
+  int64_t num_name_codes;
+  int64_t num_city_codes;
+  int64_t num_state_codes;
+  int64_t expire_min_ms;
+  int64_t expire_max_ms;
+};
+
+inline uint64_t mix64(uint64_t seed, uint64_t x) {
+  uint64_t z = x + seed * 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+// draw j in [0, 5) for event n, top-31-bit form matching the numpy oracle
+inline int64_t r32(const Config& c, int64_t n, int j) {
+  return static_cast<int64_t>(mix64(c.seed, n * 8 + j) >> 33);
+}
+
+inline int64_t timestamp_ms(const Config& c, int64_t n) {
+  int64_t step_ns = 1000000000ll / c.first_event_rate;
+  return c.base_time_ms + (n * step_ns) / 1000000ll;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of person/auction/bid events in [0, n)
+void nx_counts(int64_t n0, int64_t n1, int64_t* np, int64_t* na,
+               int64_t* nb) {
+  auto person_count = [](int64_t n) {
+    int64_t ep = n / PROPORTION_DENOMINATOR, off = n % PROPORTION_DENOMINATOR;
+    return ep + (off < PERSON_PROPORTION ? off : PERSON_PROPORTION);
+  };
+  auto auction_count = [](int64_t n) {
+    int64_t ep = n / PROPORTION_DENOMINATOR, off = n % PROPORTION_DENOMINATOR;
+    int64_t extra = off - PERSON_PROPORTION;
+    if (extra < 0) extra = 0;
+    if (extra > AUCTION_PROPORTION) extra = AUCTION_PROPORTION;
+    return ep * AUCTION_PROPORTION + extra;
+  };
+  *np = person_count(n1) - person_count(n0);
+  *na = auction_count(n1) - auction_count(n0);
+  *nb = (n1 - n0) - *np - *na;
+}
+
+// Fill columns for events [n0, n1). Buffer sizes from nx_counts.
+void nx_generate(
+    const Config* cfg, int64_t n0, int64_t n1,
+    // persons: id, name, city, state, email, date_time
+    int64_t* p_id, int32_t* p_name, int32_t* p_city, int32_t* p_state,
+    int32_t* p_email, int64_t* p_date,
+    // auctions: id, item, seller, category, initial_bid, reserve,
+    //           date_time, expires
+    int64_t* a_id, int32_t* a_item, int64_t* a_seller, int64_t* a_category,
+    int64_t* a_initial, int64_t* a_reserve, int64_t* a_date, int64_t* a_exp,
+    // bids: auction, bidder, price, channel, date_time
+    int64_t* b_auction, int64_t* b_bidder, int64_t* b_price,
+    int32_t* b_channel, int64_t* b_date) {
+  const Config& c = *cfg;
+  int64_t pi = 0, ai = 0, bi = 0;
+  for (int64_t n = n0; n < n1; ++n) {
+    int64_t ep = n / PROPORTION_DENOMINATOR;
+    int64_t off = n % PROPORTION_DENOMINATOR;
+    int64_t ts = timestamp_ms(c, n);
+    int64_t r0 = r32(c, n, 0), r1 = r32(c, n, 1), r2 = r32(c, n, 2),
+            r3 = r32(c, n, 3), r4 = r32(c, n, 4);
+    if (off < PERSON_PROPORTION) {
+      p_id[pi] = FIRST_PERSON_ID + ep;
+      p_name[pi] = static_cast<int32_t>(r0 % c.num_name_codes);
+      p_city[pi] = static_cast<int32_t>(r1 % c.num_city_codes);
+      p_state[pi] = static_cast<int32_t>(r2 % c.num_state_codes);
+      p_email[pi] = static_cast<int32_t>(r3 % c.num_name_codes);
+      p_date[pi] = ts;
+      ++pi;
+    } else if (off < PERSON_PROPORTION + AUCTION_PROPORTION) {
+      int64_t max_person = ep > 0 ? ep : 0;
+      bool hot = (r0 % 1000) < c.hot_bidder_pm;  // sellers are persons
+      int64_t recent = max_person - c.hot_window;
+      if (recent < 0) recent = 0;
+      int64_t span_hot = max_person - recent + 1;
+      if (span_hot < 1) span_hot = 1;
+      int64_t span_all = max_person + 1;
+      if (span_all < 1) span_all = 1;
+      int64_t seller_idx = hot ? recent + r1 % span_hot : r1 % span_all;
+      int64_t price0 = 1 + r2 % 10000;
+      int64_t span = c.expire_max_ms - c.expire_min_ms;
+      a_id[ai] = FIRST_AUCTION_ID + ep * AUCTION_PROPORTION +
+                 (off - PERSON_PROPORTION);
+      a_item[ai] = static_cast<int32_t>(r3 % c.num_name_codes);
+      a_seller[ai] = FIRST_PERSON_ID + seller_idx;
+      a_category[ai] = FIRST_CATEGORY_ID + r4 % NUM_CATEGORIES;
+      a_initial[ai] = price0;
+      a_reserve[ai] = price0 + (r2 >> 16) % 10000;
+      a_date[ai] = ts;
+      a_exp[ai] = ts + c.expire_min_ms + r0 % span;
+      ++ai;
+    } else {
+      int64_t max_auction = (ep + 1) * AUCTION_PROPORTION - 1;
+      if (max_auction < 0) max_auction = 0;
+      int64_t max_person = ep;
+      bool hot_a = (r0 % 1000) < c.hot_auction_pm;
+      int64_t recent_a = max_auction - c.hot_window;
+      if (recent_a < 0) recent_a = 0;
+      int64_t span_a_hot = max_auction - recent_a + 1;
+      if (span_a_hot < 1) span_a_hot = 1;
+      int64_t span_a = max_auction + 1;
+      int64_t auction_idx =
+          hot_a ? recent_a + r1 % span_a_hot : r1 % span_a;
+      bool hot_b = (r2 % 1000) < c.hot_bidder_pm;
+      int64_t recent_b = max_person - c.hot_window;
+      if (recent_b < 0) recent_b = 0;
+      int64_t span_b_hot = max_person - recent_b + 1;
+      if (span_b_hot < 1) span_b_hot = 1;
+      int64_t span_b = max_person + 1;
+      if (span_b < 1) span_b = 1;
+      int64_t bidder_idx = hot_b ? recent_b + r3 % span_b_hot : r3 % span_b;
+      b_auction[bi] = FIRST_AUCTION_ID + auction_idx;
+      b_bidder[bi] = FIRST_PERSON_ID + bidder_idx;
+      // log-uniform price in [1, 1e7): exp(ln(1e7) * u16/65536) to match
+      // the numpy oracle bit-for-bit we replicate its double arithmetic
+      {
+        double u = static_cast<double>(r4 % 65536) / 65536.0;
+        double price = __builtin_exp(__builtin_log(10000000.0) * u);
+        int64_t p = static_cast<int64_t>(price);
+        b_price[bi] = p < 1 ? 1 : p;
+      }
+      b_channel[bi] = static_cast<int32_t>(r0 % c.num_channels);
+      b_date[bi] = ts;
+      ++bi;
+    }
+  }
+}
+
+}  // extern "C"

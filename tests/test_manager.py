@@ -1,0 +1,75 @@
+"""Control plane end-to-end: manager REST -> SQL pipeline -> client reads.
+
+Mirrors the reference's managed-pipeline flow (SURVEY.md §3.5) minus the
+process boundaries: create program, deploy pipeline, push data through the
+pipeline's HTTP endpoint, read the incrementally maintained view.
+"""
+
+import pytest
+
+from dbsp_tpu.client import Connection
+from dbsp_tpu.manager import PipelineManager
+
+
+@pytest.fixture()
+def manager():
+    m = PipelineManager()
+    m.start()
+    yield m
+    m.stop()
+
+
+TABLES = {
+    "bids": {"columns": ["auction", "bidder", "price"],
+             "dtypes": ["int64", "int64", "int64"],
+             "key_columns": 1},
+}
+SQL = {"by_auction":
+       "SELECT auction, COUNT(*) AS n, MAX(price) AS hi FROM bids "
+       "GROUP BY auction"}
+
+
+def test_manager_end_to_end(manager, tmp_path):
+    conn = Connection(port=manager.port)
+    conn.create_program("auction_stats", TABLES, SQL)
+    assert conn.programs() == ["auction_stats"]
+
+    pipe = conn.start_pipeline("p1", "auction_stats")
+    assert pipe.status()["state"] == "running"
+
+    pipe.push("bids", [[1, 10, 100], [1, 11, 250], [2, 12, 300]])
+    pipe.step()
+    assert pipe.read("by_auction") == {(1, 2, 250): 1, (2, 1, 300): 1}
+
+    # retraction via the delete envelope
+    pipe.push("bids", [[1, 11, 250]], deletes=True)
+    pipe.step()
+    assert pipe.read("by_auction") == {(1, 1, 100): 1, (2, 1, 300): 1}
+
+    assert "dbsp_steps" in pipe.metrics()
+    assert any(op["name"].startswith("sql-")
+               for op in pipe.profile()["operators"])
+
+    assert manager.pipelines["p1"].describe()["status"] == "running"
+    conn.shutdown_pipeline("p1")
+    assert conn.pipelines()[0]["status"] == "shutdown"
+
+
+def test_manager_bad_program_is_api_error(manager):
+    conn = Connection(port=manager.port)
+    conn.create_program("bad", TABLES, {"v": "SELECT nope FROM bids"})
+    with pytest.raises(RuntimeError, match="unknown column"):
+        conn.start_pipeline("p2", "bad")
+
+
+def test_program_persistence(tmp_path):
+    path = str(tmp_path / "programs.json")
+    m = PipelineManager(storage_path=path)
+    m.start()
+    conn = Connection(port=m.port)
+    conn.create_program("saved", TABLES, SQL)
+    m.stop()
+    m2 = PipelineManager(storage_path=path)
+    m2.start()
+    assert Connection(port=m2.port).programs() == ["saved"]
+    m2.stop()

@@ -1,0 +1,252 @@
+"""Nexmark q6/q9/q12-q22 + topk operator vs Python oracles."""
+
+import random
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit import RootCircuit
+from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator, build_inputs,
+                              queries)
+from dbsp_tpu.operators import add_input_zset
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return NexmarkGenerator(GeneratorConfig(seed=13, first_event_rate=200))
+
+
+def run_accumulated(build_query, gen, n_events=5000, steps=4):
+    def build(c):
+        (p, a, b), handles = build_inputs(c)
+        return handles, build_query(p, a, b).output()
+
+    circuit, (handles, out) = RootCircuit.build(build)
+    per = n_events // steps
+    accum = {}
+    for i in range(steps):
+        gen.feed(handles, i * per, (i + 1) * per)
+        circuit.step()
+        for r, w in out.to_dict().items():
+            accum[r] = accum.get(r, 0) + w
+            if accum[r] == 0:
+                del accum[r]
+    return accum
+
+
+# ---------------------------------------------------------------------------
+# topk operator
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("largest", [True, False])
+@pytest.mark.parametrize("seed", range(2))
+def test_topk_matches_oracle(largest, seed):
+    rng = random.Random(seed)
+
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [jnp.int64, jnp.int64])
+        return h, s.topk(3, largest=largest).integrate().output()
+
+    circuit, (h, out) = RootCircuit.build(build)
+    state = {}
+    for tick in range(6):
+        for _ in range(rng.randrange(0, 10)):
+            row = (rng.randrange(4), rng.randrange(20), rng.randrange(5))
+            if row in state and rng.random() < 0.3:
+                h.push(row, -1)
+                del state[row]
+            else:
+                h.push(row, 1)
+                state[row] = 1
+        circuit.step()
+        want = {}
+        for key in {r[0] for r in state}:
+            grp = sorted([r[1:] for r in state if r[0] == key],
+                         reverse=largest)[:3]
+            for v in grp:
+                want[(key, *v)] = 1
+        assert out.to_dict() == want, f"tick {tick}"
+
+
+# ---------------------------------------------------------------------------
+# query oracles
+# ---------------------------------------------------------------------------
+
+
+def winning_bids_oracle(cols):
+    a, b = cols["auctions"], cols["bids"]
+    ainfo = {int(a["id"][i]): (int(a["seller"][i]), int(a["date_time"][i]),
+                               int(a["expires"][i]))
+             for i in range(len(a["id"]))}
+    best = {}
+    for i in range(len(b["auction"])):
+        aid = int(b["auction"][i])
+        if aid not in ainfo:
+            continue
+        seller, d0, d1 = ainfo[aid]
+        ts, price = int(b["date_time"][i]), int(b["price"][i])
+        bidder = int(b["bidder"][i])
+        if d0 <= ts <= d1:
+            cand = (price, -ts, bidder)
+            if aid not in best or cand > best[aid]:
+                best[aid] = cand
+    return {aid: (p, -nts, bd, ainfo[aid][0], ainfo[aid][2])
+            for aid, (p, nts, bd) in best.items()}
+
+
+def test_q9(gen):
+    got = run_accumulated(queries.q9, gen, 5000, 4)
+    wb = winning_bids_oracle(gen.generate(0, 5000))
+    want = {(aid, p, ts, bd): 1 for aid, (p, ts, bd, _, _) in wb.items()}
+    assert got == want and want
+
+
+def test_q6(gen):
+    got = run_accumulated(queries.q6, gen, 5000, 4)
+    wb = winning_bids_oracle(gen.generate(0, 5000))
+    per_seller = {}
+    for aid, (price, ts, bidder, seller, expires) in wb.items():
+        per_seller.setdefault(seller, []).append((expires, aid, price))
+    want = {}
+    for seller, rows in per_seller.items():
+        last10 = sorted(rows, reverse=True)[:10]
+        prices = [p for (_, _, p) in last10]
+        want[(seller, sum(prices) // len(prices))] = 1
+    assert got == want and want
+
+
+def test_q12(gen):
+    # 4 steps of 1250 events each; window = 10 ticks -> all in window 0
+    got = run_accumulated(queries.q12, gen, 5000, 4)
+    b = gen.generate(0, 5000)["bids"]
+    counts = {}
+    for i in range(len(b["bidder"])):
+        k = (int(b["bidder"][i]), 0)
+        counts[k] = counts.get(k, 0) + 1
+    want = {(bd, w, n): 1 for (bd, w), n in counts.items()}
+    assert got == want and want
+
+
+def test_q13(gen):
+    got = run_accumulated(queries.q13, gen, 3000, 3)
+    b = gen.generate(0, 3000)["bids"]
+    want = {}
+    for i in range(len(b["auction"])):
+        row = (int(b["auction"][i]), int(b["bidder"][i]), int(b["price"][i]),
+               int(b["date_time"][i]), 1000 + int(b["channel"][i]))
+        want[row] = want.get(row, 0) + 1
+    assert got == want and want
+
+
+def test_q14(gen):
+    got = run_accumulated(queries.q14, gen, 3000, 3)
+    b = gen.generate(0, 3000)["bids"]
+    want = {}
+    for i in range(len(b["auction"])):
+        eur = int(b["price"][i]) * 908 // 1000
+        if eur <= 1_000_000:
+            continue
+        hour = (int(b["date_time"][i]) // 3_600_000) % 24
+        tt = 0 if 8 <= hour < 18 else (1 if (hour < 6 or hour >= 20) else 2)
+        row = (int(b["auction"][i]), int(b["bidder"][i]), eur, tt,
+               int(b["date_time"][i]))
+        want[row] = want.get(row, 0) + 1
+    assert got == want and want
+
+
+def test_q15_q16(gen):
+    b = gen.generate(0, 4000)["bids"]
+    DAY = queries.DAY_MS
+    got15 = run_accumulated(queries.q15, gen, 4000, 4)
+    per_day = {}
+    for i in range(len(b["bidder"])):
+        per_day.setdefault(int(b["date_time"][i]) // DAY, set()).add(
+            int(b["bidder"][i]))
+    want15 = {(d, len(s)): 1 for d, s in per_day.items()}
+    assert got15 == want15 and want15
+
+    got16 = run_accumulated(queries.q16, gen, 4000, 4)
+    tot, uniq = {}, {}
+    for i in range(len(b["bidder"])):
+        k = (int(b["channel"][i]), int(b["date_time"][i]) // DAY)
+        tot[k] = tot.get(k, 0) + 1
+        uniq.setdefault(k, set()).add(int(b["bidder"][i]))
+    want16 = {(ch, d, tot[(ch, d)], len(u)): 1
+              for (ch, d), u in uniq.items()}
+    assert got16 == want16 and want16
+
+
+def test_q17(gen):
+    got = run_accumulated(queries.q17, gen, 3000, 3)
+    b = gen.generate(0, 3000)["bids"]
+    groups = {}
+    for i in range(len(b["auction"])):
+        k = (int(b["auction"][i]),
+             int(b["date_time"][i]) // queries.DAY_MS)
+        groups.setdefault(k, []).append(int(b["price"][i]))
+    want = {}
+    for (aid, d), ps in groups.items():
+        want[(aid, d, len(ps), min(ps), max(ps), sum(ps) // len(ps))] = 1
+    assert got == want and want
+
+
+def test_q18_q19(gen):
+    b = gen.generate(0, 4000)["bids"]
+    got18 = run_accumulated(queries.q18, gen, 4000, 4)
+    last = {}
+    for i in range(len(b["bidder"])):
+        bd = int(b["bidder"][i])
+        cand = (int(b["date_time"][i]), int(b["auction"][i]),
+                int(b["price"][i]))
+        if bd not in last or cand > last[bd]:
+            last[bd] = cand
+    want18 = {(bd, *v): 1 for bd, v in last.items()}
+    assert got18 == want18 and want18
+
+    got19 = run_accumulated(queries.q19, gen, 4000, 4)
+    groups = {}
+    for i in range(len(b["auction"])):
+        groups.setdefault(int(b["auction"][i]), set()).add(
+            (int(b["price"][i]), int(b["date_time"][i]),
+             int(b["bidder"][i])))
+    want19 = {}
+    for aid, rows in groups.items():
+        for v in sorted(rows, reverse=True)[:10]:
+            want19[(aid, *v)] = 1
+    assert got19 == want19 and want19
+
+
+def test_q20_q21_q22(gen):
+    cols = gen.generate(0, 3000)
+    a, b = cols["auctions"], cols["bids"]
+    got20 = run_accumulated(queries.q20, gen, 3000, 3)
+    ainfo = {int(a["id"][i]): (int(a["item"][i]), int(a["seller"][i]))
+             for i in range(len(a["id"]))
+             if a["category"][i] == queries.Q3_CATEGORY}
+    want20 = {}
+    for i in range(len(b["auction"])):
+        aid = int(b["auction"][i])
+        if aid in ainfo:
+            row = (aid, int(b["bidder"][i]), int(b["price"][i]), *ainfo[aid])
+            want20[row] = want20.get(row, 0) + 1
+    assert got20 == want20 and want20
+
+    got21 = run_accumulated(queries.q21, gen, 2000, 2)
+    want21 = {}
+    for i in range(len(b["auction"][:1840])):
+        ch = int(b["channel"][i])
+        row = (int(b["auction"][i]), int(b["bidder"][i]),
+               int(b["price"][i]), ch, ch if ch < 4 else 100 + ch)
+        want21[row] = want21.get(row, 0) + 1
+    assert got21 == want21 and want21
+
+    got22 = run_accumulated(queries.q22, gen, 2000, 2)
+    want22 = {}
+    for i in range(len(b["auction"][:1840])):
+        url = int(b["channel"][i])
+        row = (int(b["auction"][i]), int(b["bidder"][i]),
+               int(b["price"][i]), url % 7, (url // 7) % 11, (url // 77) % 13)
+        want22[row] = want22.get(row, 0) + 1
+    assert got22 == want22 and want22

@@ -104,3 +104,22 @@ def test_q2_filter_project(gen):
         for row, w in r.items():
             got[row] = got.get(row, 0) + w
     assert got == want
+
+
+def test_native_generator_bit_identical(gen):
+    # the C++ data-loader must reproduce the numpy stream exactly
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    from dbsp_tpu.nexmark import native
+
+    native.build_library()
+    for (lo, hi) in [(0, 500), (123, 987)]:
+        ours = gen.generate(lo, hi)
+        theirs = native.generate(gen.cfg, lo, hi)
+        for rel in ("persons", "auctions", "bids"):
+            for col in ours[rel]:
+                np.testing.assert_array_equal(
+                    ours[rel][col], theirs[rel][col],
+                    err_msg=f"{rel}.{col} [{lo},{hi})")

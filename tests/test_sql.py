@@ -1,0 +1,104 @@
+"""SQL frontend: parse + plan + incremental maintenance vs oracles."""
+
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit import RootCircuit
+from dbsp_tpu.operators import add_input_zset
+from dbsp_tpu.sql import SqlContext, SqlError, parse
+
+
+def setup_ctx(c):
+    bids, hb = add_input_zset(c, [jnp.int64], [jnp.int64, jnp.int64])
+    users, hu = add_input_zset(c, [jnp.int64], [jnp.int64])
+    ctx = SqlContext(c)
+    ctx.register_table("bids", bids, ["auction", "bidder", "price"])
+    ctx.register_table("users", users, ["id", "city"])
+    return ctx, hb, hu
+
+
+def run(sql, feeds, steps=1):
+    def build(c):
+        ctx, hb, hu = setup_ctx(c)
+        return hb, hu, ctx.query(sql).integrate().output()
+
+    circuit, (hb, hu, out) = RootCircuit.build(build)
+    for feed_b, feed_u in feeds:
+        hb.extend(feed_b)
+        hu.extend(feed_u)
+        circuit.step()
+    return out.to_dict()
+
+
+BIDS = [((1, 10, 100), 1), ((1, 11, 250), 1), ((2, 10, 50), 1),
+        ((2, 12, 300), 2), ((3, 13, 75), 1)]
+USERS = [((10, 7), 1), ((11, 7), 1), ((12, 8), 1)]
+
+
+def test_parse_roundtrip():
+    ast = parse("SELECT a.x, COUNT(*) AS n FROM t a JOIN s ON a.x = s.y "
+                "WHERE a.x > 3 AND s.z <> 1 GROUP BY a.x")
+    assert ast.join.name == "s" and ast.group_by[0].name == "x"
+    with pytest.raises(SyntaxError):
+        parse("SELECT FROM t")
+
+
+def test_select_where_projection():
+    got = run("SELECT auction, price * 2 FROM bids WHERE price >= 100",
+              [(BIDS, [])])
+    assert got == {(1, 200): 1, (1, 500): 1, (2, 600): 2}
+
+
+def test_select_star_and_distinct():
+    got = run("SELECT DISTINCT auction FROM bids", [(BIDS, [])])
+    assert got == {(1,): 1, (2,): 1, (3,): 1}
+
+
+def test_group_by_aggregates():
+    got = run("SELECT auction, COUNT(*) AS n, SUM(price) AS total, "
+              "MAX(price) AS hi FROM bids GROUP BY auction",
+              [(BIDS, [])])
+    assert got == {(1, 2, 350, 250): 1, (2, 3, 650, 300): 1,
+                   (3, 1, 75, 75): 1}
+
+
+def test_global_aggregate():
+    got = run("SELECT COUNT(*), MIN(price) FROM bids", [(BIDS, [])])
+    assert got == {(6, 50): 1}  # 6 = total multiplicity (one bid has weight 2)
+
+
+def test_join_with_where():
+    got = run("SELECT bids.auction, users.city FROM bids "
+              "JOIN users ON bidder = id WHERE price > 60",
+              [(BIDS, USERS)])
+    # bids with price>60 and a matching user: (1,10,100),(1,11,250),(2,12,300)x2
+    assert got == {(1, 7): 2, (2, 8): 2}
+
+
+def test_incremental_maintenance_with_retraction():
+    sql = "SELECT auction, COUNT(*) AS n FROM bids GROUP BY auction"
+
+    def build(c):
+        ctx, hb, hu = setup_ctx(c)
+        return hb, ctx.query(sql).integrate().output()
+
+    circuit, (hb, out) = RootCircuit.build(build)
+    hb.extend(BIDS)
+    circuit.step()
+    assert out.to_dict() == {(1, 2): 1, (2, 3): 1, (3, 1): 1}
+    hb.push((2, 12, 300), -2)  # retract the double bid
+    circuit.step()
+    assert out.to_dict() == {(1, 2): 1, (2, 1): 1, (3, 1): 1}
+    hb.push((3, 13, 75), -1)  # group disappears entirely
+    circuit.step()
+    assert out.to_dict() == {(1, 2): 1, (2, 1): 1}
+
+
+def test_errors():
+    with pytest.raises(SqlError, match="unknown column"):
+        run("SELECT nope FROM bids", [(BIDS, [])])
+    with pytest.raises(SqlError, match="unknown table"):
+        run("SELECT x FROM nope", [(BIDS, [])])
+    with pytest.raises(SqlError, match="GROUP BY"):
+        run("SELECT bidder, COUNT(*) FROM bids GROUP BY auction",
+            [(BIDS, [])])
