@@ -1,4 +1,4 @@
-from dbsp_tpu.timeseries import watermark, window  # noqa: F401  (register)
+from dbsp_tpu.timeseries import rolling, watermark, window  # noqa: F401  (register)
 from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
 from dbsp_tpu.timeseries.window import WindowOp
 

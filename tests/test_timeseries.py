@@ -176,3 +176,48 @@ def test_q8(gen):
             want[(k[0], k[1], pwin[k])] = 1
     assert got == want
     assert want
+
+
+def oracle_rolling(state, agg, rng_ms):
+    # state: {(p, t, v): w>0}; output {(p,t, agg over [t-rng, t]): 1}
+    out = {}
+    rows = [(p, t, v) for (p, t, v), w in state.items() for _ in range(w)]
+    for (p, t, _v) in set((p, t, None) for (p, t, v) in rows):
+        vals = [v for (p2, t2, v) in rows if p2 == p and t - rng_ms <= t2 <= t]
+        if agg == "sum":
+            out[(p, t, sum(vals))] = 1
+        elif agg == "max":
+            out[(p, t, max(vals))] = 1
+        elif agg == "count":
+            out[(p, t, len(vals))] = 1
+    return out
+
+
+@pytest.mark.parametrize("agg_name", ["sum", "max", "count"])
+def test_partitioned_rolling_aggregate(agg_name):
+    import random as _random
+
+    from dbsp_tpu.operators import Count, Max, Sum
+
+    aggs = {"sum": Sum(0), "max": Max(0), "count": Count()}
+    rng = _random.Random(5)
+
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64, jnp.int64], [jnp.int64])
+        roll = s.partitioned_rolling_aggregate(aggs[agg_name], 100)
+        return h, roll.integrate().output()
+
+    circuit, (h, out) = RootCircuit.build(build)
+    state = {}
+    for tick in range(6):
+        for _ in range(rng.randrange(1, 8)):
+            row = (rng.randrange(3), rng.randrange(0, 400), rng.randrange(10))
+            if row in state and rng.random() < 0.35:
+                h.push(row, -1)
+                del state[row]
+            elif row not in state:  # keep oracle weights in lockstep
+                h.push(row, 1)
+                state[row] = 1
+        circuit.step()
+        assert out.to_dict() == oracle_rolling(state, agg_name, 100), \
+            f"{agg_name} tick {tick}"
