@@ -57,9 +57,26 @@ class PipelineHandle:
         _req(self.base + "/step", data=b"", method="POST")
 
     def read(self, view: str) -> Dict[tuple, int]:
+        """Latest tick's delta for ``view`` (the server re-serves it until
+        the next tick; use :meth:`read_new` to poll without double counting)."""
+        batch, _ = self._read_step(view)
+        return batch
+
+    def read_new(self, view: str, last_seen: int = -1
+                 ) -> tuple[Dict[tuple, int], int]:
+        """Dedup-polling read: returns ({}, last_seen) if the server still
+        serves the tick already consumed, else (delta, new_cursor). Pass the
+        returned cursor back on the next poll."""
+        batch, step = self._read_step(view)
+        if step == last_seen:
+            return {}, last_seen
+        return batch, step
+
+    def _read_step(self, view: str) -> tuple[Dict[tuple, int], int]:
         with urllib.request.urlopen(
                 f"{self.base}/output_endpoint/{view}?format=json",
                 timeout=30) as r:
+            step = int(r.headers.get("X-Dbsp-Step", -1))
             out: Dict[tuple, int] = {}
             for line in r.read().decode().splitlines():
                 if not line:
@@ -71,7 +88,7 @@ class PipelineHandle:
                 else:
                     row = tuple(obj["delete"])
                     out[row] = out.get(row, 0) - 1
-            return {r: w for r, w in out.items() if w != 0}
+            return {r: w for r, w in out.items() if w != 0}, step
 
     def start(self) -> None:
         _req(self.base + "/start", data=b"", method="POST")

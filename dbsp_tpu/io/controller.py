@@ -93,6 +93,8 @@ class _OutputEndpoint:
         self.encoder = encoder
         self.total_records = 0
         self.total_bytes = 0
+        # private delta queue: endpoints never race other handle consumers
+        self.cursor = collection.handle.register_consumer()
 
 
 class Controller:
@@ -211,7 +213,9 @@ class Controller:
         self.handle.step()
         self.steps += 1
         for out in self.outputs.values():
-            batch = out.collection.handle.take()
+            # per-consumer queue: the HTTP server's /read peeks the same
+            # handle, so a destructive take() here would race it
+            batch = out.collection.handle.read_consumer(out.cursor)
             if batch is not None and int(batch.live_count()) > 0:
                 data = out.encoder.encode(batch)
                 out.transport.write(data)

@@ -65,12 +65,28 @@ class CircuitServer:
                     except KeyError as e:
                         return self._json({"error": str(e)}, 404)
                     fmt = parse_qs(url.query).get("format", ["json"])[0]
+                    # non-destructive sample of the latest tick's delta; the
+                    # X-Dbsp-Step tick id lets pollers dedup repeats (the
+                    # same delta is re-served until the next tick). Read the
+                    # id BEFORE the batch: if a tick lands between the two
+                    # reads the new batch is served under the old id, which
+                    # errs toward a duplicate delivery (dedup handles it)
+                    # instead of a skipped delta.
+                    step = str(col.handle.step_id)
                     batch = col.handle.peek()
                     if batch is None:
-                        self._reply(200, b"")
+                        self.send_response(200)
+                        self.send_header("X-Dbsp-Step", step)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
                     else:
-                        self._reply(200, OUTPUT_FORMATS[fmt]().encode(batch),
-                                    "text/plain")
+                        body = OUTPUT_FORMATS[fmt]().encode(batch)
+                        self.send_response(200)
+                        self.send_header("X-Dbsp-Step", step)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                 else:
                     self._json({"error": f"no route {route}"}, 404)
 

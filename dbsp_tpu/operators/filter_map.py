@@ -33,6 +33,19 @@ RowFn = Callable[[Cols, Cols], Tuple[Cols, Cols]]
 PredFn = Callable[[Cols, Cols], jnp.ndarray]
 
 
+def _pin_schema(nk: Cols, nv: Cols, out_schema, name: str
+                ) -> Tuple[Cols, Cols]:
+    """Cast transform outputs to the declared (key_dtypes, val_dtypes) so
+    downstream spines/probes never see drifted dtypes (silent truncation in
+    lex_probe was the failure mode)."""
+    kd, vd = out_schema
+    assert len(nk) == len(kd) and len(nv) == len(vd), (
+        f"{name}: transform arity ({len(nk)},{len(nv)}) != "
+        f"declared schema arity ({len(kd)},{len(vd)})")
+    return (tuple(c.astype(d) for c, d in zip(nk, kd)),
+            tuple(c.astype(d) for c, d in zip(nv, vd)))
+
+
 class MapOp(UnaryOperator):
     """Per-row transform + re-consolidation (transforms may collide rows).
 
@@ -44,15 +57,18 @@ class MapOp(UnaryOperator):
     """
 
     def __init__(self, fn: RowFn, name: str = "map",
-                 preserves_order: bool = False):
+                 preserves_order: bool = False, out_schema=None):
         self.fn = fn
         self.name = name
         self.preserves_order = preserves_order
+        self.out_schema = out_schema  # (key_dtypes, val_dtypes) or None
 
         @jax.jit
         def kernel(batch: Batch) -> Batch:
             nk, nv = fn(batch.keys, batch.vals)
             nk, nv = tuple(nk), tuple(nv)
+            if out_schema is not None:
+                nk, nv = _pin_schema(nk, nv, out_schema, name)
             if self.preserves_order:
                 # sort-free consolidation: inputs are sorted and the map is
                 # monotone, so equal output rows are adjacent (dead rows got
@@ -107,14 +123,19 @@ class FlatMapOp(UnaryOperator):
     reference's unbounded per-record iterators.
     """
 
-    def __init__(self, fn, fanout: int, name: str = "flat_map"):
+    def __init__(self, fn, fanout: int, name: str = "flat_map",
+                 out_schema=None):
         self.fn = fn
         self.fanout = fanout
         self.name = name
+        self.out_schema = out_schema
 
         @jax.jit
         def kernel(batch: Batch) -> Batch:
             nk, nv, keep = fn(batch.keys, batch.vals)
+            nk, nv = tuple(nk), tuple(nv)
+            if out_schema is not None:
+                nk, nv = _pin_schema(nk, nv, out_schema, name)
             cap = batch.cap
             f = fanout
             w = jnp.broadcast_to(batch.weights, (f, cap))
@@ -141,9 +162,12 @@ def _set_schema(s: Stream, key_dtypes, val_dtypes) -> Stream:
 @stream_method
 def map_rows(self: Stream, fn: RowFn, key_dtypes, val_dtypes=(),
              name: str = "map", preserves_order: bool = False) -> Stream:
-    """General columnar map; declares the output schema."""
+    """General columnar map; declares the output schema (transform outputs
+    are cast to it, so declared and device dtypes cannot drift)."""
     out = self.circuit.add_unary_operator(
-        MapOp(fn, name, preserves_order), self)
+        MapOp(fn, name, preserves_order,
+              out_schema=(tuple(jnp.dtype(d) for d in key_dtypes),
+                          tuple(jnp.dtype(d) for d in val_dtypes))), self)
     return _set_schema(out, key_dtypes, val_dtypes)
 
 
@@ -157,7 +181,10 @@ def filter_rows(self: Stream, pred: PredFn, name: str = "filter") -> Stream:
 @stream_method
 def flat_map_rows(self: Stream, fn, fanout: int, key_dtypes, val_dtypes=(),
                   name: str = "flat_map") -> Stream:
-    out = self.circuit.add_unary_operator(FlatMapOp(fn, fanout, name), self)
+    out = self.circuit.add_unary_operator(
+        FlatMapOp(fn, fanout, name,
+                  out_schema=(tuple(jnp.dtype(d) for d in key_dtypes),
+                              tuple(jnp.dtype(d) for d in val_dtypes))), self)
     return _set_schema(out, key_dtypes, val_dtypes)
 
 

@@ -68,16 +68,36 @@ class InputHandle:
 class OutputOperator(SinkOperator):
     name = "output"
 
+    # lagging consumers coalesce their backlog past this many queued deltas
+    MAX_QUEUED = 256
+
     def __init__(self):
         self.current: Optional[Batch] = None
+        self.step_id = 0  # monotone tick counter (lets HTTP readers dedup)
+        self._consumers: Dict[int, List[Batch]] = {}
+        self._next_cid = 0
 
     def eval(self, v: Batch) -> None:
         self.current = v
+        self.step_id += 1
+        for q in self._consumers.values():
+            q.append(v)
+            if len(q) > self.MAX_QUEUED:
+                # Z-set deltas compose additively, so a backlog coalesces to
+                # their sum without losing information
+                q[:] = [concat_batches(q).consolidate().shrink_to_fit()]
 
 
 class OutputHandle:
     """Reads the value a stream produced in the latest step (reference:
-    ``OutputHandle::take_from_all/consolidate``, output.rs:173-219)."""
+    ``OutputHandle::take_from_all/consolidate``, output.rs:173-219).
+
+    Multiple consumers (e.g. an output transport endpoint AND the HTTP
+    server's ``/read``) must not share the destructive :meth:`take`: each
+    should :meth:`register_consumer` and poll :meth:`read_consumer`, which
+    delivers every delta exactly once per consumer (a slow consumer gets
+    the Z-set sum of everything it missed, never a gap).
+    """
 
     def __init__(self, op: OutputOperator):
         self._op = op
@@ -88,6 +108,27 @@ class OutputHandle:
 
     def peek(self) -> Optional[Batch]:
         return self._op.current
+
+    @property
+    def step_id(self) -> int:
+        """Tick counter of the latest produced batch."""
+        return self._op.step_id
+
+    def register_consumer(self) -> int:
+        cid = self._op._next_cid
+        self._op._next_cid += 1
+        self._op._consumers[cid] = []
+        return cid
+
+    def read_consumer(self, cid: int) -> Optional[Batch]:
+        """Drain this consumer's pending deltas (coalesced into one batch)."""
+        q = self._op._consumers[cid]
+        if not q:
+            return None
+        out = q[0] if len(q) == 1 else \
+            concat_batches(q).consolidate().shrink_to_fit()
+        q.clear()
+        return out
 
     def to_dict(self) -> Dict[Row, int]:
         v = self._op.current

@@ -82,11 +82,17 @@ def _eval(expr, scope: _Scope, cols):
             return a - b
         if op == "*":
             return a * b
-        if op == "/":
-            return a // b if jnp.issubdtype(jnp.result_type(a, b),
-                                            jnp.integer) else a / b
-        if op == "%":
-            return a % b
+        if op in ("/", "%"):
+            if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+                # SQL/reference semantics: division truncates toward zero
+                # (-7/2 == -3) and % is the matching remainder (-7%2 == -1),
+                # so a == (a/b)*b + a%b holds — unlike Python/JAX floored
+                # //+%; matches the Average aggregator's truncating reduce
+                q = a // b
+                r = a - q * b
+                q = jnp.where((r != 0) & ((a < 0) != (b < 0)), q + 1, q)
+                return q if op == "/" else a - q * b
+            return a / b if op == "/" else a % b
         if op == "=":
             return a == b
         if op in ("<>", "!="):
@@ -162,7 +168,10 @@ class SqlContext:
             lcol, rcol = rcol, lcol
             li = ls.index_of(lcol)
         ri = rs.index_of(rcol)
-        key_dt = ls.dtypes[li]
+        # promote mixed-dtype ON columns to one key dtype; index_by/map_rows
+        # cast their outputs to the declared schema, so both traces carry the
+        # same key dtype and lex_probe never truncates probe keys
+        key_dt = jnp.result_type(ls.dtypes[li], rs.dtypes[ri])
 
         def rekey(idx, n):
             def key_fn(k, v, _i=idx):
