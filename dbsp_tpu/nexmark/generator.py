@@ -210,22 +210,28 @@ class NexmarkGenerator:
 
         cols = self.generate_fast(n0, n1)
         hp, ha, hb = handles
+        # persons/auctions arrive sorted by their dense monotone id with
+        # weight 1 — already consolidated, no sort needed on either side of
+        # the push; bids are keyed by (random) auction id and do need one
         p = cols["persons"]
         if len(p["id"]):
             hp.push_batch(Batch.from_columns(
                 [p["id"]], [p["name"], p["city"], p["state"], p["email"],
                             p["date_time"]],
-                np.ones(len(p["id"]), np.int64)))
+                np.ones(len(p["id"]), np.int64), consolidated=True),
+                consolidated=True)
         a = cols["auctions"]
         if len(a["id"]):
             ha.push_batch(Batch.from_columns(
                 [a["id"]], [a["item"], a["seller"], a["category"],
                             a["initial_bid"], a["reserve"], a["date_time"],
                             a["expires"]],
-                np.ones(len(a["id"]), np.int64)))
+                np.ones(len(a["id"]), np.int64), consolidated=True),
+                consolidated=True)
         b = cols["bids"]
         if len(b["auction"]):
+            # from_columns consolidates (sorts by auction id) by default
             hb.push_batch(Batch.from_columns(
                 [b["auction"]], [b["bidder"], b["price"], b["channel"],
                                  b["date_time"]],
-                np.ones(len(b["auction"]), np.int64)))
+                np.ones(len(b["auction"]), np.int64)), consolidated=True)

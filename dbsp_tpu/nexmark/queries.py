@@ -14,7 +14,11 @@ import jax.numpy as jnp
 
 from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.nexmark import model as M
-from dbsp_tpu.operators.aggregate import Average, Count, Max, Min  # noqa: F401
+from dbsp_tpu.operators.aggregate import Max, Min  # noqa: F401
+# Count/Average take the linear fast path (delta segment-sums, no input
+# trace); Min/Max need the general group-gather path
+from dbsp_tpu.operators.aggregate_linear import (  # noqa: F401
+    LinearAverage as Average, LinearCount as Count)
 
 
 def q0(persons: Stream, auctions: Stream, bids: Stream) -> Stream:

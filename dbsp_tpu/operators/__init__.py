@@ -4,14 +4,17 @@ operator extension traits into scope."""
 
 from dbsp_tpu.operators import (  # noqa: F401  (Stream-method registration)
     aggregate, basic, distinct, filter_map, io_handles, join, recursive,
-    semijoin, topk, trace_op, upsert, z1)
+    semijoin, shard_op, topk, trace_op, upsert, z1)
 import dbsp_tpu.timeseries  # noqa: F401, E402  (register window/watermark)
-from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
+from dbsp_tpu.operators.aggregate import Average, Count, Fold, Max, Min, Sum
+from dbsp_tpu.operators.aggregate_linear import (LinearAverage, LinearCount,
+                                                 LinearSum)
 from dbsp_tpu.operators.basic import Generator
 from dbsp_tpu.operators.io_handles import InputHandle, OutputHandle, add_input_zset
 from dbsp_tpu.operators.upsert import UpsertHandle, add_input_map, add_input_set
 from dbsp_tpu.operators.z1 import Z1
 
 __all__ = ["Generator", "InputHandle", "OutputHandle", "add_input_zset", "Z1",
-           "Count", "Sum", "Min", "Max", "Average",
+           "Count", "Sum", "Min", "Max", "Average", "Fold",
+           "LinearCount", "LinearSum", "LinearAverage",
            "UpsertHandle", "add_input_map", "add_input_set"]

@@ -33,8 +33,10 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.parallel.lift import lifted
 from dbsp_tpu.circuit.operator import UnaryOperator
 from dbsp_tpu.operators.registry import stream_method
 from dbsp_tpu.operators.trace_op import TraceView
@@ -134,13 +136,34 @@ class Average(Aggregator):
         return (jnp.where(s >= 0, s // c, -((-s) // c)),)
 
 
+@dataclasses.dataclass(frozen=True)
+class Fold(Aggregator):
+    """General user-defined aggregation (reference: ``aggregate/fold.rs:25``).
+
+    ``reduce_fn(val_cols, weights, seg, num_segments) -> out_cols`` is any
+    segment reduction over the gathered group rows (rows with net weight
+    <= 0 must be ignored by masking on ``weights > 0``, exactly like the
+    built-ins). Example — sum of squares:
+
+        Fold(lambda v, w, s, n: (segment_sum(v[0]**2 * maximum(w, 0), s, n),),
+             out_dtypes=(jnp.int64,))
+    """
+
+    reduce_fn: Callable = None
+    out_dtypes: Tuple = (jnp.int64,)
+    name: str = "fold"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        return tuple(self.reduce_fn(val_cols, weights, seg, num_segments))
+
+
 # ---------------------------------------------------------------------------
 # Kernels
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("nk",))
-def _unique_keys(delta: Batch, nk: int) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
+def _unique_keys_impl(delta: Batch, nk: int
+                      ) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
     """Distinct live keys of a consolidated batch, compacted to the front.
 
     Returns (key_cols, live_mask) at the delta's capacity.
@@ -152,13 +175,45 @@ def _unique_keys(delta: Batch, nk: int) -> Tuple[Tuple[jnp.ndarray, ...], jnp.nd
     return cols, w != 0
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _gather_level(qkeys: Tuple[jnp.ndarray, ...], qlive: jnp.ndarray,
-                  level: Batch, out_cap: int):
+_unique_keys_jit = jax.jit(_unique_keys_impl, static_argnames=("nk",))
+
+
+def _unique_keys_factory(nk: int):
+    return lambda d: _unique_keys_impl(d, nk)
+
+
+def _unique_keys(delta: Batch, nk: int):
+    """Distinct live keys + live mask, re-bucketed to the distinct-key count.
+
+    The trim (one scalar sync) is what keeps aggregation cost proportional
+    to TOUCHED KEYS, not delta capacity: a 64k-cap delta over 16 groups
+    would otherwise drag 64k-sized gathers/diffs through the whole eval.
+    """
+    if delta.sharded:
+        qkeys, qlive = lifted(_unique_keys_factory, nk)(delta)
+        nq = int(jnp.max(jnp.sum(qlive, axis=-1)))
+    else:
+        qkeys, qlive = _unique_keys_jit(delta, nk)
+        nq = int(jnp.sum(qlive))
+    cap = bucket_cap(max(nq, 1))
+    if cap < qlive.shape[-1]:
+        qkeys = tuple(k[..., :cap] for k in qkeys)
+        qlive = qlive[..., :cap]
+    return qkeys, qlive
+
+
+def _gather_level_impl(qkeys: Tuple[jnp.ndarray, ...], qlive: jnp.ndarray,
+                       level: Batch, out_cap: int):
     """Expand one spine level's matching rows for the query keys.
 
-    Returns (qrow ids, gathered val cols, weights, total)."""
+    Returns (qrow ids, gathered val cols, weights, total). The output is
+    SORTED by (qrow, vals): expansion follows query order and each group's
+    rows keep the level's (key, vals) order; dead slots carry qrow ==
+    q_cap (the trash segment) + sentinel vals, so they sort last. That
+    ordering is what lets cross-level results combine with a rank-merge
+    instead of a sort."""
     nk = len(qkeys)
+    q_cap = qkeys[0].shape[0]
     lo = kernels.lex_probe(level.keys[:nk], qkeys, side="left")
     hi = kernels.lex_probe(level.keys[:nk], qkeys, side="right")
     lo = jnp.where(qlive, lo, 0)
@@ -167,54 +222,109 @@ def _gather_level(qkeys: Tuple[jnp.ndarray, ...], qlive: jnp.ndarray,
     w = jnp.where(valid, level.weights[src], 0)
     vals = tuple(jnp.where(valid, c[src], kernels.sentinel_for(c.dtype))
                  for c in level.vals)
-    qrow = jnp.where(valid, row, jnp.int32(-1))
+    qrow = jnp.where(valid, row, jnp.int32(q_cap))
     return qrow, vals, w, total
+
+
+_gather_level = jax.jit(_gather_level_impl, static_argnames=("out_cap",))
+
+
+def _gather_level_factory(out_cap: int):
+    return lambda qk, ql, lvl: _gather_level_impl(qk, ql, lvl, out_cap)
 
 
 class GroupGather:
     """Host driver: gather the full groups of the query keys across all spine
-    levels, with per-level grow-on-demand output capacities."""
+    levels, with per-level grow-on-demand output capacities. All levels
+    launch before one batched overflow check (a single host sync per eval,
+    not one per level)."""
 
     def __init__(self):
         self.caps: Dict[int, int] = {}
 
+    @staticmethod
+    def _launch(qkeys, qlive, level, cap):
+        if qlive.ndim > 1:  # sharded query set
+            return lifted(_gather_level_factory, cap)(qkeys, qlive, level)
+        return _gather_level(qkeys, qlive, level, cap)
+
     def __call__(self, qkeys, qlive, levels: Sequence[Batch], q_cap: int):
-        rows, vals, ws = [], [], []
+        """Returns a list of per-level (qrow, val_cols, w) parts, or None."""
+        parts, totals, caps = [], [], []
         for level in levels:
             cap = self.caps.get(level.cap, max(64, q_cap))
-            qrow, v, w, total = _gather_level(qkeys, qlive, level, cap)
-            t = int(total)
-            if t > cap:
-                cap = bucket_cap(t)
-                self.caps[level.cap] = cap
-                qrow, v, w, total = _gather_level(qkeys, qlive, level, cap)
-            rows.append(qrow)
-            vals.append(v)
-            ws.append(w)
-        if not rows:
+            qrow, v, w, total = self._launch(qkeys, qlive, level, cap)
+            parts.append((qrow, v, w))
+            totals.append(total)
+            caps.append(cap)
+        if not parts:
             return None
-        qrow = jnp.concatenate(rows)
-        val_cols = tuple(jnp.concatenate([v[i] for v in vals])
-                         for i in range(len(vals[0])))
-        w = jnp.concatenate(ws)
-        return qrow, val_cols, w
+        for i, t in enumerate(jax.device_get(totals)):  # ONE sync for all
+            t = int(np.max(t))  # per-worker totals for sharded runs
+            if t > caps[i]:
+                cap = bucket_cap(t)
+                self.caps[levels[i].cap] = cap
+                qrow, v, w, _ = self._launch(qkeys, qlive, levels[i], cap)
+                parts[i] = (qrow, v, w)
+        return parts
 
 
-@partial(jax.jit, static_argnames=("agg", "q_cap"))
-def _reduce_groups(qrow, val_cols, w, agg: Aggregator, q_cap: int):
-    """Net out cross-level duplicates, then run the aggregator per q segment."""
-    # consolidate on (qrow, vals): sums weights of identical rows
-    cols, w = kernels.consolidate_cols((qrow, *val_cols), w)
+def concat_parts(parts):
+    """Flatten per-level gather parts to one (qrow, val_cols, w) triple —
+    for consumers that net rows themselves (topk, upsert)."""
+    qrow = jnp.concatenate([p[0] for p in parts], axis=-1)
+    nvals = len(parts[0][1])
+    vals = tuple(jnp.concatenate([p[1][i] for p in parts], axis=-1)
+                 for i in range(nvals))
+    w = jnp.concatenate([p[2] for p in parts], axis=-1)
+    return qrow, vals, w
+
+
+def _reduce_groups_impl(parts, agg: Aggregator, q_cap: int):
+    """Net out cross-level duplicates (each part is sorted by (qrow, vals)
+    — see :func:`_gather_level`), then run the aggregator per q segment.
+
+    One gathered level needs no netting (its rows are unique); multiple
+    levels combine with one sort-consolidation on CPU or a fold of
+    rank-merges on TPU (kernels.merge_strategy)."""
+    (qrow, val_cols, w), *rest = parts
+    cols = (qrow, *val_cols)
+    if rest and kernels.merge_strategy() == "sort":
+        all_cols = tuple(
+            jnp.concatenate([p[i] if i == 0 else p[1][i - 1]
+                             for p in parts])
+            for i in range(1 + len(val_cols)))
+        all_w = jnp.concatenate([p[2] for p in parts])
+        cols, w = kernels.consolidate_cols(all_cols, all_w)
+    else:
+        for (qrow2, vals2, w2) in rest:
+            cols, w = kernels.merge_sorted_cols(cols, w, (qrow2, *vals2), w2)
     qrow, val_cols = cols[0], cols[1:]
-    seg = jnp.where(qrow >= 0, qrow, q_cap).astype(jnp.int32)
+    # dead rows carry qrow >= q_cap (q_cap marker, or int32 sentinel after
+    # a merge compaction) — clamp everything dead into the trash segment
+    seg = jnp.minimum(qrow, q_cap).astype(jnp.int32)
     outs = agg.reduce(val_cols, w, seg, q_cap + 1)
     present = jax.ops.segment_max(
         jnp.where(w > 0, 1, 0), seg, num_segments=q_cap + 1)
     return tuple(o[:q_cap] for o in outs), present[:q_cap] > 0
 
 
-@jax.jit
-def _diff_outputs(qkeys, qlive, new_vals, new_present, old_vals, old_present):
+_reduce_groups_jit = jax.jit(_reduce_groups_impl,
+                             static_argnames=("agg", "q_cap"))
+
+
+def _reduce_groups_factory(agg: Aggregator, q_cap: int):
+    return lambda parts: _reduce_groups_impl(parts, agg, q_cap)
+
+
+def _reduce_groups(parts, agg: Aggregator, q_cap: int):
+    if parts[0][2].ndim > 1:  # sharded gather parts
+        return lifted(_reduce_groups_factory, agg, q_cap)(parts)
+    return _reduce_groups_jit(parts, agg, q_cap)
+
+
+def _diff_outputs_impl(qkeys, qlive, new_vals, new_present, old_vals,
+                       old_present):
     """Build the retract/insert output delta (2*q_cap capacity)."""
     changed = jnp.zeros(qlive.shape, jnp.bool_)
     for nv, ov in zip(new_vals, old_vals):
@@ -228,6 +338,21 @@ def _diff_outputs(qkeys, qlive, new_vals, new_present, old_vals, old_present):
     w = jnp.concatenate([insert_w, retract_w]).astype(jnp.int64)
     cols, w = kernels.consolidate_cols((*keys, *vals), w)
     return cols, w
+
+
+_diff_outputs_jit = jax.jit(_diff_outputs_impl)
+
+
+def _diff_outputs_factory():
+    return _diff_outputs_impl
+
+
+def _diff_outputs(qkeys, qlive, new_vals, new_present, old_vals, old_present):
+    if qlive.ndim > 1:  # sharded
+        return lifted(_diff_outputs_factory)(
+            qkeys, qlive, new_vals, new_present, old_vals, old_present)
+    return _diff_outputs_jit(qkeys, qlive, new_vals, new_present, old_vals,
+                             old_present)
 
 
 class AggregateOp(UnaryOperator):
@@ -247,37 +372,41 @@ class AggregateOp(UnaryOperator):
             self.out_spine = Spine(self.key_dtypes, tuple(self.agg.out_dtypes))
 
     def eval(self, view: TraceView) -> Batch:
+        from dbsp_tpu.circuit.runtime import Runtime
+
         delta = view.delta
         nk = len(self.key_dtypes)
         if int(delta.live_count()) == 0:
-            return Batch.empty(*self.out_schema)
+            w = Runtime.worker_count()
+            return Batch.empty(*self.out_schema, lead=(w,) if w > 1 else ())
         qkeys, qlive = _unique_keys(delta, nk)
-        q_cap = delta.cap
+        q_cap = qlive.shape[-1]  # trimmed to distinct-key bucket
 
         gathered = self._group_gather(qkeys, qlive, view.spine.batches, q_cap)
         if gathered is None:
             new_vals = tuple(
-                jnp.zeros((q_cap,), d) for d in self.agg.out_dtypes)
-            new_present = jnp.zeros((q_cap,), jnp.bool_)
+                jnp.zeros(qlive.shape, d) for d in self.agg.out_dtypes)
+            new_present = jnp.zeros(qlive.shape, jnp.bool_)
         else:
-            new_vals, new_present = _reduce_groups(*gathered, self.agg, q_cap)
+            new_vals, new_present = _reduce_groups(tuple(gathered), self.agg,
+                                                   q_cap)
 
         old = self._old_gather(qkeys, qlive, self.out_spine.batches, q_cap)
         if old is None:
-            old_vals = tuple(
-                kernels.sentinel_fill((q_cap,), d) for d in self.agg.out_dtypes)
-            old_present = jnp.zeros((q_cap,), jnp.bool_)
+            old_vals = tuple(kernels.sentinel_fill(qlive.shape, d)
+                             for d in self.agg.out_dtypes)
+            old_present = jnp.zeros(qlive.shape, jnp.bool_)
         else:
             # previous outputs are single rows per key; Max over net-positive
             # rows reconstructs the value, presence from net weight
-            old_vals_all, old_present = _reduce_groups(
-                old[0], old[1], old[2],
-                _TupleMax(len(self.agg.out_dtypes)), q_cap)
-            old_vals = old_vals_all
+            old_vals, old_present = _reduce_groups(
+                tuple(old), _TupleMax(len(self.agg.out_dtypes)), q_cap)
 
         cols, w = _diff_outputs(qkeys, qlive, new_vals, new_present,
                                 old_vals, old_present)
-        out = Batch(cols[:nk], cols[nk:], w)
+        # re-bucket to live rows: the diff has 2*q_cap capacity but few live
+        # rows, and downstream operators inherit whatever cap we emit
+        out = Batch(cols[:nk], cols[nk:], w).shrink_to_fit()
         self.out_spine.insert(out)
         return out
 
@@ -309,15 +438,32 @@ class _TupleMax(Aggregator):
 
 
 @stream_method
-def aggregate(self: Stream, agg: Aggregator, name=None) -> Stream:
+def aggregate(self: Stream, agg, name=None) -> Stream:
     """Incremental aggregate by the stream's key columns; output is an
-    indexed Z-set (key -> aggregate value) maintained under retractions."""
+    indexed Z-set (key -> aggregate value) maintained under retractions.
+
+    A :class:`~dbsp_tpu.operators.aggregate_linear.LinearAggregator`
+    (Count/Sum/Average) dispatches to the linear fast path, which consumes
+    the raw delta stream — no input trace, delta-sized work only
+    (aggregate/mod.rs:253). Other aggregators (Min/Max/Fold) use the
+    general trace-gather path (aggregate/mod.rs:204,600)."""
+    from dbsp_tpu.operators.aggregate_linear import (LinearAggregateOp,
+                                                     LinearAggregator)
+
     schema = getattr(self, "schema", None)
     assert schema is not None, "aggregate needs stream schema metadata"
+    if isinstance(agg, LinearAggregator):
+        src = self.shard()  # co-locate keys (no-op on one worker)
+        out = src.circuit.add_unary_operator(
+            LinearAggregateOp(agg, schema[0], name), src)
+        out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+        out.key_sharded = getattr(src, "key_sharded", False)
+        return out
     t = self.trace()
     out = self.circuit.add_unary_operator(
         AggregateOp(agg, schema[0], name), t)
     out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+    out.key_sharded = getattr(t, "key_sharded", False)
     return out
 
 
@@ -332,11 +478,15 @@ def stream_aggregate(self: Stream, agg: Aggregator, name=None) -> Stream:
     op_name = name or f"stream_aggregate<{agg.name}>"
 
     def eval_fn(batch: Batch) -> Batch:
+        if batch.sharded:  # oracle path runs host-side; collapse first
+            from dbsp_tpu.parallel.exchange import unshard_batch
+
+            batch = unshard_batch(batch)
         qkeys, qlive = _unique_keys(batch, nk)
-        q_cap = batch.cap
+        q_cap = qlive.shape[-1]
         gg = GroupGather()
         gathered = gg(qkeys, qlive, [batch], q_cap)
-        new_vals, new_present = _reduce_groups(*gathered, agg, q_cap)
+        new_vals, new_present = _reduce_groups(tuple(gathered), agg, q_cap)
         w = jnp.where(qlive & new_present, 1, 0).astype(jnp.int64)
         cols, w = kernels.consolidate_cols(
             (*qkeys, *(v for v in new_vals)), w)

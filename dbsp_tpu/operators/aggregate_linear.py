@@ -1,0 +1,305 @@
+"""Linear aggregation fast path: maintain per-key accumulators from delta
+segment-sums alone — no group re-gather from the input trace.
+
+Reference: ``operator/aggregate/mod.rs:253`` (``aggregate_linear``) and
+``:287`` (``weigh``). A *linear* aggregate is one where the output is a
+function of a weight-linear accumulator:
+
+    out(key) = finalize( sum_rows weight * weigh(vals),  sum_rows weight )
+
+Count/Sum/Average are linear; Min/Max are not (a retraction can expose a
+value only the full group knows — they stay on the general gather path in
+``operators/aggregate.py``).
+
+Why this is the fast path, and TPU-native: per tick the operator needs only
+(1) a segment-sum of the (already sorted) delta by key, (2) a probe of its
+own per-key accumulator state (one net row per key — NOT the input history),
+(3) an elementwise combine + diff. Every kernel is delta-sized; the input
+stream needs no trace at all, so upstream spines vanish unless some other
+consumer wants them. The general path instead gathers each touched group's
+full history from the input trace — O(group size) work the linear form
+avoids entirely.
+
+State representation: an ``acc`` spine of (key -> (acc..., count)) rows
+maintained by retract/insert deltas, exactly like the general path's output
+spine; reconstruction is linear (net acc = sum of weight * acc over the
+key's rows), so probes need no merge/netting pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.aggregate import GroupGather, _unique_keys
+from dbsp_tpu.parallel.lift import lifted
+from dbsp_tpu.trace.spine import Spine
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch
+
+# ---------------------------------------------------------------------------
+# Linear aggregators
+# ---------------------------------------------------------------------------
+
+
+class LinearAggregator:
+    """Spec: ``weigh`` maps each row's val columns to per-row contributions
+    (multiplied by the row's Z-set weight and summed per key); ``finalize``
+    maps the summed accumulator (+ the summed weight, ``count``) to the
+    output columns. Reference: aggregate/mod.rs:253,287."""
+
+    acc_dtypes: Tuple = ()
+    out_dtypes: Tuple = ()
+    name = "linear"
+
+    def weigh(self, val_cols: Tuple[jnp.ndarray, ...]
+              ) -> Tuple[jnp.ndarray, ...]:
+        return ()
+
+    def finalize(self, acc_cols: Tuple[jnp.ndarray, ...], count: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, ...]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearCount(LinearAggregator):
+    acc_dtypes = ()
+    out_dtypes = (jnp.int64,)
+    name = "count"
+
+    def finalize(self, acc_cols, count):
+        return (count,)
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearSum(LinearAggregator):
+    col: int = 0
+    acc_dtypes = (jnp.int64,)
+    out_dtypes = (jnp.int64,)
+    name = "sum"
+
+    def weigh(self, val_cols):
+        return (val_cols[self.col].astype(jnp.int64),)
+
+    def finalize(self, acc_cols, count):
+        return (acc_cols[0],)
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearAverage(LinearAggregator):
+    """Integer average sum/count with truncating division (SQL semantics,
+    matches the general-path Average)."""
+
+    col: int = 0
+    acc_dtypes = (jnp.int64,)
+    out_dtypes = (jnp.int64,)
+    name = "avg"
+
+    def weigh(self, val_cols):
+        return (val_cols[self.col].astype(jnp.int64),)
+
+    def finalize(self, acc_cols, count):
+        s = acc_cols[0]
+        c = jnp.maximum(count, 1)
+        return (jnp.where(s >= 0, s // c, -((-s) // c)),)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _weigh_deltas_impl(delta: Batch, agg: LinearAggregator, nk: int):
+    """Per-unique-key accumulator deltas: seg-sum of weight * weigh(vals).
+
+    Segment ids follow the same first-live-distinct-key order as
+    :func:`_unique_keys`, so outputs align with its compacted key columns.
+    """
+    cap = delta.cap
+    live = delta.weights != 0
+    first = ~kernels.rows_equal_prev(delta.keys[:nk], n=cap) & live
+    seg = jnp.cumsum(first) - 1
+    seg = jnp.where(live, seg, cap).astype(jnp.int32)
+    w = delta.weights
+    accs = tuple(
+        jax.ops.segment_sum(a.astype(d) * w, seg, num_segments=cap + 1)[:cap]
+        for a, d in zip(agg.weigh(delta.vals), agg.acc_dtypes))
+    cnt = jax.ops.segment_sum(w, seg, num_segments=cap + 1)[:cap]
+    return accs, cnt
+
+
+_weigh_deltas_jit = jax.jit(_weigh_deltas_impl, static_argnames=("agg", "nk"))
+
+
+def _weigh_deltas_factory(agg: LinearAggregator, nk: int):
+    return lambda d: _weigh_deltas_impl(d, agg, nk)
+
+
+def _weigh_deltas(delta: Batch, agg: LinearAggregator, nk: int):
+    if delta.sharded:
+        return lifted(_weigh_deltas_factory, agg, nk)(delta)
+    return _weigh_deltas_jit(delta, agg, nk)
+
+
+def _net_state_impl(parts, q_cap: int):
+    """Linear reconstruction of per-key state from acc-spine probe results:
+    net acc columns, net count, and net row count (presence) — plain
+    segment-sums per level, no merge/netting needed (linearity)."""
+    accs = None
+    cnt = None
+    rows = None
+    for qrow, vals, w in parts:
+        seg = jnp.minimum(qrow, q_cap).astype(jnp.int32)
+        # vals = (*acc_cols, count_col); dead slots have w == 0 so their
+        # sentinel values contribute nothing
+        sums = tuple(
+            jax.ops.segment_sum(v * w, seg, num_segments=q_cap + 1)[:q_cap]
+            for v in vals)
+        r = jax.ops.segment_sum(w, seg, num_segments=q_cap + 1)[:q_cap]
+        if accs is None:
+            accs, cnt, rows = sums[:-1], sums[-1], r
+        else:
+            accs = tuple(a + b for a, b in zip(accs, sums[:-1]))
+            cnt = cnt + sums[-1]
+            rows = rows + r
+    return accs, cnt, rows
+
+
+_net_state_jit = jax.jit(_net_state_impl, static_argnames=("q_cap",))
+
+
+def _net_state_factory(q_cap: int):
+    return lambda parts: _net_state_impl(parts, q_cap)
+
+
+def _net_state(parts, q_cap: int):
+    if parts[0][2].ndim > 1:  # sharded gather parts
+        return lifted(_net_state_factory, q_cap)(parts)
+    return _net_state_jit(parts, q_cap)
+
+
+def _combine_diff_impl(qkeys, qlive, acc_delta, cnt_delta, old_accs, old_cnt,
+                       old_rows, agg: LinearAggregator, nk: int):
+    """Combine old state + deltas; build the output diff and the state diff."""
+    q_cap = qlive.shape[0]
+    old_present = qlive & (old_rows > 0)
+    new_accs = tuple(o + d for o, d in zip(old_accs, acc_delta))
+    new_cnt = old_cnt + cnt_delta
+    new_present = qlive & (new_cnt > 0)
+
+    fin_old = tuple(c.astype(d) for c, d in
+                    zip(agg.finalize(old_accs, old_cnt), agg.out_dtypes))
+    fin_new = tuple(c.astype(d) for c, d in
+                    zip(agg.finalize(new_accs, new_cnt), agg.out_dtypes))
+    changed = new_present != old_present
+    for a, b in zip(fin_new, fin_old):
+        changed = changed | ~kernels._col_eq(a, b)
+
+    def two_sided(vals_new, vals_old, ins_mask, ret_mask):
+        keys = tuple(jnp.concatenate([c, c]) for c in qkeys)
+        vals = tuple(jnp.concatenate([n, o])
+                     for n, o in zip(vals_new, vals_old))
+        w = jnp.concatenate([jnp.where(ins_mask, 1, 0),
+                             jnp.where(ret_mask, -1, 0)]).astype(jnp.int64)
+        cols, w = kernels.consolidate_cols((*keys, *vals), w)
+        return Batch(cols[:nk], cols[nk:], w)
+
+    out = two_sided(fin_new, fin_old,
+                    new_present & changed, old_present & changed)
+    # state rows change iff any accumulator or the count moved
+    state_changed = cnt_delta != 0
+    for d in acc_delta:
+        state_changed = state_changed | (d != 0)
+    state = two_sided((*new_accs, new_cnt), (*old_accs, old_cnt),
+                      new_present & state_changed, old_present & state_changed)
+    return out, state
+
+
+_combine_diff_jit = jax.jit(_combine_diff_impl, static_argnames=("agg", "nk"))
+
+
+def _combine_diff_factory(agg: LinearAggregator, nk: int):
+    return lambda qk, ql, ad, cd, oa, oc, orr: _combine_diff_impl(
+        qk, ql, ad, cd, oa, oc, orr, agg, nk)
+
+
+def _combine_diff(qkeys, qlive, acc_delta, cnt_delta, old_accs, old_cnt,
+                  old_rows, agg: LinearAggregator, nk: int):
+    if qlive.ndim > 1:  # sharded
+        return lifted(_combine_diff_factory, agg, nk)(
+            qkeys, qlive, acc_delta, cnt_delta, old_accs, old_cnt, old_rows)
+    return _combine_diff_jit(qkeys, qlive, acc_delta, cnt_delta, old_accs,
+                             old_cnt, old_rows, agg, nk)
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+
+class LinearAggregateOp(UnaryOperator):
+    """Incremental linear aggregate. Consumes the RAW delta stream (no input
+    trace); keeps only its own (key -> acc) state spine."""
+
+    def __init__(self, agg: LinearAggregator, key_dtypes, name=None):
+        self.agg = agg
+        self.name = name or f"aggregate_linear<{agg.name}>"
+        self.key_dtypes = tuple(key_dtypes)
+        self.out_schema = (self.key_dtypes, tuple(agg.out_dtypes))
+        self._state_schema = (self.key_dtypes,
+                              (*agg.acc_dtypes, jnp.int64))  # + count col
+        self.acc_spine = Spine(*self._state_schema)
+        self._gather = GroupGather()
+
+    def clock_start(self, scope: int) -> None:
+        if scope > 0:  # nested clock: reset per parent tick (nested.py)
+            self.acc_spine = Spine(*self._state_schema)
+
+    def eval(self, delta: Batch) -> Batch:
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        nk = len(self.key_dtypes)
+        if int(delta.live_count()) == 0:
+            w = Runtime.worker_count()
+            return Batch.empty(*self.out_schema, lead=(w,) if w > 1 else ())
+        qkeys, qlive = _unique_keys(delta, nk)
+        q_cap = qlive.shape[-1]  # trimmed to distinct-key bucket
+        acc_delta, cnt_delta = _weigh_deltas(delta, self.agg, nk)
+        # _weigh_deltas aligns to the delta's cap; the distinct-key trim
+        # means only the first q_cap slots are populated
+        acc_delta = tuple(a[..., :q_cap] for a in acc_delta)
+        cnt_delta = cnt_delta[..., :q_cap]
+
+        parts = self._gather(qkeys, qlive, self.acc_spine.batches, q_cap)
+        if parts is None:
+            zeros = tuple(jnp.zeros(qlive.shape, d)
+                          for d in self.agg.acc_dtypes)
+            old = (zeros, jnp.zeros(qlive.shape, jnp.int64),
+                   jnp.zeros(qlive.shape, jnp.int64))
+        else:
+            old = _net_state(tuple(parts), q_cap)
+
+        out, state = _combine_diff(qkeys, qlive, tuple(acc_delta), cnt_delta,
+                                   *old, self.agg, nk)
+        # re-bucket to live rows before emitting/storing: the diffs carry
+        # 2*q_cap capacity but few live rows
+        self.acc_spine.insert(state.shrink_to_fit())
+        return out.shrink_to_fit()
+
+    def fixedpoint(self, scope: int) -> bool:
+        return True
+
+    def metadata(self):
+        return {"state_levels": len(self.acc_spine.batches),
+                "state_cap": self.acc_spine.total_cap}
+
+    def state_dict(self):
+        return {"acc_spine": self.acc_spine}
+
+    def load_state_dict(self, state):
+        self.acc_spine = state["acc_spine"]

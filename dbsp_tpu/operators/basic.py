@@ -107,10 +107,19 @@ class Generator(SourceOperator):
         if self.pos < len(self.values):
             v = self.values[self.pos]
             self.pos += 1
-            return v
-        if self.default is not None:
-            return self.default
-        raise StopIteration("Generator exhausted and no default value set")
+        elif self.default is not None:
+            v = self.default
+        else:
+            raise StopIteration("Generator exhausted and no default value set")
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        rt = Runtime.current()
+        if rt is not None and rt.workers > 1 and isinstance(v, Batch) \
+                and not v.sharded:
+            from dbsp_tpu.parallel.exchange import shard_batch
+
+            v = shard_batch(v, rt.mesh)
+        return v
 
     def state_dict(self):
         return {"pos": self.pos}

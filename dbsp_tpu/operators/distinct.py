@@ -22,12 +22,12 @@ from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.circuit.operator import UnaryOperator
 from dbsp_tpu.operators.registry import stream_method
 from dbsp_tpu.operators.trace_op import TraceView
+from dbsp_tpu.parallel.lift import lifted
 from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch
 
 
-@jax.jit
-def _old_weights_level(delta: Batch, level: Batch) -> jnp.ndarray:
+def _old_weights_level_impl(delta: Batch, level: Batch) -> jnp.ndarray:
     """Accumulated weight of each delta ROW (keys+vals) in one spine level.
 
     Rows are unique within a consolidated level, so the [lo, hi) range per
@@ -41,8 +41,7 @@ def _old_weights_level(delta: Batch, level: Batch) -> jnp.ndarray:
     return jnp.where(found, w, 0)
 
 
-@jax.jit
-def _distinct_delta(delta: Batch, old_w: jnp.ndarray) -> Batch:
+def _distinct_delta_impl(delta: Batch, old_w: jnp.ndarray) -> Batch:
     new_w = old_w + delta.weights
     became = (old_w <= 0) & (new_w > 0)
     ceased = (old_w > 0) & (new_w <= 0)
@@ -53,17 +52,33 @@ def _distinct_delta(delta: Batch, old_w: jnp.ndarray) -> Batch:
     return Batch(cols[: len(delta.keys)], cols[len(delta.keys):], w)
 
 
+_old_weights_level = jax.jit(_old_weights_level_impl)
+_distinct_delta = jax.jit(_distinct_delta_impl)
+
+
+def _old_weights_factory():
+    return _old_weights_level_impl
+
+
+def _distinct_delta_factory():
+    return _distinct_delta_impl
+
+
 class DistinctOp(UnaryOperator):
     name = "distinct"
 
     def eval(self, view: TraceView) -> Batch:
         delta = view.delta
+        sharded = delta.sharded
         old_w = None
         for level in view.pre_levels:
-            w = _old_weights_level(delta, level)
+            w = lifted(_old_weights_factory)(delta, level) if sharded \
+                else _old_weights_level(delta, level)
             old_w = w if old_w is None else old_w + w
         if old_w is None:
-            old_w = jnp.zeros((delta.cap,), delta.weights.dtype)
+            old_w = jnp.zeros_like(delta.weights)
+        if sharded:
+            return lifted(_distinct_delta_factory)(delta, old_w)
         return _distinct_delta(delta, old_w)
 
 
@@ -89,6 +104,7 @@ def distinct(self: Stream) -> Stream:
     t = self.trace()
     out = self.circuit.add_unary_operator(DistinctOp(), t)
     out.schema = getattr(self, "schema", None)
+    out.key_sharded = getattr(t, "key_sharded", False)
     return out
 
 

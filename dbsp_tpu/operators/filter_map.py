@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.circuit.operator import UnaryOperator
 from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.parallel.lift import lifted_op
 from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch
 
@@ -62,35 +63,35 @@ class MapOp(UnaryOperator):
         self.name = name
         self.preserves_order = preserves_order
         self.out_schema = out_schema  # (key_dtypes, val_dtypes) or None
+        self._kernel = jax.jit(self._inner)
 
-        @jax.jit
-        def kernel(batch: Batch) -> Batch:
-            nk, nv = fn(batch.keys, batch.vals)
-            nk, nv = tuple(nk), tuple(nv)
-            if out_schema is not None:
-                nk, nv = _pin_schema(nk, nv, out_schema, name)
-            if self.preserves_order:
-                # sort-free consolidation: inputs are sorted and the map is
-                # monotone, so equal output rows are adjacent (dead rows got
-                # garbage transforms but weight 0 and merge/drop cleanly)
-                cap = batch.cap
-                live = batch.weights != 0
-                cols = tuple(
-                    jnp.where(live, c, kernels.sentinel_for(c.dtype))
-                    for c in (*nk, *nv))
-                dup = kernels.rows_equal_prev(cols, n=cap) & live
-                seg = jnp.cumsum(~dup) - 1
-                sums = jax.ops.segment_sum(batch.weights, seg,
-                                           num_segments=cap)
-                w = jnp.where(dup, 0, sums[seg]).astype(batch.weights.dtype)
-                cols, w = kernels.compact(cols, w, w != 0)
-            else:
-                cols, w = kernels.consolidate_cols((*nk, *nv), batch.weights)
-            return Batch(cols[: len(nk)], cols[len(nk):], w)
-
-        self._kernel = kernel
+    def _inner(self, batch: Batch) -> Batch:
+        nk, nv = self.fn(batch.keys, batch.vals)
+        nk, nv = tuple(nk), tuple(nv)
+        if self.out_schema is not None:
+            nk, nv = _pin_schema(nk, nv, self.out_schema, self.name)
+        if self.preserves_order:
+            # sort-free consolidation: inputs are sorted and the map is
+            # monotone, so equal output rows are adjacent (dead rows got
+            # garbage transforms but weight 0 and merge/drop cleanly)
+            cap = batch.cap
+            live = batch.weights != 0
+            cols = tuple(
+                jnp.where(live, c, kernels.sentinel_for(c.dtype))
+                for c in (*nk, *nv))
+            dup = kernels.rows_equal_prev(cols, n=cap) & live
+            seg = jnp.cumsum(~dup) - 1
+            sums = jax.ops.segment_sum(batch.weights, seg,
+                                       num_segments=cap)
+            w = jnp.where(dup, 0, sums[seg]).astype(batch.weights.dtype)
+            cols, w = kernels.compact(cols, w, w != 0)
+        else:
+            cols, w = kernels.consolidate_cols((*nk, *nv), batch.weights)
+        return Batch(cols[: len(nk)], cols[len(nk):], w)
 
     def eval(self, batch: Batch) -> Batch:
+        if batch.sharded:
+            return lifted_op(self)(batch)
         return self._kernel(batch)
 
 
@@ -101,16 +102,16 @@ class FilterOp(UnaryOperator):
     def __init__(self, pred: PredFn, name: str = "filter"):
         self.pred = pred
         self.name = name
+        self._kernel = jax.jit(self._inner)
 
-        @jax.jit
-        def kernel(batch: Batch) -> Batch:
-            keep = pred(batch.keys, batch.vals) & (batch.weights != 0)
-            cols, w = kernels.compact(batch.cols, batch.weights, keep)
-            return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w)
-
-        self._kernel = kernel
+    def _inner(self, batch: Batch) -> Batch:
+        keep = self.pred(batch.keys, batch.vals) & (batch.weights != 0)
+        cols, w = kernels.compact(batch.cols, batch.weights, keep)
+        return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w)
 
     def eval(self, batch: Batch) -> Batch:
+        if batch.sharded:
+            return lifted_op(self)(batch)
         return self._kernel(batch)
 
 
@@ -129,25 +130,25 @@ class FlatMapOp(UnaryOperator):
         self.fanout = fanout
         self.name = name
         self.out_schema = out_schema
+        self._kernel = jax.jit(self._inner)
 
-        @jax.jit
-        def kernel(batch: Batch) -> Batch:
-            nk, nv, keep = fn(batch.keys, batch.vals)
-            nk, nv = tuple(nk), tuple(nv)
-            if out_schema is not None:
-                nk, nv = _pin_schema(nk, nv, out_schema, name)
-            cap = batch.cap
-            f = fanout
-            w = jnp.broadcast_to(batch.weights, (f, cap))
-            w = jnp.where(keep, w, 0).reshape(f * cap)
-            flat_k = tuple(c.reshape(f * cap) for c in nk)
-            flat_v = tuple(c.reshape(f * cap) for c in nv)
-            cols, w = kernels.consolidate_cols((*flat_k, *flat_v), w)
-            return Batch(cols[: len(flat_k)], cols[len(flat_k):], w)
-
-        self._kernel = kernel
+    def _inner(self, batch: Batch) -> Batch:
+        nk, nv, keep = self.fn(batch.keys, batch.vals)
+        nk, nv = tuple(nk), tuple(nv)
+        if self.out_schema is not None:
+            nk, nv = _pin_schema(nk, nv, self.out_schema, self.name)
+        cap = batch.cap
+        f = self.fanout
+        w = jnp.broadcast_to(batch.weights, (f, cap))
+        w = jnp.where(keep, w, 0).reshape(f * cap)
+        flat_k = tuple(c.reshape(f * cap) for c in nk)
+        flat_v = tuple(c.reshape(f * cap) for c in nv)
+        cols, w = kernels.consolidate_cols((*flat_k, *flat_v), w)
+        return Batch(cols[: len(flat_k)], cols[len(flat_k):], w)
 
     def eval(self, batch: Batch) -> Batch:
+        if batch.sharded:
+            return lifted_op(self)(batch)
         return self._kernel(batch)
 
 
@@ -175,6 +176,8 @@ def map_rows(self: Stream, fn: RowFn, key_dtypes, val_dtypes=(),
 def filter_rows(self: Stream, pred: PredFn, name: str = "filter") -> Stream:
     out = self.circuit.add_unary_operator(FilterOp(pred, name), self)
     out.schema = getattr(self, "schema", None)
+    # filtering moves no rows between workers: placement survives
+    out.key_sharded = getattr(self, "key_sharded", False)
     return out
 
 

@@ -32,19 +32,35 @@ class ZSetInput(SourceOperator):
         self.key_dtypes = tuple(key_dtypes)
         self.val_dtypes = tuple(val_dtypes)
         self._rows: List[Tuple[Row, int]] = []
-        self._batches: List[Batch] = []
+        self._batches: List[Tuple[Batch, bool]] = []  # (batch, consolidated)
 
     def eval(self) -> Batch:
-        parts = self._batches
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        rt = Runtime.current()
+        workers = rt.workers if rt is not None else 1
+        # canonicalize each part once, then fold with rank-merges — pushed
+        # batches that are already consolidated (the common generator path)
+        # are never re-sorted
+        parts = [b if done else b.consolidate()
+                 for b, done in self._batches]
         if self._rows:
-            parts = parts + [Batch.from_tuples(
-                self._rows, self.key_dtypes, self.val_dtypes)]
+            parts.append(Batch.from_tuples(
+                self._rows, self.key_dtypes, self.val_dtypes))
         self._rows, self._batches = [], []
         if not parts:
-            return Batch.empty(self.key_dtypes, self.val_dtypes)
-        if len(parts) == 1:
-            return parts[0].consolidate()
-        return concat_batches(parts).consolidate()
+            return Batch.empty(self.key_dtypes, self.val_dtypes,
+                               lead=(workers,) if workers > 1 else ())
+        acc = parts[0]
+        for p in parts[1:]:
+            acc = acc.merge_with(p)
+        if workers > 1:
+            # distribute by key hash over the mesh (the reference spreads
+            # input across workers at the handle, input.rs:66-67/309-311)
+            from dbsp_tpu.parallel.exchange import shard_batch
+
+            acc = shard_batch(acc, rt.mesh).shrink_to_fit()
+        return acc
 
 
 class InputHandle:
@@ -60,9 +76,12 @@ class InputHandle:
     def extend(self, rows: Sequence[Tuple[Row, int]]) -> None:
         self._op._rows.extend(rows)
 
-    def push_batch(self, batch: Batch) -> None:
-        """Zero-copy path: feed an already-built (device) batch."""
-        self._op._batches.append(batch)
+    def push_batch(self, batch: Batch, consolidated: bool = False) -> None:
+        """Zero-copy path: feed an already-built (device) batch. Pass
+        ``consolidated=True`` when the batch already satisfies the
+        consolidated invariant (sorted, unique, dead sentinel tail) to skip
+        its canonicalization sort."""
+        self._op._batches.append((batch, consolidated))
 
 
 class OutputOperator(SinkOperator):
@@ -78,6 +97,12 @@ class OutputOperator(SinkOperator):
         self._next_cid = 0
 
     def eval(self, v: Batch) -> None:
+        if isinstance(v, Batch) and v.sharded:
+            # collapse to one host-side batch so every consumer (tests,
+            # transports, HTTP readers) sees worker-count-independent output
+            from dbsp_tpu.parallel.exchange import unshard_batch
+
+            v = unshard_batch(v)
         self.current = v
         self.step_id += 1
         for q in self._consumers.values():
@@ -139,9 +164,12 @@ def add_input_zset(circuit: Circuit, key_dtypes: Sequence,
                    val_dtypes: Sequence = ()) -> Tuple[Stream, InputHandle]:
     """reference: ``add_input_zset`` (input.rs:75). The returned stream's
     schema metadata propagates through schema-preserving operators."""
+    from dbsp_tpu.circuit.runtime import Runtime
+
     op = ZSetInput(key_dtypes, val_dtypes)
     s = circuit.add_source(op)
     s.schema = (op.key_dtypes, op.val_dtypes)
+    s.key_sharded = Runtime.worker_count() > 1  # sources hash-distribute
     return s, InputHandle(op)
 
 

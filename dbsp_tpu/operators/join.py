@@ -25,8 +25,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.parallel.lift import lifted
 from dbsp_tpu.circuit.operator import BinaryOperator
 from dbsp_tpu.operators.registry import stream_method
 from dbsp_tpu.operators.trace_op import TraceView
@@ -37,10 +39,15 @@ from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 JoinFn = Callable[[Tuple, Tuple, Tuple], Tuple[Tuple, Tuple]]
 
 
-@partial(jax.jit, static_argnames=("nk", "fn", "out_cap"))
-def _join_level(delta: Batch, level: Batch, nk: int, fn: JoinFn,
-                out_cap: int) -> Tuple[Batch, jnp.ndarray]:
-    """Join a delta batch against one spine level; static out_cap."""
+def _join_level_impl(delta: Batch, level: Batch, nk: int, fn: JoinFn,
+                     out_cap: int) -> Tuple[Batch, jnp.ndarray]:
+    """Join a delta batch against one spine level; static out_cap.
+
+    The output is RAW (unconsolidated: arbitrary row order, possible
+    duplicates, weight-0 padding) — callers concat all level outputs and
+    consolidate once, instead of sorting per level and re-sorting the
+    concat.
+    """
     dk = delta.keys[:nk]
     lk = level.keys[:nk]
     lo = kernels.lex_probe(lk, dk, side="left")
@@ -56,18 +63,30 @@ def _join_level(delta: Batch, level: Batch, nk: int, fn: JoinFn,
     lvals = tuple(c[row] for c in delta.vals)
     rvals = tuple(c[src] for c in level.vals)
     out_keys, out_vals = fn(key_cols, lvals, rvals)
-    cols, w = kernels.consolidate_cols((*out_keys, *out_vals), w)
-    out = Batch(cols[: len(out_keys)], cols[len(out_keys):], w)
+    # dead slots must carry sentinels so they sort to the tail later
+    out_keys = tuple(jnp.where(valid, c, kernels.sentinel_for(c.dtype))
+                     for c in out_keys)
+    out_vals = tuple(jnp.where(valid, c, kernels.sentinel_for(c.dtype))
+                     for c in out_vals)
+    out = Batch(out_keys, out_vals, w)
     return out, total
+
+
+_join_level = jax.jit(_join_level_impl, static_argnames=("nk", "fn", "out_cap"))
+
+
+def _join_level_factory(nk: int, fn: JoinFn, out_cap: int):
+    return lambda d, l: _join_level_impl(d, l, nk, fn, out_cap)
 
 
 class JoinCore:
     """Grow-on-demand driver for joining deltas against spine levels.
 
     Keeps a per-instance output-capacity estimate (monotone, power-of-two) so
-    the common case is one kernel launch per level and zero host syncs beyond
-    the overflow check — the TPU answer to the reference's two-pass
-    count/alloc/fill fan-out.
+    the common case is one kernel launch per level — the TPU answer to the
+    reference's two-pass count/alloc/fill fan-out. All levels launch before
+    the single batched overflow check (one host sync per eval, not one per
+    level).
     """
 
     def __init__(self, nk: int, fn: JoinFn, out_schema):
@@ -76,17 +95,32 @@ class JoinCore:
         self.out_schema = out_schema
         self.caps: Dict[int, int] = {}  # level bucket -> out cap
 
-    def join_levels(self, delta: Batch, levels: Sequence[Batch]) -> List[Batch]:
+    def _launch(self, delta: Batch, level: Batch, cap: int):
+        if delta.sharded:
+            return lifted(_join_level_factory, self.nk, self.fn, cap)(
+                delta, level)
+        return _join_level(delta, level, self.nk, self.fn, cap)
+
+    def join_levels(self, delta: Batch, levels: Sequence[Batch]
+                    ) -> List[Batch]:
+        """Launch every level's join; returns RAW per-level outputs."""
         outs: List[Batch] = []
+        totals = []
+        caps = []
         for level in levels:
             cap = self.caps.get(level.cap, max(64, delta.cap))
-            out, total = _join_level(delta, level, self.nk, self.fn, cap)
-            t = int(total)
-            if t > cap:
-                cap = bucket_cap(t)
-                self.caps[level.cap] = cap
-                out, _ = _join_level(delta, level, self.nk, self.fn, cap)
+            out, total = self._launch(delta, level, cap)
             outs.append(out)
+            totals.append(total)
+            caps.append(cap)
+        if not outs:
+            return []
+        for i, t in enumerate(jax.device_get(totals)):  # ONE sync for all
+            t = int(np.max(t))  # per-worker totals for sharded runs
+            if t > caps[i]:
+                cap = bucket_cap(t)
+                self.caps[levels[i].cap] = cap
+                outs[i], _ = self._launch(delta, levels[i], cap)
         return outs
 
 
@@ -95,7 +129,8 @@ class JoinOp(BinaryOperator):
 
     Reference: the JoinTrace operator pair assembled by join_generic
     (join.rs:581 + :268-290); both terms and the final sum are fused into one
-    host eval here.
+    host eval here — and consolidated with ONE sort over the concatenated
+    raw level expansions rather than per-level sorts plus a re-sort.
     """
 
     def __init__(self, fn: JoinFn, nk: int, out_schema, name="join"):
@@ -108,12 +143,15 @@ class JoinOp(BinaryOperator):
         self._right_core = JoinCore(nk, flipped, out_schema)
 
     def eval(self, left: TraceView, right: TraceView) -> Batch:
+        from dbsp_tpu.circuit.runtime import Runtime
+
         outs = self._left_core.join_levels(left.delta, right.spine.batches)
         outs += self._right_core.join_levels(right.delta, left.pre_levels)
         if not outs:
-            return Batch.empty(*self.out_schema)
+            w = Runtime.worker_count()
+            return Batch.empty(*self.out_schema, lead=(w,) if w > 1 else ())
         if len(outs) == 1:
-            return outs[0]
+            return outs[0].consolidate().shrink_to_fit()
         return concat_batches(outs).consolidate().shrink_to_fit()
 
 
@@ -147,9 +185,9 @@ def stream_join(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
 
     def eval_fn(a: Batch, b: Batch) -> Batch:
         core.nk = len(a.keys)  # late-bound; capacity estimates persist
-        outs = core.join_levels(a, [b])
-        return outs[0] if len(outs) == 1 else \
-            concat_batches(outs).consolidate()
+        outs = core.join_levels(a, [b])  # raw — consolidate before emitting
+        return concat_batches(outs).consolidate() if len(outs) > 1 \
+            else outs[0].consolidate()
 
     from dbsp_tpu.operators.basic import Apply2
 

@@ -1,0 +1,105 @@
+"""The shard operator: key-hash repartition of a stream across workers.
+
+Reference: ``operator/communication/shard.rs:35-101`` — ``shard()`` is a
+no-op on one worker; stateful operators (trace/join/aggregate/distinct)
+re-shard their own inputs so state is partitioned by key hash and each
+worker's slice can be processed independently; the circuit cache makes
+repeated ``shard()`` of one stream share a single exchange.
+
+Here the exchange is a ``lax.all_to_all`` over the worker mesh inside the
+SPMD step (parallel/exchange.py); placement metadata (``key_sharded``) on
+streams elides exchanges that cannot move any row (the stream is already
+hash-partitioned on its current key).
+"""
+
+from __future__ import annotations
+
+from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.operator import UnaryOperator
+from dbsp_tpu.operators.registry import stream_method
+from dbsp_tpu.parallel.exchange import exchange_local
+from dbsp_tpu.parallel.lift import lifted
+from dbsp_tpu.zset.batch import Batch
+
+
+def _exchange_factory(nworkers: int):
+    return lambda b: exchange_local(b, nworkers)
+
+
+class ExchangeOp(UnaryOperator):
+    name = "shard"
+
+    def __init__(self, nworkers: int):
+        self.nworkers = nworkers
+
+    def eval(self, batch: Batch) -> Batch:
+        if not batch.sharded:
+            # host-resident input (e.g. an operator that ran unsharded, see
+            # unshard()): distribute it instead of exchanging
+            from dbsp_tpu.circuit.runtime import Runtime
+            from dbsp_tpu.parallel.exchange import shard_batch
+
+            return shard_batch(batch, Runtime.current().mesh).shrink_to_fit()
+        out = lifted(_exchange_factory, self.nworkers)(batch)
+        # all_to_all output cap is nworkers * cap_local; re-bucket to the
+        # worst worker's live rows (one scalar sync)
+        return out.shrink_to_fit()
+
+
+class UnshardOp(UnaryOperator):
+    """Collapse a sharded stream to host-resident 1-D batches (all-gather +
+    consolidate). Inserted by operators that are not yet shard-lifted
+    (topk / rolling / window) so they run with single-worker semantics
+    inside a multi-worker circuit — correctness first, parallelism where
+    implemented (the reference's gather(), communication/gather.rs:41)."""
+
+    name = "unshard"
+
+    def eval(self, batch: Batch) -> Batch:
+        if not batch.sharded:
+            return batch
+        from dbsp_tpu.parallel.exchange import unshard_batch
+
+        return unshard_batch(batch).shrink_to_fit()
+
+
+@stream_method
+def shard(self: Stream) -> Stream:
+    """Hash-repartition this stream by its first key column so equal keys
+    co-locate on one worker. No-op on a single worker or when the stream is
+    already key-sharded; cached so all consumers share one exchange."""
+    from dbsp_tpu.circuit.runtime import Runtime
+
+    rt = Runtime.current()
+    if rt is None or rt.workers <= 1:
+        return self
+    if getattr(self, "key_sharded", False):
+        return self
+    key = ("shard", self.node_index)
+    cached = self.circuit.cache.get(key)
+    if cached is not None:
+        return cached
+    out = self.circuit.add_unary_operator(ExchangeOp(rt.workers), self)
+    out.schema = getattr(self, "schema", None)
+    out.key_sharded = True
+    self.circuit.cache[key] = out
+    return out
+
+
+@stream_method
+def unshard(self: Stream) -> Stream:
+    """Collapse to host-resident batches; no-op on a single worker."""
+    from dbsp_tpu.circuit.runtime import Runtime
+
+    rt = Runtime.current()
+    if rt is None or rt.workers <= 1:
+        return self
+    key = ("unshard", self.node_index)
+    cached = self.circuit.cache.get(key)
+    if cached is not None:
+        return cached
+    out = self.circuit.add_unary_operator(UnshardOp(), self)
+    out.schema = getattr(self, "schema", None)
+    out.key_sharded = False
+    self.circuit.cache[key] = out
+    return out

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 
 from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.circuit.operator import UnaryOperator
-from dbsp_tpu.operators.aggregate import GroupGather, _unique_keys
+from dbsp_tpu.operators.aggregate import (GroupGather, _unique_keys,
+                                          concat_parts)
 from dbsp_tpu.operators.registry import stream_method
 from dbsp_tpu.operators.trace_op import TraceView
 from dbsp_tpu.trace.spine import Spine
@@ -93,18 +94,19 @@ class TopKOp(UnaryOperator):
         if int(delta.live_count()) == 0:
             return Batch.empty(*self.schema)
         qkeys, qlive = _unique_keys(delta, nk)
-        q_cap = delta.cap
+        q_cap = qlive.shape[-1]  # trimmed to distinct-key bucket
         parts = []
         gathered = self._group_gather(qkeys, qlive, view.spine.batches, q_cap)
         if gathered is not None:
-            parts.append(_topk_rows(gathered[0], qkeys, gathered[1],
-                                    gathered[2], self.k, self.largest, 1,
-                                    q_cap))
+            g = concat_parts(gathered)
+            parts.append(_topk_rows(g[0], qkeys, g[1], g[2],
+                                    self.k, self.largest, 1, q_cap))
         old = self._old_gather(qkeys, qlive, self.out_spine.batches, q_cap)
         if old is not None:
             # previous top-K rows of the touched keys, retracted; K is
             # larger than any group's slot count so keep=present suffices
-            parts.append(_topk_rows(old[0], qkeys, old[1], old[2],
+            o = concat_parts(old)
+            parts.append(_topk_rows(o[0], qkeys, o[1], o[2],
                                     self.k, self.largest, -1, q_cap))
         if not parts:
             return Batch.empty(*self.schema)
@@ -125,7 +127,7 @@ def topk(self: Stream, k: int, largest: bool = True, name=None) -> Stream:
     """Top-K rows per key, ordered by the value columns (see module doc)."""
     schema = getattr(self, "schema", None)
     assert schema is not None, "topk needs stream schema metadata"
-    t = self.trace()
+    t = self.trace(shard=False)  # not yet shard-lifted
     out = self.circuit.add_unary_operator(
         TopKOp(k, (tuple(schema[0]), tuple(schema[1])), largest, name), t)
     out.schema = schema

@@ -79,16 +79,25 @@ class TraceOp(UnaryOperator):
 
 
 @stream_method
-def trace(self: Stream) -> Stream:
+def trace(self: Stream, shard: bool = True) -> Stream:
     """Stream of TraceViews of this stream's integral; built once per source
-    stream via the circuit cache (reference: trace.rs:173 + cache.rs)."""
-    key = ("trace", self.node_index)
-    cached = self.circuit.cache.get(key)
+    stream via the circuit cache (reference: trace.rs:173 + cache.rs).
+
+    Under a multi-worker runtime the stream is hash-sharded first so each
+    worker's spine holds a disjoint key slice — the reference's stateful
+    operators call shard() on their inputs the same way (shard.rs:89,
+    join.rs:268-270). ``shard=False`` instead collapses the stream to a
+    host-resident trace (for consumers not yet lifted over the mesh:
+    topk / rolling / window)."""
+    src = self.shard() if shard else self.unshard()
+    key = ("trace", src.node_index)
+    cached = src.circuit.cache.get(key)
     if cached is not None:
         return cached
-    schema = getattr(self, "schema", None)
+    schema = getattr(src, "schema", None)
     assert schema is not None, "trace() needs stream schema metadata"
-    out = self.circuit.add_unary_operator(TraceOp(*schema), self)
+    out = src.circuit.add_unary_operator(TraceOp(*schema), src)
     out.schema = schema
-    self.circuit.cache[key] = out
+    out.key_sharded = getattr(src, "key_sharded", False)
+    src.circuit.cache[key] = out
     return out

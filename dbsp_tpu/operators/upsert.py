@@ -21,7 +21,7 @@ import numpy as np
 
 from dbsp_tpu.circuit.builder import Circuit, Stream
 from dbsp_tpu.circuit.operator import SourceOperator
-from dbsp_tpu.operators.aggregate import GroupGather
+from dbsp_tpu.operators.aggregate import GroupGather, concat_parts
 from dbsp_tpu.trace.spine import Spine
 from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, Row, bucket_cap, concat_batches
@@ -55,8 +55,13 @@ class UpsertInput(SourceOperator):
         self._gather = GroupGather()
 
     def eval(self) -> Batch:
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        rt = Runtime.current()
+        workers = rt.workers if rt is not None else 1
         if not self._pending:
-            return Batch.empty(self.key_dtypes, self.val_dtypes)
+            return Batch.empty(self.key_dtypes, self.val_dtypes,
+                               lead=(workers,) if workers > 1 else ())
         items = list(self._pending.items())
         self._pending.clear()
 
@@ -77,8 +82,8 @@ class UpsertInput(SourceOperator):
         parts = []
         gathered = self._gather(qkeys, qlive, self.spine.batches, qcap)
         if gathered is not None:
-            parts.append(_retractions(gathered[0], qkeys, gathered[1],
-                                      gathered[2]))
+            g = concat_parts(gathered)
+            parts.append(_retractions(g[0], qkeys, g[1], g[2]))
         inserts = [((*(k), *(v)), 1) for k, v in items if v is not None]
         if inserts:
             parts.append(Batch.from_tuples(inserts, self.key_dtypes,
@@ -87,7 +92,13 @@ class UpsertInput(SourceOperator):
             return Batch.empty(self.key_dtypes, self.val_dtypes)
         delta = parts[0] if len(parts) == 1 else \
             concat_batches(parts).consolidate().shrink_to_fit()
+        # upsert state diffing stays host-side (the spine above); only the
+        # emitted delta is distributed over the mesh
         self.spine.insert(delta)
+        if workers > 1:
+            from dbsp_tpu.parallel.exchange import shard_batch
+
+            return shard_batch(delta, rt.mesh).shrink_to_fit()
         return delta
 
 
@@ -118,9 +129,12 @@ class UpsertHandle:
 def add_input_map(circuit: Circuit, key_dtypes: Sequence,
                   val_dtypes: Sequence) -> Tuple[Stream, UpsertHandle]:
     """Keyed map input: at most one live value per key (input.rs:313)."""
+    from dbsp_tpu.circuit.runtime import Runtime
+
     op = UpsertInput(key_dtypes, val_dtypes)
     s = circuit.add_source(op)
     s.schema = (op.key_dtypes, op.val_dtypes)
+    s.key_sharded = Runtime.worker_count() > 1  # deltas are hash-distributed
     return s, UpsertHandle(op)
 
 

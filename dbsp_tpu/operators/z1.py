@@ -57,7 +57,15 @@ class Z1(StrictOperator):
 
 def _zero_like_factory(example_schema):
     key_dtypes, val_dtypes = example_schema
-    return lambda: Batch.empty(key_dtypes, val_dtypes)
+
+    def zero():
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        w = Runtime.worker_count()
+        return Batch.empty(key_dtypes, val_dtypes,
+                           lead=(w,) if w > 1 else ())
+
+    return zero
 
 
 @stream_method

@@ -1,0 +1,93 @@
+"""Lift single-worker batch kernels over the worker mesh.
+
+The execution convention for circuit-integrated sharding (reference:
+``operator/communication/shard.rs:35-101``): a sharded stream carries
+:class:`~dbsp_tpu.zset.batch.Batch` pytrees whose arrays have a leading
+``[W]`` worker axis laid out over the 1-D mesh. Every operator keeps its
+single-worker kernel; when its input is sharded the kernel is wrapped in
+``shard_map`` (one jit per (mesh, kernel, static-config)) so each worker
+evaluates its slice independently — cross-worker movement happens ONLY in
+the explicit exchange/gather operators, exactly like the reference where
+every operator body is single-threaded and ``shard()`` owns communication.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+
+from dbsp_tpu.parallel.exchange import spmd
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch
+
+
+def current_mesh():
+    from dbsp_tpu.circuit.runtime import Runtime
+
+    rt = Runtime.current()
+    assert rt is not None and rt.mesh is not None, (
+        "sharded batch encountered outside a multi-worker Runtime context "
+        "(build/step circuits through Runtime.init_circuit)")
+    return rt.mesh
+
+
+@lru_cache(maxsize=1024)
+def _lifted_jit(mesh, factory, statics):
+    """One compiled SPMD callable per (mesh, kernel factory, static config).
+
+    ``factory(*statics)`` must return the pure per-worker function; the
+    factory itself is the stable cache identity (module-level function), so
+    the lambda it builds is created once per distinct config. Bounded:
+    ``statics`` can hold operator instances (lifted_op), and an unbounded
+    cache would pin every operator ever built for process lifetime —
+    eviction only costs a re-jit (backed by the persistent compile cache).
+    """
+    return jax.jit(spmd(mesh, factory(*statics)))
+
+
+def lifted(factory, *statics):
+    """Dispatcher for sharded operator kernels: returns the compiled SPMD
+    callable for the current mesh."""
+    return _lifted_jit(current_mesh(), factory, tuple(statics))
+
+
+def op_kernel(op):
+    """Factory for instance-bound kernels: the operator instance is the
+    (hashable, stable) static identity; its ``_inner`` is the pure body."""
+    return op._inner
+
+
+def lifted_op(op):
+    """SPMD dispatch of an operator's ``_inner(batch...)`` kernel."""
+    return lifted(op_kernel, op)
+
+
+# -- per-worker bodies used by Batch's host-path methods --------------------
+
+
+def _consolidate_factory():
+    def body(b: Batch) -> Batch:
+        cols, w = kernels.consolidate_cols(b.cols, b.weights)
+        nk = len(b.keys)
+        return Batch(cols[:nk], cols[nk:], w)
+
+    return body
+
+
+def _merge_factory():
+    def body(a: Batch, b: Batch) -> Batch:
+        cols, w = kernels.merge_sorted_cols(a.cols, a.weights,
+                                            b.cols, b.weights)
+        nk = len(a.keys)
+        return Batch(cols[:nk], cols[nk:], w)
+
+    return body
+
+
+def lifted_consolidate(batch: Batch) -> Batch:
+    return lifted(_consolidate_factory)(batch)
+
+
+def lifted_merge(a: Batch, b: Batch) -> Batch:
+    return lifted(_merge_factory)(a, b)

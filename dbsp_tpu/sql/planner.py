@@ -24,7 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dbsp_tpu.circuit.builder import Circuit, Stream
-from dbsp_tpu.operators.aggregate import Average, Count, Max, Min, Sum
+from dbsp_tpu.operators.aggregate import Max, Min
+from dbsp_tpu.operators.aggregate_linear import (
+    LinearAverage as Average, LinearCount as Count, LinearSum as Sum)
 from dbsp_tpu.sql import parser as P
 
 AGG_CLASSES = {"count": Count, "sum": Sum, "min": Min, "max": Max,

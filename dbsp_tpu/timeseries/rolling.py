@@ -184,12 +184,11 @@ class RollingAggregateOp(UnaryOperator):
             old_present = jnp.zeros((a_cap,), jnp.bool_)
         else:
             old_vals, old_present = _reduce_groups(
-                old[0], old[1], old[2],
-                _TupleMax(len(self.agg.out_dtypes)), a_cap)
+                tuple(old), _TupleMax(len(self.agg.out_dtypes)), a_cap)
 
         cols, w = _diff_outputs((ap, at), alive, new_vals, new_present,
                                 old_vals, old_present)
-        out = Batch(cols[:2], cols[2:], w)
+        out = Batch(cols[:2], cols[2:], w).shrink_to_fit()
         self.out_spine.insert(out)
         return out
 
@@ -208,7 +207,7 @@ def partitioned_rolling_aggregate(self: Stream, agg: Aggregator,
     schema = getattr(self, "schema", None)
     assert schema is not None and len(schema[0]) == 2, (
         "partitioned_rolling_aggregate needs keys (partition, time)")
-    t = self.trace()
+    t = self.trace(shard=False)  # not yet shard-lifted
     out = self.circuit.add_unary_operator(
         RollingAggregateOp(agg, range_ms, schema, name), t)
     out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))

@@ -136,7 +136,7 @@ def window(self: Stream, bounds: Stream, gc: bool = False) -> Stream:
     host driver must coordinate)."""
     schema = getattr(self, "schema", None)
     assert schema is not None, "window needs stream schema metadata"
-    t = self.trace()
+    t = self.trace(shard=False)  # not yet shard-lifted
     out = self.circuit.add_binary_operator(WindowOp(schema, gc), t, bounds)
     out.schema = schema
     return out

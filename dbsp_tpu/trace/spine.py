@@ -52,6 +52,8 @@ class Spine:
         self.batches.append(batch)
         self.batches.sort(key=lambda b: b.cap, reverse=True)
         # Merge while two levels share a capacity bucket (LSM compaction).
+        # Levels are consolidated (sorted), so each merge is one rank-based
+        # sorted-merge kernel, not a re-sort of the combined rows.
         merged = True
         while merged:
             merged = False
@@ -59,7 +61,7 @@ class Spine:
                 if self.batches[i].cap == self.batches[i + 1].cap:
                     a = self.batches.pop(i + 1)
                     b = self.batches.pop(i)
-                    m = _shrink(concat_batches([a, b]).consolidate())
+                    m = _shrink(a.merge_with(b))
                     if m is not None:
                         self.batches.insert(i, m)
                         self.batches.sort(key=lambda b: b.cap, reverse=True)
@@ -89,7 +91,11 @@ class Spine:
             elif len(self.batches) == 1:
                 self._consolidated = self.batches[0]
             else:
-                c = _shrink(concat_batches(self.batches).consolidate())
+                # fold small->large so each rank-merge probes the smaller side
+                acc = None
+                for b in sorted(self.batches, key=lambda b: b.cap):
+                    acc = b if acc is None else acc.merge_with(b)
+                c = _shrink(acc)
                 self._consolidated = c if c is not None else Batch.empty(
                     self.key_dtypes, self.val_dtypes)
         return self._consolidated

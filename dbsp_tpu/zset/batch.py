@@ -64,9 +64,16 @@ class Batch:
         return cls(tuple(keys), tuple(vals), weights)
 
     # -- basic properties ---------------------------------------------------
+    # Arrays are [cap] on a single worker, or [W, cap] for a batch sharded
+    # over a worker mesh (parallel/): the row axis is always the LAST axis,
+    # and per-worker row invariants hold along it independently.
     @property
     def cap(self) -> int:
-        return int(self.weights.shape[0])
+        return int(self.weights.shape[-1])
+
+    @property
+    def sharded(self) -> bool:
+        return self.weights.ndim == 2
 
     @property
     def cols(self) -> Tuple[jnp.ndarray, ...]:
@@ -79,16 +86,24 @@ class Batch:
         return tuple(v.dtype for v in self.vals)
 
     def live_count(self) -> jnp.ndarray:
-        """Number of live rows (device scalar)."""
+        """Total number of live rows (device scalar; all workers)."""
         return jnp.sum(self.weights != 0)
+
+    def max_worker_live(self) -> jnp.ndarray:
+        """Max live rows on any one worker — what capacity bucketing needs
+        for sharded batches (each worker slice has the same static cap)."""
+        if self.sharded:
+            return jnp.max(jnp.sum(self.weights != 0, axis=-1))
+        return self.live_count()
 
     # -- constructors -------------------------------------------------------
     @staticmethod
     def empty(key_dtypes: Sequence, val_dtypes: Sequence = (), cap: int = 8,
-              weight_dtype=WEIGHT_DTYPE) -> "Batch":
-        keys = tuple(kernels.sentinel_fill((cap,), d) for d in key_dtypes)
-        vals = tuple(kernels.sentinel_fill((cap,), d) for d in val_dtypes)
-        return Batch(keys, vals, jnp.zeros((cap,), weight_dtype))
+              weight_dtype=WEIGHT_DTYPE, lead: Tuple[int, ...] = ()) -> "Batch":
+        """``lead=(W,)`` builds an empty sharded batch (worker axis first)."""
+        keys = tuple(kernels.sentinel_fill((*lead, cap), d) for d in key_dtypes)
+        vals = tuple(kernels.sentinel_fill((*lead, cap), d) for d in val_dtypes)
+        return Batch(keys, vals, jnp.zeros((*lead, cap), weight_dtype))
 
     @staticmethod
     def from_columns(keys: Sequence[jnp.ndarray], vals: Sequence[jnp.ndarray],
@@ -132,22 +147,29 @@ class Batch:
 
     # -- canonicalization ---------------------------------------------------
     def consolidate(self) -> "Batch":
+        if self.sharded:  # canonicalize each worker slice under the mesh
+            from dbsp_tpu.parallel.lift import lifted_consolidate
+
+            return lifted_consolidate(self)
         cols, w = kernels.consolidate_cols(self.cols, self.weights)
         nk = len(self.keys)
         return Batch(cols[:nk], cols[nk:], w)
 
     def with_cap(self, cap: int) -> "Batch":
-        """Grow or shrink capacity. Shrinking assumes live rows fit (caller
-        checked ``live_count``); consolidated batches keep live rows first."""
+        """Grow or shrink row capacity (last axis). Shrinking assumes live
+        rows fit (caller checked the live count); consolidated batches keep
+        live rows first on every worker."""
         if cap == self.cap:
             return self
         if cap > self.cap:
             keys = tuple(_pad_sentinel(k, cap) for k in self.keys)
             vals = tuple(_pad_sentinel(v, cap) for v in self.vals)
-            w = jnp.zeros((cap,), self.weights.dtype).at[: self.cap].set(self.weights)
+            w = jnp.zeros((*self.weights.shape[:-1], cap),
+                          self.weights.dtype).at[..., : self.cap].set(self.weights)
             return Batch(keys, vals, w)
-        return Batch(tuple(k[:cap] for k in self.keys),
-                     tuple(v[:cap] for v in self.vals), self.weights[:cap])
+        return Batch(tuple(k[..., :cap] for k in self.keys),
+                     tuple(v[..., :cap] for v in self.vals),
+                     self.weights[..., :cap])
 
     # -- algebra (reference: crates/dbsp/src/algebra) -----------------------
     def neg(self) -> "Batch":
@@ -158,24 +180,41 @@ class Batch:
         return Batch(self.keys, self.vals, self.weights * c)
 
     def add(self, other: "Batch") -> "Batch":
-        """Z-set group addition (concatenate + consolidate + re-bucket).
+        """Z-set group addition of two CONSOLIDATED batches (the invariant
+        every stream value upholds) via the rank-based sorted merge — no
+        re-sort.
 
         The shrink keeps capacities in power-of-two buckets proportional to
         live rows — without it, iterated adds (the integrator loop) would grow
         capacity by cap_other per tick and trigger a fresh XLA compile each
         step. Costs one scalar device->host sync; host-level callers only.
         """
-        return concat_batches([self, other]).consolidate().shrink_to_fit()
+        return self.merge_with(other).shrink_to_fit()
+
+    def merge_with(self, other: "Batch") -> "Batch":
+        """Sorted merge of two consolidated batches; output cap is the sum
+        of the input caps (see :func:`kernels.merge_sorted_cols`)."""
+        assert len(self.keys) == len(other.keys) and \
+            len(self.vals) == len(other.vals), "schema mismatch in merge"
+        assert self.weights.ndim == other.weights.ndim, (
+            "cannot merge a sharded batch with an unsharded one — check "
+            "that every source in the circuit produces the same placement")
+        if self.sharded:
+            from dbsp_tpu.parallel.lift import lifted_merge
+
+            return lifted_merge(self, other)
+        return _merge_kernel(self, other)
 
     def shrink_to_fit(self, minimum: int = 8) -> "Batch":
-        """Re-bucket a consolidated batch to bucket_cap(live rows)."""
-        return self.with_cap(bucket_cap(int(self.live_count()), minimum))
+        """Re-bucket a consolidated batch to bucket_cap(max worker live)."""
+        return self.with_cap(bucket_cap(int(self.max_worker_live()), minimum))
 
     # -- host-side views (tests / output handles) ---------------------------
     def to_dict(self) -> Dict[Row, int]:
-        """Materialize as {(key..., val...): weight} — the test oracle format."""
-        cols = [np.asarray(c) for c in self.cols]
-        ws = np.asarray(self.weights)
+        """Materialize as {(key..., val...): weight} — the test oracle format.
+        A sharded batch materializes the union over all worker slices."""
+        cols = [np.asarray(c).reshape(-1) for c in self.cols]
+        ws = np.asarray(self.weights).reshape(-1)
         out: Dict[Row, int] = {}
         for i in range(len(ws)):
             if ws[i] != 0:
@@ -184,23 +223,32 @@ class Batch:
         return {r: w for r, w in out.items() if w != 0}
 
 
+@jax.jit
+def _merge_kernel(a: Batch, b: Batch) -> Batch:
+    cols, w = kernels.merge_sorted_cols(a.cols, a.weights, b.cols, b.weights)
+    nk = len(a.keys)
+    return Batch(cols[:nk], cols[nk:], w)
+
+
 def _pad_sentinel(col: jnp.ndarray, cap: int) -> jnp.ndarray:
-    n = col.shape[0]
+    n = col.shape[-1]
     if n == cap:
         return col
     assert n < cap, f"column of {n} rows exceeds capacity {cap}"
-    return jnp.concatenate([col, kernels.sentinel_fill((cap - n,), col.dtype)])
+    fill = kernels.sentinel_fill((*col.shape[:-1], cap - n), col.dtype)
+    return jnp.concatenate([col, fill], axis=-1)
 
 
 def concat_batches(batches: Sequence[Batch]) -> Batch:
-    """Stack batches into one (un-consolidated) batch of summed capacity."""
+    """Stack batches into one (un-consolidated) batch of summed capacity
+    (row axis = last axis, so sharded batches concat per worker)."""
     assert batches
     first = batches[0]
     keys = tuple(
-        jnp.concatenate([b.keys[i] for b in batches])
+        jnp.concatenate([b.keys[i] for b in batches], axis=-1)
         for i in range(len(first.keys)))
     vals = tuple(
-        jnp.concatenate([b.vals[i] for b in batches])
+        jnp.concatenate([b.vals[i] for b in batches], axis=-1)
         for i in range(len(first.vals)))
-    w = jnp.concatenate([b.weights for b in batches])
+    w = jnp.concatenate([b.weights for b in batches], axis=-1)
     return Batch(keys, vals, w)

@@ -1,0 +1,146 @@
+"""Differential tests for the linear aggregation fast path.
+
+Oracle pattern (SURVEY.md §4): the incremental linear operator must produce
+output deltas whose integral equals (a) the general trace-gather path's and
+(b) a from-scratch recomputation over the integrated input — under inserts,
+retractions, weight>1 rows, and keys vanishing entirely.
+"""
+
+import random
+
+import jax.numpy as jnp
+import pytest
+
+from dbsp_tpu.circuit import Runtime
+from dbsp_tpu.operators import add_input_zset
+from dbsp_tpu.operators.aggregate import Average, Count, Sum
+from dbsp_tpu.operators.aggregate_linear import (LinearAverage, LinearCount,
+                                                 LinearSum)
+
+
+def _drive(agg_pairs, ticks):
+    """Run linear + general operators over the same input; return per-tick
+    integrated outputs for each."""
+    def build(c):
+        s, h = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+        outs = []
+        for i, (lin, gen) in enumerate(agg_pairs):
+            outs.append((s.aggregate(lin, name=f"lin{i}").output(),
+                         s.aggregate(gen, name=f"gen{i}").output()))
+        return h, outs
+
+    handle, (h, outs) = Runtime.init_circuit(1, build)
+    integrals = [[{}, {}] for _ in agg_pairs]
+    model = {}  # key -> list of (val, weight) integral for the oracle
+    results = []
+    for rows in ticks:
+        for (row, w) in rows:
+            h.push(row, w)
+            model[row] = model.get(row, 0) + w
+            if model[row] == 0:
+                del model[row]
+        handle.step()
+        tick_result = []
+        for i, (lo, go) in enumerate(outs):
+            for j, out in enumerate((lo, go)):
+                b = out.take()
+                if b is not None:
+                    for r, w in b.to_dict().items():
+                        integrals[i][j][r] = integrals[i][j].get(r, 0) + w
+                        if integrals[i][j][r] == 0:
+                            del integrals[i][j][r]
+            tick_result.append((dict(integrals[i][0]), dict(integrals[i][1])))
+        results.append(tick_result)
+    return results, model
+
+
+def _oracle(model, kind):
+    out = {}
+    groups = {}
+    for (k, v), w in model.items():
+        groups.setdefault(k, []).append((v, w))
+    for k, rows in groups.items():
+        cnt = sum(w for _, w in rows if w > 0)
+        if cnt <= 0:
+            continue
+        s = sum(v * w for v, w in rows if w > 0)
+        if kind == "count":
+            out[(k, cnt)] = 1
+        elif kind == "sum":
+            out[(k, s)] = 1
+        else:  # avg, truncating division
+            q = abs(s) // cnt
+            out[(k, q if s >= 0 else -q)] = 1
+    return out
+
+
+AGG_SPECS = [
+    (LinearCount(), Count(), "count"),
+    (LinearSum(0), Sum(0), "sum"),
+    (LinearAverage(0), Average(0), "avg"),
+]
+
+
+def test_linear_matches_general_and_oracle():
+    rng = random.Random(7)
+    live = []
+    ticks = []
+    for _ in range(6):
+        rows = []
+        for _ in range(40):
+            action = rng.random()
+            if action < 0.35 and live:  # retract something present
+                row, w = live.pop(rng.randrange(len(live)))
+                rows.append((row, -w))
+            else:
+                row = (rng.randrange(8), rng.randrange(-50, 50))
+                w = rng.choice([1, 1, 2, 3])
+                rows.append((row, w))
+                live.append((row, w))
+        ticks.append(rows)
+
+    results, model = _drive([(l, g) for l, g, _ in AGG_SPECS], ticks)
+    # every tick: linear integral == general integral (exact stepwise parity)
+    for tick in results:
+        for i, (lin_int, gen_int) in enumerate(tick):
+            assert lin_int == gen_int, f"divergence in {AGG_SPECS[i][2]}"
+    # final: both match the from-scratch oracle
+    for i, (_, _, kind) in enumerate(AGG_SPECS):
+        lin_int, gen_int = results[-1][i]
+        assert lin_int == _oracle(model, kind)
+
+
+def test_key_vanishes_and_returns():
+    ticks = [
+        [(((1, 10)), 1), (((1, 20)), 1), (((2, 5)), 1)],
+        [(((1, 10)), -1), (((1, 20)), -1)],          # key 1 disappears
+        [(((1, 7)), 2)],                              # returns, weight 2
+        [(((2, 5)), -1)],                             # key 2 disappears
+    ]
+    results, model = _drive([(l, g) for l, g, _ in AGG_SPECS], ticks)
+    for tick in results:
+        for i, (lin_int, gen_int) in enumerate(tick):
+            assert lin_int == gen_int, f"divergence in {AGG_SPECS[i][2]}"
+    lin_count = results[-1][0][0]
+    assert lin_count == {(1, 2): 1}  # key 1: weight-2 row; key 2 gone
+    lin_avg = results[-1][2][0]
+    assert lin_avg == {(1, 7): 1}
+
+
+def test_no_output_when_aggregate_unchanged():
+    """Inserting then retracting within later ticks must not emit spurious
+    diffs for untouched keys, and unchanged aggregates emit nothing."""
+    def build(c):
+        s, h = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+        return h, s.aggregate(LinearSum(0), name="s").output()
+
+    handle, (h, out) = Runtime.init_circuit(1, build)
+    h.push((1, 10), 1)
+    handle.step()
+    assert out.take().to_dict() == {(1, 10): 1}
+    # +5 and -5 to the same key in one tick: sum unchanged -> no delta
+    h.push((1, 5), 1)
+    h.push((1, 5), -1)
+    handle.step()
+    b = out.take()
+    assert b is None or b.to_dict() == {}

@@ -111,3 +111,149 @@ def test_sharded_join_matches_single_worker(mesh):
     sharded_out = jax.jit(spmd(mesh, local_join))(ls, rs)
     assert unshard_batch(sharded_out).to_dict() == want
     assert want, "vacuous join test"
+
+
+# ---------------------------------------------------------------------------
+# Circuit-level sharded execution: full queries via the normal Stream API at
+# 8 workers must produce output Z-sets identical to the 1-worker run
+# (reference contract: shard.rs:35-88; VERDICT round-1 item #2).
+# ---------------------------------------------------------------------------
+
+
+def _run_nexmark_query(qname: str, workers: int, ticks: int = 3,
+                       batch: int = 2000):
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    gen = NexmarkGenerator(GeneratorConfig(seed=3))
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, getattr(queries, qname)(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(workers, build)
+    integral = {}
+    n = 0
+    for _ in range(ticks):
+        gen.feed(handles, n, n + batch)
+        handle.step()
+        b = out.take()
+        if b is not None:
+            for r, w in b.to_dict().items():
+                integral[r] = integral.get(r, 0) + w
+                if integral[r] == 0:
+                    del integral[r]
+        n += batch
+    return integral
+
+
+@pytest.mark.parametrize("qname", ["q3", "q4"])
+def test_circuit_query_8workers_matches_1worker(mesh, qname):
+    want = _run_nexmark_query(qname, workers=1)
+    got = _run_nexmark_query(qname, workers=8)
+    assert got == want
+    assert want, f"vacuous {qname} comparison"
+
+
+def test_circuit_join_aggregate_distinct_8workers(mesh):
+    """Plain Stream-API pipeline (join + linear & general aggregates +
+    distinct) at 8 workers: identical integral to 1 worker, including under
+    retractions."""
+    import random
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.operators import add_input_zset
+    from dbsp_tpu.operators.aggregate import Max
+    from dbsp_tpu.operators.aggregate_linear import LinearSum
+
+    def run(workers):
+        def build(c):
+            a, ha = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+            b, hb = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+            j = a.join_index(b, lambda k, av, bv: (k, (av[0] + bv[0],)),
+                             (jnp.int64,), (jnp.int64,))
+            return (ha, hb), {
+                "sum": j.aggregate(LinearSum(0)).output(),
+                "max": j.aggregate(Max(0)).output(),
+                "distinct": j.distinct().output(),
+            }
+
+        handle, ((ha, hb), outs) = Runtime.init_circuit(workers, build)
+        rng = random.Random(11)
+        integrals = {name: {} for name in outs}
+        live = []
+        for _ in range(4):
+            for _ in range(30):
+                if rng.random() < 0.3 and live:
+                    side, row, w = live.pop(rng.randrange(len(live)))
+                    (ha if side == 0 else hb).push(row, -w)
+                else:
+                    side = rng.randrange(2)
+                    row = (rng.randrange(10), rng.randrange(100))
+                    w = rng.choice([1, 2])
+                    (ha if side == 0 else hb).push(row, w)
+                    live.append((side, row, w))
+            handle.step()
+            for name, out in outs.items():
+                b = out.take()
+                if b is not None:
+                    for r, wt in b.to_dict().items():
+                        d = integrals[name]
+                        d[r] = d.get(r, 0) + wt
+                        if d[r] == 0:
+                            del d[r]
+        return integrals
+
+    want = run(1)
+    got = run(8)
+    assert got == want
+    assert all(want.values()), "vacuous comparison"
+
+
+def test_unlifted_ops_run_at_8workers_via_unshard(mesh):
+    """topk / rolling / window / upsert inputs are not shard-lifted yet;
+    they must still run correctly inside an 8-worker circuit (the unshard
+    fallback) with outputs identical to 1 worker."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.operators import add_input_map, add_input_zset
+    from dbsp_tpu.operators.aggregate import Sum
+
+    def run(workers):
+        def build(c):
+            s, h = add_input_zset(c, (jnp.int64, jnp.int64), (jnp.int64,))
+            m, hm = add_input_map(c, (jnp.int64,), (jnp.int64,))
+            return (h, hm), {
+                "topk": s.topk(2).output(),
+                "rolling": s.partitioned_rolling_aggregate(
+                    Sum(0), 100).output(),
+                "upsert": m.distinct().output(),
+            }
+
+        handle, ((h, hm), outs) = Runtime.init_circuit(workers, build)
+        integrals = {name: {} for name in outs}
+        ticks = [
+            [((1, 10, 5), 1), ((1, 20, 7), 1), ((2, 10, 3), 1)],
+            [((1, 30, 9), 1), ((1, 10, 5), -1), ((2, 150, 4), 1)],
+        ]
+        upserts = [[(1, (10,)), (2, (20,))], [(1, (11,)), (3, (30,))]]
+        for rows, ups in zip(ticks, upserts):
+            for row, w in rows:
+                h.push(row, w)
+            for k, v in ups:
+                hm.upsert((k,), v)
+            handle.step()
+            for name, out in outs.items():
+                b = out.take()
+                if b is not None:
+                    for r, wt in b.to_dict().items():
+                        d = integrals[name]
+                        d[r] = d.get(r, 0) + wt
+                        if d[r] == 0:
+                            del d[r]
+        return integrals
+
+    want = run(1)
+    got = run(8)
+    assert got == want
+    assert all(want.values()), "vacuous comparison"
