@@ -26,12 +26,15 @@ from dbsp_tpu.zset.batch import Batch
 
 class Delta0(ImportOperator):
     """Emits the parent value on the child's first tick, zero afterwards
-    (operator/delta0.rs)."""
+    (operator/delta0.rs). ``hold=True`` re-emits the value EVERY child tick
+    instead — the constant-import shape per-tick operators (stream_join /
+    stream_aggregate) need in iterate()-style children."""
 
     name = "delta0"
 
-    def __init__(self, zero_factory: Callable[[], Any]):
+    def __init__(self, zero_factory: Callable[[], Any], hold: bool = False):
         self.zero_factory = zero_factory
+        self.hold = hold
         self.value: Any = None
         self.first = True
 
@@ -40,7 +43,7 @@ class Delta0(ImportOperator):
         self.first = True
 
     def eval(self) -> Any:
-        if self.first:
+        if self.first or self.hold:
             self.first = False
             return self.value
         return self.zero_factory()
@@ -69,11 +72,22 @@ class ChildCircuit(Circuit):
         self.exports: List[int] = []                   # child node indices
         self.conditions: List[int] = []                # child node indices
         self.max_iterations = 10_000
+        self.iteration = 0            # current child tick (set per step)
+        self.run_exact: Optional[int] = None  # fixed iteration count (e.g.
+        #                               PageRank-style loops), no fixedpoint
+        # True (set by recursive()): child operators are incremental ACROSS
+        # parent ticks via nested (epoch, iteration) timestamps — imports are
+        # parent DELTAS, join/distinct dispatch to nested variants, and
+        # per-epoch work is proportional to the parent delta. False: the
+        # round-1 regime — child state resets per epoch, imports must be
+        # integrals (iterate()-style children with aggregates use this).
+        self.nested_incremental = False
 
     def import_stream(self, parent_stream: Stream,
-                      zero_factory: Optional[Callable[[], Any]] = None
-                      ) -> Stream:
-        """delta0 import of a parent stream into this clock domain."""
+                      zero_factory: Optional[Callable[[], Any]] = None,
+                      hold: bool = False) -> Stream:
+        """delta0 import of a parent stream into this clock domain
+        (``hold=True``: re-emit the value every child tick)."""
         assert parent_stream.circuit is self.parent, \
             "import_stream takes a stream of the immediate parent"
         if zero_factory is None:
@@ -81,7 +95,7 @@ class ChildCircuit(Circuit):
             assert schema is not None, \
                 "import_stream needs schema metadata or zero_factory"
             zero_factory = lambda: Batch.empty(*schema)  # noqa: E731
-        op = Delta0(zero_factory)
+        op = Delta0(zero_factory, hold=hold)
         node = self._add_node(op, "import", [])
         self.imports.append((parent_stream.node_index, op))
         s = Stream(self, node.index)

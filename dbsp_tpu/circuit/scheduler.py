@@ -127,7 +127,10 @@ class IterativeExecutor:
             child._executor = OnceExecutor(child)
 
         exports = None
-        for _ in range(child.max_iterations):
+        limit = child.run_exact if child.run_exact is not None \
+            else child.max_iterations
+        for it in range(limit):
+            child.iteration = it  # nested ops read the (epoch, i) clock
             # evaluate one child tick, capturing export/condition values
             child._emit_scheduler_event(SchedulerEvent(kind="step_start"))
             for node in child._executor.order:
@@ -138,12 +141,13 @@ class IterativeExecutor:
                 for i in child.conditions) if child.conditions else True
             child._values.clear()
             child._emit_scheduler_event(SchedulerEvent(kind="step_end"))
-            if done and all(n.operator.fixedpoint(scope)
-                            for n in child.nodes):
+            if child.run_exact is None and done and all(
+                    n.operator.fixedpoint(scope) for n in child.nodes):
                 break
         else:
-            raise RuntimeError(
-                f"nested circuit did not reach a fixedpoint within "
-                f"{child.max_iterations} iterations")
+            if child.run_exact is None:
+                raise RuntimeError(
+                    f"nested circuit did not reach a fixedpoint within "
+                    f"{child.max_iterations} iterations")
         child.clock_end(scope)
         return exports

@@ -1,0 +1,133 @@
+"""Embedded web console (reference: ``web-ui/`` — the Next.js console's
+home / SQL-editor / pipeline-management pages, reduced to one dependency-free
+HTML page served by the pipeline manager at ``GET /``).
+
+Capabilities: list programs and pipelines, author a program (SQL views over
+declared tables), start/stop pipelines, push rows into a running pipeline's
+input collections, and peek output views — all over the existing REST
+surfaces (manager + per-pipeline circuit servers)."""
+
+CONSOLE_HTML = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>dbsp_tpu console</title>
+<style>
+  body { font-family: -apple-system, system-ui, sans-serif; margin: 0;
+         background: #0f1115; color: #e6e6e6; }
+  header { padding: 14px 22px; background: #171a21;
+           border-bottom: 1px solid #2a2e38; font-size: 18px; }
+  header b { color: #7aa2f7; }
+  main { display: grid; grid-template-columns: 1fr 1fr; gap: 18px;
+         padding: 18px 22px; }
+  section { background: #171a21; border: 1px solid #2a2e38;
+            border-radius: 8px; padding: 14px 16px; }
+  h2 { margin: 0 0 10px; font-size: 14px; text-transform: uppercase;
+       letter-spacing: .08em; color: #9aa5b1; }
+  textarea, input { width: 100%; box-sizing: border-box; background: #0f1115;
+        color: #e6e6e6; border: 1px solid #2a2e38; border-radius: 6px;
+        padding: 8px; font-family: ui-monospace, monospace; font-size: 13px; }
+  textarea { min-height: 90px; }
+  button { background: #2f4d8a; color: #fff; border: 0; border-radius: 6px;
+           padding: 7px 14px; margin: 6px 6px 0 0; cursor: pointer; }
+  button.warn { background: #8a2f2f; }
+  table { width: 100%; border-collapse: collapse; font-size: 13px; }
+  td, th { border-bottom: 1px solid #2a2e38; padding: 5px 8px;
+           text-align: left; }
+  .status-running { color: #9ece6a; } .status-failed { color: #f7768e; }
+  .status-stopped { color: #9aa5b1; }
+  pre { background: #0f1115; padding: 8px; border-radius: 6px;
+        overflow: auto; max-height: 240px; }
+  label { font-size: 12px; color: #9aa5b1; display: block; margin: 8px 0 3px; }
+</style>
+</head>
+<body>
+<header><b>dbsp_tpu</b> console — incremental view maintenance on TPU</header>
+<main>
+  <section>
+    <h2>New program</h2>
+    <label>name</label><input id="pname" value="demo"/>
+    <label>tables (JSON: {name: [columns...]})</label>
+    <textarea id="ptables">{"events": ["id", "category", "amount"]}</textarea>
+    <label>views (JSON: {view: "SELECT ..."})</label>
+    <textarea id="psql">{"totals": "SELECT category, sum(amount) AS total FROM events GROUP BY category"}</textarea>
+    <button onclick="createProgram()">Save program</button>
+    <button onclick="startPipeline()">Start pipeline</button>
+    <h2 style="margin-top:16px">Programs</h2>
+    <pre id="programs">-</pre>
+  </section>
+  <section>
+    <h2>Pipelines</h2>
+    <table id="pipelines"><tr><th>name</th><th>status</th><th>port</th>
+      <th>steps</th><th></th></tr></table>
+    <h2 style="margin-top:16px">Interact</h2>
+    <label>pipeline port</label><input id="ioport"/>
+    <label>input collection + rows (JSON list of lists)</label>
+    <input id="icoll" value="events"/>
+    <textarea id="irows">[[1, 3, 250], [2, 3, 100], [3, 7, 40]]</textarea>
+    <button onclick="pushRows()">Push</button>
+    <label>output view</label><input id="ocoll" value="totals"/>
+    <button onclick="readView()">Read</button>
+    <button onclick="readStats()">Stats</button>
+    <pre id="io">-</pre>
+  </section>
+</main>
+<script>
+const j = (u, opt) => fetch(u, opt).then(r => r.text()).then(t => {
+  try { return JSON.parse(t); } catch (e) { return t; } });
+async function refresh() {
+  document.getElementById('programs').textContent =
+      JSON.stringify(await j('/programs'), null, 1);
+  const ps = await j('/pipelines');
+  const tbl = document.getElementById('pipelines');
+  tbl.innerHTML = '<tr><th>name</th><th>status</th><th>port</th>' +
+                  '<th>steps</th><th></th></tr>';
+  for (const p of ps) {
+    const tr = document.createElement('tr');
+    tr.innerHTML = `<td>${p.name}</td>` +
+      `<td class="status-${p.status}">${p.status}${p.error ? ' — ' + p.error : ''}</td>` +
+      `<td>${p.port ?? ''}</td><td>${p.steps ?? ''}</td>` +
+      `<td><button class="warn" onclick="stopPipeline('${p.name}')">stop</button></td>`;
+    tbl.appendChild(tr);
+    if (p.port) document.getElementById('ioport').value = p.port;
+  }
+}
+async function createProgram() {
+  const body = { name: val('pname'), tables: JSON.parse(val('ptables')),
+                 sql: JSON.parse(val('psql')) };
+  show(await j('/programs', post(body)));
+  refresh();
+}
+async function startPipeline() {
+  show(await j('/pipelines',
+               post({ name: val('pname'), program: val('pname') })));
+  refresh();
+}
+async function stopPipeline(name) {
+  show(await j(`/pipelines/${name}/shutdown`, post({})));
+  refresh();
+}
+async function pushRows() {
+  const rows = JSON.parse(val('irows'))
+      .map(r => JSON.stringify({ insert: r })).join('\n');
+  show(await fetch(
+      `http://127.0.0.1:${val('ioport')}/input_endpoint/${val('icoll')}?format=json`,
+      { method: 'POST', body: rows }).then(r => r.text()));
+}
+async function readView() {
+  show(await fetch(
+      `http://127.0.0.1:${val('ioport')}/output_endpoint/${val('ocoll')}?format=json`)
+      .then(r => r.text()) || '(empty)');
+}
+async function readStats() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/stats`));
+}
+const val = id => document.getElementById(id).value;
+const post = b => ({ method: 'POST', body: JSON.stringify(b) });
+const show = x => document.getElementById('io').textContent =
+    typeof x === 'string' ? x : JSON.stringify(x, null, 1);
+refresh(); setInterval(refresh, 4000);
+</script>
+</body>
+</html>
+"""

@@ -36,8 +36,19 @@ class CircuitServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # the manager's console (another port) fetches these routes
+                self.send_header("Access-Control-Allow-Origin", "*")
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_OPTIONS(self):  # CORS preflight for the console
+                self.send_response(204)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Access-Control-Allow-Methods",
+                                 "GET, POST, OPTIONS")
+                self.send_header("Access-Control-Allow-Headers",
+                                 "Content-Type")
+                self.end_headers()
 
             def _json(self, obj, code=200):
                 self._reply(code, json.dumps(obj).encode())
@@ -77,12 +88,14 @@ class CircuitServer:
                     if batch is None:
                         self.send_response(200)
                         self.send_header("X-Dbsp-Step", step)
+                        self.send_header("Access-Control-Allow-Origin", "*")
                         self.send_header("Content-Length", "0")
                         self.end_headers()
                     else:
                         body = OUTPUT_FORMATS[fmt]().encode(batch)
                         self.send_response(200)
                         self.send_header("X-Dbsp-Step", step)
+                        self.send_header("Access-Control-Allow-Origin", "*")
                         self.send_header("Content-Type", "text/plain")
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()

@@ -119,7 +119,17 @@ class PipelineManager:
 
             def do_GET(self):
                 parts = self.path.rstrip("/").split("/")
-                if self.path.rstrip("/") == "/programs":
+                if self.path in ("/", ""):
+                    from dbsp_tpu.console import CONSOLE_HTML
+
+                    body = CONSOLE_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.rstrip("/") == "/programs":
                     self._json(sorted(mgr.programs))
                 elif self.path.rstrip("/") == "/pipelines":
                     self._json([p.describe() for p in mgr.pipelines.values()])

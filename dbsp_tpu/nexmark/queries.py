@@ -81,6 +81,7 @@ def q3(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
 
 Q5_WINDOW_MS = 10_000
 Q5_HOP_MS = 2_000
+Q5_RETAIN_MS = 4 * Q5_WINDOW_MS  # completed windows linger this long
 
 
 def q5(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
@@ -102,6 +103,14 @@ def q5(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
 
     per_window = bids.flat_map_rows(
         assign, fanout, (jnp.int64, jnp.int64), (), name="q5-windows")
+    # retire old windows (queries/q5.rs keeps state bounded the same way):
+    # a watermark on bid time drives monotone bounds; windows whose start
+    # falls below wm - retention are retracted AND their trace state GC'd
+    wm = bids.watermark_monotonic(lambda k, v: v[M.B_DATE], lateness=0)
+    bounds = wm.apply(
+        lambda w: None if w is None else (w - Q5_RETAIN_MS, 1 << 62),
+        name="q5-bounds")
+    per_window = per_window.window(bounds, gc=True)
     counts = per_window.aggregate(Count(), name="q5-count")
     # counts: key=(window, auction) val=(n). Max n per window:
     by_window = counts.index_by(
@@ -349,18 +358,93 @@ def q15(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     return by_day.aggregate(Count(), name="q15-count")
 
 
+Q16_RANK1 = 10_000
+Q16_RANK2 = 1_000_000
+Q16_NSTATS = 12
+
+
+import dataclasses as _dc
+
+from dbsp_tpu.operators.aggregate_linear import LinearAggregator
+
+
+@_dc.dataclass(frozen=True)
+class _Q16Stats(LinearAggregator):
+    """12-column linear sum: each input row is a one-hot stat contribution;
+    summing per (channel, day) assembles the full stat row with zeros for
+    absent ranks — the left-join-with-default-0 the reference's SQL
+    `count(*) filter (...)` columns imply."""
+
+    acc_dtypes = (jnp.int64,) * Q16_NSTATS
+    out_dtypes = (jnp.int64,) * Q16_NSTATS
+    name = "q16stats"
+
+    def weigh(self, val_cols):
+        return tuple(val_cols[:Q16_NSTATS])
+
+    def finalize(self, acc_cols, count):
+        return acc_cols
+
+
 def q16(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
-    """Channel statistics per day (queries/q16.rs, simplified to the core
-    aggregates): (channel, day) -> (total_bids, distinct_bidders)."""
-    keyed = bids.map_rows(
+    """Channel statistics per day (queries/q16.rs, the FULL stat set):
+    (channel, day) -> (total_bids, rank1/2/3_bids, total_bidders,
+    rank1/2/3_bidders, total_auctions, rank1/2/3_auctions), where rank
+    buckets split on price < 10_000 / < 1_000_000 / >= (q16.rs:55-66).
+
+    Shape: one Count per bid rank (4 streams), one distinct+Count per
+    (bidder x rank) and (auction x rank) (8 streams); each stat maps to a
+    one-hot 12-column row and a single 12-column linear sum per
+    (channel, day) assembles the output with 0 for empty buckets."""
+    def rank_of(price):
+        return jnp.where(price < Q16_RANK1, 1,
+                         jnp.where(price < Q16_RANK2, 2, 3))
+
+    base = bids.map_rows(
         lambda k, v: ((v[M.B_CHANNEL].astype(jnp.int64),
-                       v[M.B_DATE] // DAY_MS), (v[M.B_BIDDER],)),
-        (jnp.int64, jnp.int64), (jnp.int64,), name="q16-key")
-    totals = keyed.aggregate(Count(), name="q16-total")
-    uniq_bidders = keyed.distinct().aggregate(Count(), name="q16-distinct")
-    return totals.join_index(
-        uniq_bidders, lambda k, tv, uv: (k, (tv[0], uv[0])),
-        (jnp.int64, jnp.int64), (jnp.int64, jnp.int64), name="q16-join")
+                       v[M.B_DATE] // DAY_MS),
+                      (k[0], v[M.B_BIDDER], rank_of(v[M.B_PRICE]))),
+        (jnp.int64, jnp.int64), (jnp.int64, jnp.int64, jnp.int64),
+        name="q16-base")  # (channel, day) -> (auction, bidder, rank)
+
+    def rank_filter(s, r, name):
+        return s if r == 0 else s.filter_rows(
+            lambda k, v, _r=r: v[2] == _r, name=name)
+
+    stats = []  # (slot, stream of (channel, day) -> count)
+    for r in range(4):  # bids counts: slots 0..3
+        stats.append((r, rank_filter(base, r, f"q16-bids-r{r}")
+                      .aggregate(Count(), name=f"q16-nbids-r{r}")))
+    for col, what in ((1, "bidder"), (0, "auction")):
+        for r in range(4):  # bidders: slots 4..7; auctions: slots 8..11
+            slot = (4 if what == "bidder" else 8) + r
+            uniq = rank_filter(base, r, f"q16-{what}-r{r}-f").map_rows(
+                lambda k, v, _c=col: ((k[0], k[1], v[_c]), ()),
+                (jnp.int64, jnp.int64, jnp.int64), (),
+                name=f"q16-{what}-r{r}-key").distinct()
+            cnt = uniq.index_by(
+                lambda k, v: (k[0], k[1]), (jnp.int64, jnp.int64),
+                val_fn=lambda k, v: (k[2],), val_dtypes=(jnp.int64,),
+                name=f"q16-{what}-r{r}-by").aggregate(
+                    Count(), name=f"q16-n{what}-r{r}")
+            stats.append((slot, cnt))
+
+    # one-hot each stat into the 12-column layout and sum
+    onehot = []
+    for slot, s in stats:
+        def mk(slot):
+            def f(k, v):
+                z = jnp.zeros_like(v[0])
+                return k, tuple(v[0] if i == slot else z
+                                for i in range(Q16_NSTATS))
+            return f
+
+        oh = s.map_rows(mk(slot), (jnp.int64, jnp.int64),
+                        (jnp.int64,) * Q16_NSTATS, name=f"q16-oh{slot}")
+        onehot.append(oh)
+    combined = onehot[0].sum_with(onehot[1:])
+    combined.schema = ((jnp.int64, jnp.int64), (jnp.int64,) * Q16_NSTATS)
+    return combined.aggregate(_Q16Stats(), name="q16-stats")
 
 
 def q17(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
@@ -422,9 +506,10 @@ def q20(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
 
 def q21(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     """Channel id classification (queries/q21.rs): channels 0-3 map to fixed
-    ids (the reference's Google/Facebook/Baidu/Apple CASE), others derive
-    from the channel code (its url-hash arm). Strings are dictionary codes
-    (generator.py); the CASE is arithmetic on codes."""
+    ids (the reference's apple/google/facebook/baidu CASE), others extract
+    channel_id from the url. Strings are dictionary codes; the host-side
+    dictionary (``nexmark/strings.py``) is constructed so this arithmetic
+    EQUALS the CASE/regex over the decoded strings (fidelity-tested)."""
     def classify(k, v):
         ch = v[M.B_CHANNEL].astype(jnp.int64)
         chan_id = jnp.where(ch < 4, ch, 100 + ch)
@@ -436,9 +521,10 @@ def q21(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
 
 
 def q22(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
-    """URL split (queries/q22.rs): dir1/dir2/dir3 of the bid url. Urls are
-    dictionary-coded; the synthetic generator derives part codes from the
-    url code arithmetically (host dictionaries own the real strings)."""
+    """URL split (queries/q22.rs): dir1/dir2/dir3 of the bid url. URLs are
+    dictionary-coded; ``nexmark/strings.py`` owns the real strings, built so
+    this mod/div arithmetic EQUALS split_part over the decoded url
+    (fidelity-tested)."""
     def split(k, v):
         url = v[M.B_CHANNEL].astype(jnp.int64)  # channel doubles as url code
         dir1 = url % 7

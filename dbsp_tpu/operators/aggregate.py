@@ -452,6 +452,10 @@ def aggregate(self: Stream, agg, name=None) -> Stream:
 
     schema = getattr(self, "schema", None)
     assert schema is not None, "aggregate needs stream schema metadata"
+    assert not getattr(self.circuit, "nested_incremental", False), (
+        "aggregates inside an incremental recursive() child are not "
+        "supported yet — restructure so aggregation happens outside the "
+        "fixedpoint, or use an iterate()-style subcircuit (reset-per-epoch)")
     if isinstance(agg, LinearAggregator):
         src = self.shard()  # co-locate keys (no-op on one worker)
         out = src.circuit.add_unary_operator(

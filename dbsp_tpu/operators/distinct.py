@@ -100,10 +100,20 @@ class StreamDistinct(UnaryOperator):
 
 @stream_method
 def distinct(self: Stream) -> Stream:
-    """Incremental distinct (root scope)."""
+    """Incremental distinct; dispatches to the nested (epoch, iteration)
+    variant inside a recursive() child (distinct.rs:64 nested scope)."""
+    schema = getattr(self, "schema", None)
+    if getattr(self.circuit, "nested_incremental", False):
+        from dbsp_tpu.operators.nested_ops import NestedDistinctOp
+
+        assert schema is not None, "distinct needs stream schema metadata"
+        out = self.circuit.add_unary_operator(
+            NestedDistinctOp(schema, self.circuit), self)
+        out.schema = schema
+        return out
     t = self.trace()
     out = self.circuit.add_unary_operator(DistinctOp(), t)
-    out.schema = getattr(self, "schema", None)
+    out.schema = schema
     out.key_sharded = getattr(t, "key_sharded", False)
     return out
 

@@ -167,12 +167,22 @@ def join_index(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
     ls, rs = getattr(self, "schema", None), getattr(other, "schema", None)
     assert ls is not None and rs is not None, "join needs schemas on both sides"
     assert ls[0] == rs[0], f"join key dtypes differ: {ls[0]} vs {rs[0]}"
+    out_schema = (tuple(out_key_dtypes), tuple(out_val_dtypes))
+    if getattr(self.circuit, "nested_incremental", False):
+        # inside a recursive() child: joins are incremental over the
+        # (epoch, iteration) product lattice and own their state
+        from dbsp_tpu.operators.nested_ops import NestedJoinOp
+
+        out = self.circuit.add_binary_operator(
+            NestedJoinOp(fn, len(ls[0]), (ls, rs), out_schema, self.circuit,
+                         name=f"nested-{name}"), self, other)
+        out.schema = out_schema
+        return out
     lt = self.trace()
     rt = other.trace()
     out = self.circuit.add_binary_operator(
-        JoinOp(fn, len(ls[0]), (tuple(out_key_dtypes), tuple(out_val_dtypes)),
-               name), lt, rt)
-    out.schema = (tuple(out_key_dtypes), tuple(out_val_dtypes))
+        JoinOp(fn, len(ls[0]), out_schema, name), lt, rt)
+    out.schema = out_schema
     return out
 
 

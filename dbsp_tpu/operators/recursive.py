@@ -27,29 +27,81 @@ from dbsp_tpu.operators.z1 import Z1
 from dbsp_tpu.zset.batch import Batch
 
 
+def recursive_streams(parent: Circuit, inputs, f):
+    """Mutual least fixedpoint of R_k = distinct(f_k(R_1..R_n) ∪ I_k).
+
+    The n-ary generalization of :func:`recursive` (reference:
+    ``recursive.rs`` implements the same via tuples of streams): ``f(child,
+    [R_1..R_n]) -> [step_1..step_n]`` builds every relation's rule body in
+    ONE child circuit, so rules may join across relations (mutual
+    recursion, e.g. galen's p/q). Returns one delta stream per relation.
+    """
+    schemas = []
+    for s in inputs:
+        schema = getattr(s, "schema", None)
+        assert schema is not None, "recursive needs schema metadata"
+        schemas.append(schema)
+    inputs = [s.unshard() for s in inputs]  # nested ops are not shard-lifted
+
+    def ctor(child: ChildCircuit):
+        child.nested_incremental = True
+        i0s = [child.import_stream(s) for s in inputs]
+        fbs = []
+        for schema in schemas:
+            fb = child.add_feedback(
+                Z1(lambda _s=schema: Batch.empty(*_s)))
+            fb.stream.schema = schema
+            fbs.append(fb)
+        steps = f(child, [fb.stream for fb in fbs])
+        assert len(steps) == len(inputs), (
+            f"f must return {len(inputs)} streams, got {len(steps)}")
+        for step, i0, fb, schema in zip(steps, i0s, fbs, schemas):
+            assert getattr(step, "schema", None) == schema, (
+                f"f must preserve the relation schema {schema}, got "
+                f"{getattr(step, 'schema', None)}")
+            new = step.plus(i0)
+            new.schema = schema
+            delta = new.distinct()
+            delta.schema = schema
+            fb.connect(delta)
+            child.add_condition(delta)
+            child.export(delta.integrate())
+        return None
+
+    exports, _ = subcircuit(parent, ctor, iterative=True)
+    outs = []
+    for i, schema in enumerate(schemas):
+        out = exports.apply(lambda t, _i=i: t[_i], name=f"export{i}")
+        out.schema = schema
+        outs.append(out)
+    return outs
+
+
 def recursive(parent: Circuit, input_stream: Stream,
               f: Callable[[ChildCircuit, Stream], Stream]) -> Stream:
     """Least fixedpoint of R = distinct(f(R) ∪ I), as a parent stream.
 
-    ``f(child, delta_stream) -> stream`` builds the recursive step inside the
-    child circuit (it may use any operators, including joins against other
-    imported streams). The result is the full accumulated relation, exported
-    once the iteration converges — re-derived per parent tick (see
-    circuit/nested.py scope note).
+    ``f(child, delta_stream) -> stream`` builds the recursive step inside
+    the child circuit (it may use map/filter/flat_map/plus/minus, joins —
+    including against other imported streams — and distinct; those dispatch
+    to the nested (epoch, iteration)-incremental variants,
+    operators/nested_ops.py).
+
+    INCREMENTAL ACROSS PARENT TICKS (reference: recursive.rs:255-276 +
+    nested_ts32.rs): child operator state persists between epochs, imports
+    are parent DELTAS (import auxiliary streams raw:
+    ``child.import_stream(aux)``), and per-epoch work is proportional to
+    the input change, not the accumulated relation. The output stream
+    carries the DELTA of the fixedpoint relation per parent tick.
     """
     schema = getattr(input_stream, "schema", None)
     assert schema is not None, "recursive needs schema metadata on the input"
-
-    # Child state resets each parent tick (nested.py scope note), so the
-    # child must see the FULL current relation, not the tick's delta: import
-    # the integral. (The reference instead keeps child state across ticks
-    # via nested timestamps and imports deltas — the future optimization.)
-    # Auxiliary streams used inside ``f`` must likewise be imported
-    # integrated: child.import_stream(aux.integrate()).
-    full_input = input_stream.integrate()
+    # nested operators are not shard-lifted: collapse a sharded input first
+    input_stream = input_stream.unshard()
 
     def ctor(child: ChildCircuit):
-        i0 = child.import_stream(full_input)
+        child.nested_incremental = True
+        i0 = child.import_stream(input_stream)
         fb = child.add_feedback(Z1(lambda: Batch.empty(*schema)))
         fb.stream.schema = schema
         step = f(child, fb.stream)
@@ -58,22 +110,19 @@ def recursive(parent: Circuit, input_stream: Stream,
             f"{getattr(step, 'schema', None)}")
         new = step.plus(i0)
         new.schema = schema
-        delta = new.distinct()      # incremental: only not-yet-seen rows
+        delta = new.distinct()      # nested: only rows whose status changed
         delta.schema = schema
         fb.connect(delta)
         child.add_condition(delta)
+        # within-epoch integral of the 2-d deltas == this epoch's change of
+        # the fixedpoint relation (the iteration dimension telescopes), so
+        # the export already IS the parent-level delta stream
         acc = delta.integrate()
         child.export(acc)
         return None
 
     exports, _ = subcircuit(parent, ctor, iterative=True)
-    snapshot = exports.apply(lambda t: t[0], name="export0")
-    snapshot.schema = schema
-    # The child exports the full re-derived relation each parent tick;
-    # differentiate restores the framework-wide delta-stream convention so
-    # stateful consumers (traces, aggregates, joins) see changes, not
-    # snapshots.
-    out = snapshot.differentiate()
+    out = exports.apply(lambda t: t[0], name="export0")
     out.schema = schema
     return out
 

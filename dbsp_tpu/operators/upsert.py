@@ -89,7 +89,8 @@ class UpsertInput(SourceOperator):
             parts.append(Batch.from_tuples(inserts, self.key_dtypes,
                                            self.val_dtypes))
         if not parts:
-            return Batch.empty(self.key_dtypes, self.val_dtypes)
+            return Batch.empty(self.key_dtypes, self.val_dtypes,
+                               lead=(workers,) if workers > 1 else ())
         delta = parts[0] if len(parts) == 1 else \
             concat_batches(parts).consolidate().shrink_to_fit()
         # upsert state diffing stays host-side (the spine above); only the

@@ -7,14 +7,20 @@ hand-rolled tokenizer + recursive-descent parser for the subset that covers
 incremental view maintenance over streams:
 
     SELECT [DISTINCT] expr [AS name], ...
-    FROM table [alias] [JOIN table [alias] ON col = col]
+    FROM table [alias]
+    [[LEFT] JOIN table [alias] ON col = col
+       | JOIN table [alias] ON col BETWEEN expr AND expr]
     [WHERE predicate]
-    [GROUP BY col, ...]
+    [GROUP BY col, ...] [HAVING predicate]
+    [ORDER BY col [ASC|DESC], ...] [LIMIT n]
 
-with integer/float literals, + - * / %, comparisons, AND/OR/NOT, and
-aggregates COUNT(*) / COUNT / SUM / MIN / MAX / AVG. The planner
-(``sql/planner.py``) lowers the AST onto circuit operators, so every query
-is maintained incrementally like any hand-built circuit.
+with integer/float literals, + - * / %, comparisons, BETWEEN, AND/OR/NOT,
+aggregates COUNT(*) / COUNT / SUM / MIN / MAX / AVG, and scalar subqueries
+``(SELECT <aggregate> FROM ...)`` as comparison operands. The planner
+(``sql/planner.py``) lowers the AST onto circuit operators — ORDER BY +
+LIMIT onto top-K, LEFT JOIN onto join + antijoin, BETWEEN joins onto
+range joins — so every query is maintained incrementally like any
+hand-built circuit.
 """
 
 from __future__ import annotations
@@ -29,7 +35,8 @@ TOKEN_RE = re.compile(
 
 KEYWORDS = {"select", "distinct", "from", "join", "on", "where", "group",
             "by", "as", "and", "or", "not", "count", "sum", "min", "max",
-            "avg"}
+            "avg", "having", "order", "limit", "asc", "desc", "left",
+            "outer", "between"}
 
 
 def tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -84,7 +91,12 @@ class Agg:
     arg: Optional["Expr"]  # None for COUNT(*)
 
 
-Expr = Union[Col, Lit, BinOp, NotOp, Agg]
+@dataclasses.dataclass
+class Subquery:
+    select: "Select"      # scalar subquery (single aggregate, no grouping)
+
+
+Expr = Union[Col, Lit, BinOp, NotOp, Agg, Subquery]
 
 
 @dataclasses.dataclass
@@ -100,6 +112,21 @@ class TableRef:
 
 
 @dataclasses.dataclass
+class OrderItem:
+    col: Col
+    desc: bool
+
+
+@dataclasses.dataclass
+class RangeOn:
+    """JOIN ... ON <right col> BETWEEN <expr over left> AND <expr over left>."""
+
+    col: Col
+    lo: Expr
+    hi: Expr
+
+
+@dataclasses.dataclass
 class Select:
     items: List[SelectItem]
     distinct: bool
@@ -108,6 +135,11 @@ class Select:
     join_on: Optional[Tuple[Col, Col]]
     where: Optional[Expr]
     group_by: List[Col]
+    having: Optional[Expr] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    join_left: bool = False          # LEFT [OUTER] JOIN
+    join_range: Optional[RangeOn] = None  # BETWEEN join
 
 
 class Parser:
@@ -138,6 +170,12 @@ class Parser:
 
     # -- grammar ------------------------------------------------------------
     def parse_select(self) -> Select:
+        s = self.select_body()
+        if self.peek()[0] != "eof":
+            raise SyntaxError(f"trailing tokens: {self.toks[self.i:]}")
+        return s
+
+    def select_body(self) -> Select:
         self.expect("kw", "select")
         distinct = self.accept("kw", "distinct")
         items = [self.select_item()]
@@ -145,14 +183,25 @@ class Parser:
             items.append(self.select_item())
         self.expect("kw", "from")
         table = self.table_ref()
-        join = join_on = None
-        if self.accept("kw", "join"):
+        join = join_on = join_range = None
+        join_left = False
+        if self.peek() == ("kw", "left") or self.peek() == ("kw", "join"):
+            if self.accept("kw", "left"):
+                self.accept("kw", "outer")
+                join_left = True
+            self.expect("kw", "join")
             join = self.table_ref()
             self.expect("kw", "on")
             left = self.column()
-            self.expect("op", "=")
-            right = self.column()
-            join_on = (left, right)
+            if self.accept("kw", "between"):
+                lo = self.additive()
+                self.expect("kw", "and")
+                hi = self.additive()
+                join_range = RangeOn(left, lo, hi)
+            else:
+                self.expect("op", "=")
+                right = self.column()
+                join_on = (left, right)
         where = None
         if self.accept("kw", "where"):
             where = self.disjunction()
@@ -162,9 +211,25 @@ class Parser:
             group_by.append(self.column())
             while self.accept("op", ","):
                 group_by.append(self.column())
-        if self.peek()[0] != "eof":
-            raise SyntaxError(f"trailing tokens: {self.toks[self.i:]}")
-        return Select(items, distinct, table, join, join_on, where, group_by)
+        having = None
+        if self.accept("kw", "having"):
+            having = self.disjunction()
+        order_by: List[OrderItem] = []
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                col = self.column()
+                desc = bool(self.accept("kw", "desc"))
+                if not desc:
+                    self.accept("kw", "asc")
+                order_by.append(OrderItem(col, desc))
+                if not self.accept("op", ","):
+                    break
+        limit = None
+        if self.accept("kw", "limit"):
+            limit = int(self.expect("num")[1])
+        return Select(items, distinct, table, join, join_on, where, group_by,
+                      having, order_by, limit, join_left, join_range)
 
     def select_item(self) -> SelectItem:
         if self.peek() == ("op", "*"):
@@ -213,6 +278,12 @@ class Parser:
         if t[0] == "op" and t[1] in ("=", "<>", "!=", "<", "<=", ">", ">="):
             self.next()
             return BinOp(t[1], e, self.additive())
+        if t == ("kw", "between"):  # sugar: e BETWEEN a AND b
+            self.next()
+            lo = self.additive()
+            self.expect("kw", "and")
+            hi = self.additive()
+            return BinOp("and", BinOp(">=", e, lo), BinOp("<=", e, hi))
         return e
 
     def additive(self) -> Expr:
@@ -242,6 +313,10 @@ class Parser:
             return Lit(float(t[1]) if "." in t[1] else int(t[1]))
         if t[0] == "op" and t[1] == "(":
             self.next()
+            if self.peek() == ("kw", "select"):  # scalar subquery
+                sub = self.select_body()
+                self.expect("op", ")")
+                return Subquery(sub)
             e = self.disjunction()
             self.expect("op", ")")
             return e

@@ -9,10 +9,19 @@ and retractions on base tables propagate deltas through WHERE/JOIN/GROUP BY.
 Lowering map:
     WHERE                -> filter_rows (columnar predicate)
     JOIN ... ON a = b    -> index_by + incremental bilinear join
+    LEFT JOIN            -> inner join ∪ (antijoined left rows padded with
+                            the NULL marker, iinfo.min — see NULL_INT)
+    JOIN ON r BETWEEN l+c1 AND l+c2 -> incremental relative range join
+                            (operators/join_range.py)
     GROUP BY + agg       -> index_by + incremental aggregate (one per agg,
                             joined on the group key — reference's multi-agg
                             plans share the same shape)
+    HAVING               -> filter over the joined aggregate columns
     DISTINCT             -> incremental distinct
+    ORDER BY ... LIMIT n -> global top-K (operators/topk.py); ORDER BY
+                            without LIMIT is a no-op (Z-sets are unordered)
+    scalar subqueries    -> planned standalone, cross-joined on a unit key,
+                            then referenced like columns in WHERE
     plain SELECT         -> map_rows projection
 """
 
@@ -31,6 +40,12 @@ from dbsp_tpu.sql import parser as P
 
 AGG_CLASSES = {"count": Count, "sum": Sum, "min": Min, "max": Max,
                "avg": Average}
+
+# SQL NULL marker for outer-join padding: the dtype's MINIMUM (the maximum
+# is the engine's dead-row sentinel). Documented engine-wide convention —
+# the reference's nullable columns become (value | NULL_INT) here.
+def NULL_INT(dtype):
+    return int(np.iinfo(np.dtype(dtype)).min)
 
 
 class SqlError(ValueError):
@@ -54,6 +69,39 @@ class _Scope:
         if len(hits) > 1:
             raise SqlError(f"ambiguous column {want}")
         return hits[0]
+
+
+def _item_names(items) -> List[str]:
+    out = []
+    for i, item in enumerate(items):
+        if item.alias:
+            out.append(item.alias)
+        elif isinstance(item.expr, P.Col):
+            out.append(f"{item.expr.table}.{item.expr.name}"
+                       if item.expr.table else item.expr.name)
+        else:
+            out.append(f"col{i}")
+    return out
+
+
+def _collect_aggs(expr) -> List[P.Agg]:
+    if isinstance(expr, P.Agg):
+        return [expr]
+    if isinstance(expr, P.BinOp):
+        return _collect_aggs(expr.left) + _collect_aggs(expr.right)
+    if isinstance(expr, P.NotOp):
+        return _collect_aggs(expr.expr)
+    return []
+
+
+def _has_subquery(expr) -> bool:
+    if isinstance(expr, P.Subquery):
+        return True
+    if isinstance(expr, P.BinOp):
+        return _has_subquery(expr.left) or _has_subquery(expr.right)
+    if isinstance(expr, P.NotOp):
+        return _has_subquery(expr.expr)
+    return False
 
 
 def _compile_expr(expr, scope: _Scope):
@@ -132,10 +180,16 @@ class SqlContext:
 
     # -- planning -----------------------------------------------------------
     def query(self, sql: str) -> Stream:
-        ast = P.parse(sql)
+        return self._plan(P.parse(sql))
+
+    def _plan(self, ast: P.Select) -> Stream:
         stream, scope = self._plan_from(ast)
         if ast.where is not None:
-            pred, dt = _compile_expr(ast.where, scope)
+            where = ast.where
+            if _has_subquery(where):
+                stream, scope, where = self._bind_subqueries(
+                    stream, scope, where)
+            pred, dt = _compile_expr(where, scope)
             if dt != np.bool_:
                 raise SqlError("WHERE must be boolean")
             stream = stream.filter_rows(
@@ -143,10 +197,14 @@ class SqlContext:
         has_aggs = any(isinstance(i.expr, P.Agg) for i in ast.items)
         if has_aggs or ast.group_by:
             stream = self._plan_aggregate(ast, stream, scope)
+        elif ast.having is not None:
+            raise SqlError("HAVING requires GROUP BY / aggregates")
         else:
             stream = self._plan_project(ast, stream, scope)
         if ast.distinct:
             stream = stream.distinct()
+        if ast.limit is not None:
+            stream = self._plan_topk(ast, stream)
         return stream
 
     def _table_scope(self, ref: P.TableRef) -> Tuple[Stream, _Scope]:
@@ -162,6 +220,11 @@ class SqlContext:
         if ast.join is None:
             return left, ls
         right, rs = self._table_scope(ast.join)
+        if ast.join_range is not None:
+            if ast.join_left:
+                raise SqlError("LEFT JOIN with BETWEEN bounds is not "
+                               "supported yet")
+            return self._plan_range_join(ast, left, ls, right, rs)
         lcol, rcol = ast.join_on
         # resolve which side each ON column belongs to
         try:
@@ -193,15 +256,125 @@ class SqlContext:
         joined = lkeyed.join_index(
             rkeyed, lambda k, lvs, rvs: (k, (*lvs, *rvs)),
             (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-join")
+        if ast.join_left:
+            # LEFT JOIN: unmatched left rows survive, right columns padded
+            # with NULL_INT (the dtype's min — documented NULL convention)
+            nulls = tuple(NULL_INT(dt) for dt in rs.dtypes)
+
+            def pad(k, v, _nulls=nulls, _dts=tuple(rs.dtypes)):
+                return k, (*v, *(jnp.full(v[0].shape, nv, jnp.dtype(dt))
+                                 for nv, dt in zip(_nulls, _dts)))
+
+            missing = lkeyed.antijoin(rkeyed).map_rows(
+                pad, (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-leftpad")
+            joined = joined.plus(missing)
+            joined.schema = ((key_dt,), (*ls.dtypes, *rs.dtypes))
         scope = _Scope(["__jk__", *ls.names, *rs.names],
                        [key_dt, *ls.dtypes, *rs.dtypes])
         return joined, scope
+
+    def _plan_range_join(self, ast, left, ls, right, rs):
+        """JOIN r ON r.x BETWEEN l.y + c1 AND l.y + c2 -> relative range
+        join (operators/join_range.py)."""
+        import dbsp_tpu.operators.join_range  # noqa: F401 (register)
+
+        rng = ast.join_range
+        try:
+            ri = rs.index_of(rng.col)
+        except SqlError:
+            raise SqlError("range-join column must belong to the joined "
+                           f"table: {rng.col}")
+
+        def split_rel(e):
+            if isinstance(e, P.Col):
+                return e, 0
+            if isinstance(e, P.BinOp) and e.op in ("+", "-") and \
+                    isinstance(e.left, P.Col) and isinstance(e.right, P.Lit):
+                c = int(e.right.value)
+                return e.left, c if e.op == "+" else -c
+            raise SqlError(
+                "range-join bounds must be <left column> [± integer]")
+
+        lo_col, lo_c = split_rel(rng.lo)
+        hi_col, hi_c = split_rel(rng.hi)
+        if (lo_col.table, lo_col.name) != (hi_col.table, hi_col.name):
+            raise SqlError("range-join bounds must share one base column")
+        li = ls.index_of(lo_col)
+        key_dt = jnp.result_type(ls.dtypes[li], rs.dtypes[ri])
+
+        lkeyed = left.index_by(
+            lambda k, v, _i=li: ((*k, *v)[_i],), (key_dt,),
+            val_fn=lambda k, v: (*k, *v), val_dtypes=tuple(ls.dtypes),
+            name="sql-rglkey")
+        rkeyed = right.index_by(
+            lambda k, v, _i=ri: ((*k, *v)[_i],), (key_dt,),
+            val_fn=lambda k, v: (*k, *v), val_dtypes=tuple(rs.dtypes),
+            name="sql-rgrkey")
+        joined = lkeyed.join_range(
+            rkeyed, lo_c, hi_c,
+            lambda lk, lv, rk, rv: (lk, (*lv, *rv)),
+            (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-rangejoin")
+        scope = _Scope(["__jk__", *ls.names, *rs.names],
+                       [key_dt, *ls.dtypes, *rs.dtypes])
+        return joined, scope
+
+    # -- scalar subqueries ---------------------------------------------------
+    def _bind_subqueries(self, stream, scope, where):
+        """Plan each scalar subquery; cross-join its single row into the
+        main stream on a unit key; rewrite the WHERE to reference it."""
+        subs: List[P.Select] = []
+
+        def rewrite(e):
+            if isinstance(e, P.Subquery):
+                subs.append(e.select)
+                return P.Col(None, f"__sub{len(subs) - 1}__")
+            if isinstance(e, P.BinOp):
+                return P.BinOp(e.op, rewrite(e.left), rewrite(e.right))
+            if isinstance(e, P.NotOp):
+                return P.NotOp(rewrite(e.expr))
+            return e
+
+        where2 = rewrite(where)
+        flat_dts = list(scope.dtypes)
+        names = list(scope.names)
+        for i, sel in enumerate(subs):
+            sub = self._plan(sel)
+            sschema = sub.schema
+            scols = (*sschema[0], *sschema[1])
+            if len(scols) != 1:
+                raise SqlError("scalar subquery must select one column")
+            unit = lambda k, v: (jnp.zeros_like((*k, *v)[0]).astype(jnp.int64),)  # noqa: E731,E501
+            main_ck = stream.index_by(
+                unit, (jnp.int64,), val_fn=lambda k, v: (*k, *v),
+                val_dtypes=tuple(flat_dts), name=f"sql-crossL{i}")
+            sub_ck = sub.index_by(
+                unit, (jnp.int64,), val_fn=lambda k, v: (*k, *v),
+                val_dtypes=scols, name=f"sql-crossR{i}")
+            stream = main_ck.join_index(
+                sub_ck, lambda k, mv, sv: (k, (*mv, *sv)),
+                (jnp.int64,), (*flat_dts, *scols), name=f"sql-cross{i}")
+            names = [f"__cross{i}__", *names, f"__sub{i}__"]
+            flat_dts = [jnp.int64, *flat_dts, scols[0]]
+        return stream, _Scope(names, flat_dts), where2
 
     def _plan_project(self, ast: P.Select, stream: Stream, scope: _Scope
                       ) -> Stream:
         if len(ast.items) == 1 and isinstance(ast.items[0].expr, P.Col) \
                 and ast.items[0].expr.name == "*":
-            return stream
+            # internal plumbing columns (join keys, cross-join units,
+            # subquery scalars — all dunder-named) are not user-visible
+            visible = [i for i, n in enumerate(scope.names)
+                       if not (n.startswith("__") and n.endswith("__"))]
+            if len(visible) == len(scope.names):
+                stream._sql_names = list(scope.names)
+                return stream
+            out = stream.map_rows(
+                lambda k, v, _i=tuple(visible): (
+                    tuple((*k, *v)[i] for i in _i), ()),
+                tuple(scope.dtypes[i] for i in visible), (),
+                name="sql-star")
+            out._sql_names = [scope.names[i] for i in visible]
+            return out
         fns, dts = [], []
         for item in ast.items:
             fn, dt = _compile_expr(item.expr, scope)
@@ -214,7 +387,9 @@ class SqlContext:
                          for f in fns)
             return outs, ()
 
-        return stream.map_rows(project, tuple(dts), (), name="sql-project")
+        out = stream.map_rows(project, tuple(dts), (), name="sql-project")
+        out._sql_names = _item_names(ast.items)
+        return out
 
     def _plan_aggregate(self, ast: P.Select, stream: Stream, scope: _Scope
                         ) -> Stream:
@@ -231,6 +406,13 @@ class SqlContext:
                         f"{item.expr} must appear in GROUP BY or an aggregate")
             else:
                 raise SqlError("non-aggregate select items must be columns")
+        # aggregates referenced only by HAVING are computed but not projected
+        having_aggs = _collect_aggs(ast.having) if ast.having else []
+        selected = [a for _, a in aggs]
+        for ha in having_aggs:
+            if ha not in selected:
+                aggs.append((None, ha))
+                selected.append(ha)
 
         def keyed_stream(agg: P.Agg) -> Stream:
             if agg.arg is None:
@@ -262,8 +444,36 @@ class SqlContext:
                 tuple(key_dts),
                 (*combined.schema[1], *extra.schema[1]), name="sql-aggjoin")
 
+        if ast.having is not None:
+            # evaluate the HAVING predicate over (group keys, agg columns):
+            # rewrite Agg nodes to their slot in combined's value columns
+            # and group columns to their key slot
+            hscope = _Scope(
+                [f"__g{i}__" for i in range(len(group_idx))] +
+                [f"__a{j}__" for j in range(len(aggs))],
+                [*key_dts, *([jnp.int64] * len(aggs))])
+
+            def hrewrite(e):
+                if isinstance(e, P.Agg):
+                    return P.Col(None, f"__a{selected.index(e)}__")
+                if isinstance(e, P.Col):
+                    gi = group_idx.index(scope.index_of(e))
+                    return P.Col(None, f"__g{gi}__")
+                if isinstance(e, P.BinOp):
+                    return P.BinOp(e.op, hrewrite(e.left), hrewrite(e.right))
+                if isinstance(e, P.NotOp):
+                    return P.NotOp(hrewrite(e.expr))
+                return e
+
+            pred, dt = _compile_expr(hrewrite(ast.having), hscope)
+            if dt != np.bool_:
+                raise SqlError("HAVING must be boolean")
+            combined = combined.filter_rows(
+                lambda k, v, _p=pred: _p((*k, *v)), name="sql-having")
+
         # order output columns as selected: group cols come from the key
-        agg_positions = {pos: i for i, (pos, _) in enumerate(aggs)}
+        agg_positions = {pos: i for i, (pos, _) in enumerate(aggs)
+                         if pos is not None}
 
         def finalize(k, v):
             outs = []
@@ -281,5 +491,38 @@ class SqlContext:
                 out_dts.append(jnp.int64)
             else:
                 out_dts.append(scope.dtypes[scope.index_of(item.expr)])
-        return combined.map_rows(finalize, tuple(out_dts), (),
-                                 name="sql-finalize")
+        out = combined.map_rows(finalize, tuple(out_dts), (),
+                                name="sql-finalize")
+        out._sql_names = _item_names(ast.items)
+        return out
+
+    def _plan_topk(self, ast: P.Select, stream: Stream) -> Stream:
+        """ORDER BY ... LIMIT n -> global top-K: re-key to a unit key with
+        the order columns leading the value tuple, take K, restore layout."""
+        names = getattr(stream, "_sql_names", None)
+        schema = stream.schema
+        flat_dts = [*schema[0], *schema[1]]
+        if names is None:
+            names = [f"col{i}" for i in range(len(flat_dts))]
+        aux = _Scope(names, flat_dts)
+        order_idx = [aux.index_of(o.col) for o in ast.order_by]
+        descs = {o.desc for o in ast.order_by}
+        if len(descs) > 1:
+            raise SqlError("mixed ASC/DESC ORDER BY is not supported yet")
+        desc = descs.pop() if descs else False
+        rest = [i for i in range(len(flat_dts)) if i not in order_idx]
+        perm = [*order_idx, *rest]
+        inv = [perm.index(i) for i in range(len(flat_dts))]
+
+        keyed = stream.index_by(
+            lambda k, v: (jnp.zeros_like((*k, *v)[0]).astype(jnp.int64),),
+            (jnp.int64,),
+            val_fn=lambda k, v, _p=tuple(perm): tuple((*k, *v)[i]
+                                                      for i in _p),
+            val_dtypes=tuple(flat_dts[i] for i in perm), name="sql-orderkey")
+        top = keyed.topk(ast.limit, largest=desc, name="sql-limit")
+        out = top.map_rows(
+            lambda k, v, _i=tuple(inv): (tuple(v[i] for i in _i), ()),
+            tuple(flat_dts), (), name="sql-unorder")
+        out._sql_names = names
+        return out

@@ -1,0 +1,398 @@
+"""Hierarchical time-aggregate index — the radix tree.
+
+Reference: ``crates/dbsp/src/operator/time_series/radix_tree/mod.rs:1-55``
+(+ ``updater.rs``, ``tree_aggregate.rs``, ``partitioned_tree_aggregate.rs``):
+the reference maintains, per partition key, aggregates over aligned time
+buckets at geometric granularities, so that ANY time range decomposes into
+O(log(range)) precomputed buckets and stays cheap to maintain under
+out-of-order inserts and retractions.
+
+TPU-native shape: tree level ``L`` (1-based) is a host-side
+:class:`~dbsp_tpu.trace.Spine` keyed ``(partition, prefix)`` whose value
+column is the aggregate over the aligned bucket
+``[prefix * R^L, (prefix+1) * R^L)``, ``R = 1 << radix_bits``. Level 0 is
+the raw ``(partition, time)`` input trace itself — never duplicated. The
+level count is fixed at construction from ``max_time_range`` (the largest
+range queries will ask for), so update and query loops are static — no
+data-dependent host control flow.
+
+Maintenance is bottom-up and delta-proportional (updater.rs semantics):
+the tick's delta dirties level-1 prefixes; each dirty bucket recomputes by
+a range-gather + segment-reduce from the level below and diffs against the
+stored spine (retract old row / insert new); dirty prefixes shift right by
+``radix_bits`` to seed the next level. Late/out-of-order inserts and
+retractions need no special casing — whatever buckets the delta touches
+are recomputed from the ground truth below. Per tick the work is
+O(levels * |touched prefixes| * R), independent of total history.
+
+Queries (tree_aggregate semantics): ``query(qp, qlo, qhi, ...)`` returns,
+per query row, the aggregate over partition ``qp``'s rows with time in
+``[qlo, qhi]``. Working in level-L position space (one position = R^L time
+ticks): positions whose parent bucket lies fully inside the range are
+covered by the next level; this level gathers only the left/right fringe
+positions (< R each side). Gathered rows per query are therefore
+O(R * levels) instead of O(range) — the whole point of the index.
+
+Aggregator contract: ``leaf_agg`` turns raw rows into a bucket value;
+``combine_agg`` combines bucket VALUES (weight-1 rows) into coarser buckets
+and query answers, and must satisfy
+``combine(leaf(A), leaf(B)) == leaf(A ∪ B)``. Max/Min/Sum combine with
+themselves; Count combines with Sum (bucket counts add — re-counting bucket
+rows would be wrong). Average is not a semigroup (avg of avgs) — linear
+aggregates should index (sum, count) as two trees or a Fold.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbsp_tpu.operators.aggregate import Aggregator, _reduce_groups
+from dbsp_tpu.trace.spine import Spine
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, bucket_cap
+
+
+# ---------------------------------------------------------------------------
+# Range gather over (partition, position)-keyed spines
+# ---------------------------------------------------------------------------
+
+
+def _range_gather_impl(qp, qlo, qhi, qlive, level: Batch, out_cap: int):
+    """Rows of a (p, pos)-keyed level with p == qp[i] and pos in [qlo, qhi];
+    returns (qrow, pos col, value col, weights, total), sorted by
+    (qrow, pos). Dead slots carry qrow == len(qp) (the trash segment).
+    Empty ranges (qhi < qlo) gather nothing."""
+    q_cap = qp.shape[0]
+    pk, tk = level.keys[0], level.keys[1]
+    qlo = qlo.astype(tk.dtype)
+    qhi = qhi.astype(tk.dtype)
+    lo = kernels.lex_probe((pk, tk), (qp, qlo), side="left")
+    hi = kernels.lex_probe((pk, tk), (qp, qhi), side="right")
+    ok = qlive & (qhi >= qlo)
+    lo = jnp.where(ok, lo, 0)
+    hi = jnp.where(ok, hi, lo)
+    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap)
+    w = jnp.where(valid, level.weights[src], 0)
+    t = jnp.where(valid, tk[src], kernels.sentinel_for(tk.dtype))
+    v = jnp.where(valid, level.vals[0][src],
+                  kernels.sentinel_for(level.vals[0].dtype))
+    qrow = jnp.where(valid, row, jnp.int32(q_cap))
+    return qrow, t, v, w, total
+
+
+_range_gather = jax.jit(_range_gather_impl, static_argnames=("out_cap",))
+
+
+class RangeGather:
+    """Grow-on-demand driver for vectorized [lo, hi] range gathers over a
+    spine's batches; one batched overflow sync per call. Counts gathered
+    slot capacity (tests assert the O(log) query-cost scaling)."""
+
+    def __init__(self):
+        self.caps: Dict[int, int] = {}
+        self.rows_gathered = 0
+
+    def __call__(self, qp, qlo, qhi, qlive, levels: Sequence[Batch],
+                 q_cap: int):
+        parts, totals, caps = [], [], []
+        for level in levels:
+            cap = self.caps.get(level.cap, max(64, q_cap))
+            out = _range_gather(qp, qlo, qhi, qlive, level, cap)
+            parts.append(out[:4])
+            totals.append(out[4])
+            caps.append(cap)
+        if not parts:
+            return None
+        tvals = jax.device_get(totals)
+        for i, t in enumerate(tvals):
+            t = int(np.max(t))
+            if t > caps[i]:
+                cap = bucket_cap(t)
+                self.caps[levels[i].cap] = cap
+                out = _range_gather(qp, qlo, qhi, qlive, levels[i], cap)
+                parts[i] = out[:4]
+        self.rows_gathered += int(sum(np.max(t) for t in tvals))
+        return [(qrow, (t, v), w) for qrow, t, v, w in parts]
+
+
+# ---------------------------------------------------------------------------
+# The tree
+# ---------------------------------------------------------------------------
+
+
+def _depth_for(max_time_range: int, radix_bits: int) -> int:
+    """Levels so the top bucket is at least the largest query range."""
+    levels = 1
+    while (1 << (radix_bits * levels)) <= max_time_range:
+        levels += 1
+    return levels
+
+
+def combine_for(agg: Aggregator) -> Aggregator:
+    """Default combine semigroup for a built-in leaf aggregator."""
+    from dbsp_tpu.operators.aggregate import Count, Max, Min, Sum
+
+    if isinstance(agg, Count):
+        return Sum(0)
+    if isinstance(agg, (Max, Min, Sum)):
+        return type(agg)(0)
+    raise TypeError(
+        f"no default combine semigroup for {agg.name}; pass combine_agg=")
+
+
+class RadixTimeIndex:
+    """Per-partition hierarchical time aggregates (see module doc)."""
+
+    def __init__(self, leaf_agg: Aggregator, part_dtype, time_dtype,
+                 max_time_range: int, radix_bits: int = 4,
+                 combine_agg: Optional[Aggregator] = None):
+        assert len(leaf_agg.out_dtypes) == 1, (
+            "RadixTimeIndex needs a single-column aggregator")
+        self.agg = leaf_agg
+        self.combine = combine_agg if combine_agg is not None \
+            else combine_for(leaf_agg)
+        self.radix_bits = radix_bits
+        self.nlevels = _depth_for(max_time_range, radix_bits)
+        self.part_dtype = jnp.dtype(part_dtype)
+        self.time_dtype = jnp.dtype(time_dtype)
+        # level L (1-based): (p, prefix) -> bucket aggregate
+        self.levels: List[Spine] = [
+            Spine((self.part_dtype, self.time_dtype),
+                  tuple(leaf_agg.out_dtypes))
+            for _ in range(self.nlevels)]
+        self._child_gather = [RangeGather() for _ in range(self.nlevels)]
+        self._old_gather = [RangeGather() for _ in range(self.nlevels)]
+        self._query_gather = [RangeGather() for _ in range(self.nlevels + 1)]
+
+    @property
+    def query_rows_gathered(self) -> int:
+        return sum(g.rows_gathered for g in self._query_gather)
+
+    # -- maintenance --------------------------------------------------------
+    def update(self, delta: Batch, trace_levels: Sequence[Batch]) -> None:
+        """Fold the tick's (p, t)-keyed delta into the tree.
+
+        ``trace_levels``: the POST-tick spine levels of the raw input trace
+        (level 0 — the recompute source of truth for level 1).
+        """
+        if int(delta.live_count()) == 0:
+            return
+        bits = self.radix_bits
+        dp = delta.keys[0]
+        dt = delta.keys[1]
+        live = delta.weights != 0
+        p, pref = _unique_prefixes(dp, (dt >> bits).astype(dt.dtype), live)
+        p, pref = _trim(p, pref)
+        for L in range(1, self.nlevels + 1):
+            child = trace_levels if L == 1 else self.levels[L - 2].batches
+            self._update_level(L, p, pref, child)
+            if L < self.nlevels:
+                p, pref = _unique_prefixes(
+                    p, (pref >> bits).astype(pref.dtype),
+                    p != kernels.sentinel_for(p.dtype))
+                p, pref = _trim(p, pref)
+
+    def _update_level(self, L: int, p, pref, child_levels) -> None:
+        """Recompute the (p, pref) buckets of level L from the level below.
+
+        In the child's position space one bucket spans R positions
+        (for L == 1 the children are raw rows, whose positions are times).
+        """
+        bits = self.radix_bits
+        spine = self.levels[L - 1]
+        q_cap = p.shape[0]
+        qlive = p != kernels.sentinel_for(p.dtype)
+        clo = pref << bits
+        chi = ((pref + 1) << bits) - 1
+        gathered = self._child_gather[L - 1](p, clo, chi, qlive,
+                                             child_levels, q_cap)
+        if gathered is None:
+            new_vals = (jnp.zeros((q_cap,), self.agg.out_dtypes[0]),)
+            new_present = jnp.zeros((q_cap,), jnp.bool_)
+        else:
+            # reduce on the value column; the position column rides along
+            # in the parts only to keep rows distinct while netting.
+            # Level 1 aggregates raw rows (leaf), higher levels combine
+            # bucket values.
+            red = self.agg if L == 1 else self.combine
+            parts = tuple((qrow, (t, v), w) for qrow, (t, v), w in gathered)
+            new_vals, new_present = _reduce_groups(parts, _OnCol1(red),
+                                                   q_cap)
+        old = self._old_gather[L - 1](p, pref, pref, qlive, spine.batches,
+                                      q_cap)
+        if old is None:
+            old_vals = (kernels.sentinel_fill((q_cap,),
+                                              self.agg.out_dtypes[0]),)
+            old_present = jnp.zeros((q_cap,), jnp.bool_)
+        else:
+            parts = tuple((qrow, (t, v), w) for qrow, (t, v), w in old)
+            old_vals, old_present = _reduce_groups(parts, _KeepCol1(), q_cap)
+        diff = _bucket_diff(p, pref, qlive, new_vals[0], new_present,
+                            old_vals[0], old_present)
+        spine.insert(diff.shrink_to_fit())
+
+    # -- queries -------------------------------------------------------------
+    def query(self, qp, qlo, qhi, qlive, trace_levels: Sequence[Batch],
+              q_cap: int):
+        """Aggregate over raw-time range [qlo, qhi] per query row.
+
+        Returns (vals tuple, present mask) aligned with the queries;
+        ``present`` means at least one raw row lies in the range.
+        """
+        bits = self.radix_bits
+        B = 1 << bits
+        raw_parts: list = []     # level-0 rows -> leaf aggregation
+        bucket_parts: list = []  # level>=1 bucket values -> combine
+
+        def add(sink, gathered):
+            if gathered:
+                sink.extend((qrow, (t, v), w) for qrow, (t, v), w in gathered)
+
+        lo = jnp.asarray(qlo, jnp.int64)
+        hi = jnp.asarray(qhi, jnp.int64)
+        active = qlive & (lo <= hi)
+        for L in range(0, self.nlevels + 1):
+            levels = trace_levels if L == 0 else self.levels[L - 1].batches
+            sink = raw_parts if L == 0 else bucket_parts
+            last = L == self.nlevels
+            nlo = (lo + B - 1) // B   # first next-level position fully inside
+            nhi = (hi + 1) // B       # exclusive end of covered positions
+            covered = (nlo < nhi) & (not last)
+            left_hi = jnp.where(covered, nlo * B - 1, hi)
+            right_lo = jnp.where(covered, nhi * B, hi + 1)
+            add(sink, self._query_gather[L](qp, lo, left_hi, active, levels,
+                                            q_cap))
+            add(sink, self._query_gather[L](qp, right_lo, hi,
+                                            active & covered, levels, q_cap))
+            lo, hi, active = nlo, nhi - 1, active & covered
+
+        def reduce(parts, agg):
+            if not parts:
+                return (jnp.zeros((q_cap,), self.agg.out_dtypes[0]),
+                        jnp.zeros((q_cap,), jnp.bool_))
+            vals, present = _reduce_groups(tuple(parts), _OnCol1(agg), q_cap)
+            return vals[0], present
+
+        raw_val, raw_present = reduce(raw_parts, self.agg)
+        buck_val, buck_present = reduce(bucket_parts, self.combine)
+        val, present = _combine_partials(
+            raw_val, raw_present, buck_val, buck_present, self.combine,
+            q_cap)
+        return (val,), present
+
+    # -- views ---------------------------------------------------------------
+    def to_dicts(self):
+        return [lvl.to_dict() for lvl in self.levels]
+
+    def state_dict(self):
+        return {"levels": self.levels}
+
+    def load_state_dict(self, state):
+        self.levels = state["levels"]
+
+
+# ---------------------------------------------------------------------------
+# Helper aggregators over (position, value) part columns
+# ---------------------------------------------------------------------------
+
+
+class _OnCol1(Aggregator):
+    """Apply the user aggregator to value column 1 of (pos, value) parts."""
+
+    def __init__(self, agg: Aggregator):
+        self.agg = agg
+        self.out_dtypes = agg.out_dtypes
+        self.name = f"oncol1<{agg.name}>"
+
+    def __hash__(self):  # jit static identity
+        return hash(("oncol1", self.agg))
+
+    def __eq__(self, other):
+        return isinstance(other, _OnCol1) and self.agg == other.agg
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        return self.agg.reduce(val_cols[1:], weights, seg, num_segments)
+
+
+class _KeepCol1(Aggregator):
+    """Recover the unique stored row's value per bucket (col 1 of parts)."""
+
+    out_dtypes = (jnp.int64,)
+    name = "keep1"
+
+    def __hash__(self):
+        return hash("keep1")
+
+    def __eq__(self, other):
+        return isinstance(other, _KeepCol1)
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        v = val_cols[1]
+        lo = (jnp.iinfo(v.dtype).min
+              if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf)
+        return (jax.ops.segment_max(jnp.where(weights > 0, v, lo), seg,
+                                    num_segments=num_segments),)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("combine", "q_cap"))
+def _combine_partials(raw_val, raw_present, buck_val, buck_present,
+                      combine: Aggregator, q_cap: int):
+    """Fold the raw-fringe partial and the bucket partial per query row with
+    the combine semigroup (absent partials are masked by weight 0)."""
+    seg = jnp.concatenate([jnp.arange(q_cap, dtype=jnp.int32)] * 2)
+    vals = jnp.concatenate([raw_val, buck_val])
+    w = jnp.concatenate([jnp.where(raw_present, 1, 0),
+                         jnp.where(buck_present, 1, 0)]).astype(jnp.int64)
+    out = combine.reduce((vals,), w, seg, q_cap)
+    return out[0], raw_present | buck_present
+
+
+@jax.jit
+def _unique_prefixes(p, pref, live):
+    """Distinct live (p, prefix) pairs, compacted to the front. Inputs are
+    sorted by (p, t) and prefixing is monotone in t, so (p, pref) stays
+    sorted and distinctness is an adjacent-equality check."""
+    p = jnp.where(live, p, kernels.sentinel_for(p.dtype))
+    pref = jnp.where(live, pref, kernels.sentinel_for(pref.dtype))
+    dup = kernels.rows_equal_prev((p, pref), n=p.shape[0])
+    keep = ~dup & live
+    cols, _ = kernels.compact((p, pref),
+                              jnp.where(keep, 1, 0).astype(jnp.int32), keep)
+    return cols[0], cols[1]
+
+
+def _trim(p, pref):
+    """Re-bucket compacted (p, pref) columns to the live count (one sync) —
+    keeps every per-level kernel sized by touched prefixes."""
+    n = int(jnp.sum(p != kernels.sentinel_for(p.dtype)))
+    cap = bucket_cap(max(n, 1))
+    if cap < p.shape[-1]:
+        p, pref = p[..., :cap], pref[..., :cap]
+    return p, pref
+
+
+@jax.jit
+def _bucket_diff(p, pref, qlive, new_vals, new_present, old_vals,
+                 old_present):
+    """Retract/insert delta batch for the (p, prefix) bucket rows."""
+    changed = (new_present != old_present) | \
+        ~kernels._col_eq(new_vals.astype(old_vals.dtype), old_vals)
+    ins = jnp.where(qlive & new_present & changed, 1, 0)
+    ret = jnp.where(qlive & old_present & changed, -1, 0)
+    keys = (jnp.concatenate([p, p]), jnp.concatenate([pref, pref]))
+    vals = (jnp.concatenate([new_vals.astype(old_vals.dtype), old_vals]),)
+    w = jnp.concatenate([ins, ret]).astype(jnp.int64)
+    cols, w = kernels.consolidate_cols((*keys, *vals), w)
+    return Batch(cols[:2], cols[2:], w)

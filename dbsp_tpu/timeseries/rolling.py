@@ -111,9 +111,19 @@ def _rolling_reduce(wrow, wt, wvals, ww, at, agg: Aggregator, a_cap: int):
 
 class RollingAggregateOp(UnaryOperator):
     """Input: keys (partition, time), vals (value cols). Output: keys
-    (partition, time), vals (agg outputs)."""
+    (partition, time), vals (agg outputs).
 
-    def __init__(self, agg: Aggregator, range_ms: int, schema, name=None):
+    When the aggregator has a combine semigroup (Max/Min/Sum/Count), dirty
+    windows are answered by a :class:`RadixTimeIndex` in O(log range)
+    gathered rows each; otherwise (Average, custom Folds) each window is
+    recomputed from the trace in O(window rows) — the round-1 fallback.
+    """
+
+    def __init__(self, agg: Aggregator, range_ms: int, schema, name=None,
+                 use_tree: bool = True):
+        from dbsp_tpu.timeseries.radix_tree import (RadixTimeIndex,
+                                                    combine_for)
+
         self.agg = agg
         self.range_ms = range_ms
         self.in_schema = schema
@@ -123,10 +133,26 @@ class RollingAggregateOp(UnaryOperator):
         self._affected = RangeGather()
         self._windows = RangeGather()
         self._old = GroupGather()
+        self.tree = None
+        if use_tree and len(agg.out_dtypes) == 1 \
+                and getattr(agg, "col", 0) == 0:
+            try:
+                combine_for(agg)
+            except TypeError:
+                pass
+            else:
+                self.tree = RadixTimeIndex(agg, schema[0][0], schema[0][1],
+                                           max_time_range=range_ms)
 
     def clock_start(self, scope: int) -> None:
         if scope > 0:
             self.out_spine = Spine(*self.out_schema)
+            if self.tree is not None:
+                from dbsp_tpu.timeseries.radix_tree import RadixTimeIndex
+
+                self.tree = RadixTimeIndex(
+                    self.agg, self.in_schema[0][0], self.in_schema[0][1],
+                    max_time_range=self.range_ms)
 
     def eval(self, view: TraceView) -> Batch:
         delta = view.delta
@@ -161,20 +187,30 @@ class RollingAggregateOp(UnaryOperator):
         alive = cw != 0
         a_cap = ap.shape[0]
 
-        # 2. recompute each dirty window [t'-range, t'] from the post trace.
-        # An output row (p, t') exists only while an input row at exactly
-        # (p, t') is live — a non-empty window alone is not enough (the
-        # retraction of (p, t') must retract its output even though
-        # neighbours still populate the window).
-        win = self._windows(ap, at - self.range_ms, at, alive,
-                            view.spine.batches, a_cap)
-        if win is None:
-            new_vals = tuple(jnp.zeros((a_cap,), d)
-                             for d in self.agg.out_dtypes)
-            new_present = jnp.zeros((a_cap,), jnp.bool_)
+        # 2. recompute each dirty window [t'-range, t'] — via the radix tree
+        # (O(log range) gathered rows per window) when available, else a
+        # full-window gather. An output row (p, t') exists only while an
+        # input row at exactly (p, t') is live — a non-empty window alone is
+        # not enough (the retraction of (p, t') must retract its output even
+        # though neighbours still populate the window).
+        if self.tree is not None:
+            self.tree.update(delta, view.spine.batches)
+            new_vals, _range_present = self.tree.query(
+                ap, at - self.range_ms, at, alive, view.spine.batches, a_cap)
+            # presence requires a live row at exactly (p, t')
+            own = self.tree.query(ap, at, at, alive, view.spine.batches,
+                                  a_cap)
+            new_present = own[1]
         else:
-            new_vals, new_present = _rolling_reduce(
-                win[0], win[1], win[2], win[3], at, self.agg, a_cap)
+            win = self._windows(ap, at - self.range_ms, at, alive,
+                                view.spine.batches, a_cap)
+            if win is None:
+                new_vals = tuple(jnp.zeros((a_cap,), d)
+                                 for d in self.agg.out_dtypes)
+                new_present = jnp.zeros((a_cap,), jnp.bool_)
+            else:
+                new_vals, new_present = _rolling_reduce(
+                    win[0], win[1], win[2], win[3], at, self.agg, a_cap)
 
         # 3. diff vs previous outputs for the dirty keys
         old = self._old((ap, at), alive, self.out_spine.batches, a_cap)
@@ -193,22 +229,37 @@ class RollingAggregateOp(UnaryOperator):
         return out
 
     def state_dict(self):
-        return {"out_spine": self.out_spine}
+        state = {"out_spine": self.out_spine}
+        if self.tree is not None:
+            state["tree_levels"] = self.tree.levels
+        return state
 
     def load_state_dict(self, state):
         self.out_spine = state["out_spine"]
+        if self.tree is not None and "tree_levels" in state:
+            self.tree.levels = state["tree_levels"]
+
+    def metadata(self):
+        meta = {"out_levels": len(self.out_spine.batches)}
+        if self.tree is not None:
+            meta["tree_levels"] = [len(s.batches) for s in self.tree.levels]
+            meta["tree_query_rows"] = self.tree.query_rows_gathered
+        return meta
 
 
 @stream_method
 def partitioned_rolling_aggregate(self: Stream, agg: Aggregator,
-                                  range_ms: int, name=None) -> Stream:
+                                  range_ms: int, name=None,
+                                  use_tree: bool = True) -> Stream:
     """Per-partition rolling aggregate over [t - range_ms, t] (see module
-    doc). The stream must be keyed (partition, time)."""
+    doc). The stream must be keyed (partition, time). ``use_tree=False``
+    forces the O(window) recompute path (the differential-testing oracle
+    for the radix-tree path)."""
     schema = getattr(self, "schema", None)
     assert schema is not None and len(schema[0]) == 2, (
         "partitioned_rolling_aggregate needs keys (partition, time)")
     t = self.trace(shard=False)  # not yet shard-lifted
     out = self.circuit.add_unary_operator(
-        RollingAggregateOp(agg, range_ms, schema, name), t)
+        RollingAggregateOp(agg, range_ms, schema, name, use_tree=use_tree), t)
     out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
     return out

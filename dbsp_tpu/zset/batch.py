@@ -143,6 +143,21 @@ class Batch:
             for j in range(nv):
                 vcols[j][i] = row[nk + j]
             ws[i] = w
+        # DOMAIN CONTRACT: the max representable value of each column dtype
+        # is the engine's dead-row sentinel; a live row carrying it would be
+        # conflated with padding in probes/window slices. Reject at the host
+        # boundary (zero-cost here; device-batch pushers uphold it by
+        # contract — see push_batch).
+        for col, d in ((c, d) for cols, dts in
+                       ((kcols, key_dtypes), (vcols, val_dtypes))
+                       for c, d in zip(cols, dts)):
+            dt = jnp.dtype(d)
+            if np.issubdtype(dt, np.integer) and n and \
+                    col.max(initial=np.iinfo(dt).min) == np.iinfo(dt).max:
+                raise ValueError(
+                    f"value {np.iinfo(dt).max} ({dt}) is reserved as the "
+                    "dead-row sentinel; remap the input domain (e.g. use a "
+                    "wider dtype)")
         return Batch.from_columns(kcols, vcols, ws, cap=cap)
 
     # -- canonicalization ---------------------------------------------------

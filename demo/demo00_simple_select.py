@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""SimpleSelect (reference: demo/project_demo00-SimpleSelect): filter and
+project a table with an incrementally maintained view."""
+
+from _common import run_demo
+
+run_demo(
+    "simple-select",
+    tables={"people": ["id", "age", "city"]},
+    sql={"adults": "SELECT id, city FROM people WHERE age >= 18"},
+    feeds=[("people", [[1, 17, 3], [2, 22, 3], [3, 41, 7], [4, 12, 7]])],
+    reads=["adults"],
+)

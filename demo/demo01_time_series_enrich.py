@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""TimeSeriesEnrich (reference: demo/project_demo01-TimeSeriesEnrich):
+enrich a stream of readings with static sensor metadata via an
+incremental join."""
+
+from _common import run_demo
+
+run_demo(
+    "ts-enrich",
+    tables={
+        "readings": ["sensor", "ts", "value"],
+        "sensors": ["sensor", "site"],
+    },
+    sql={"enriched": "SELECT readings.ts, readings.value, sensors.site "
+                     "FROM readings JOIN sensors "
+                     "ON readings.sensor = sensors.sensor"},
+    feeds=[
+        ("sensors", [[1, 100], [2, 200]]),
+        ("readings", [[1, 1000, 21], [1, 1060, 22], [2, 1000, 17]]),
+    ],
+    reads=["enriched"],
+)

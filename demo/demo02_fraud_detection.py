@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""FraudDetection (reference: demo/project_demo02-FraudDetection): flag
+accounts whose transaction volume is anomalous — aggregation + HAVING and a
+scalar-subquery threshold, incrementally maintained."""
+
+from _common import run_demo
+
+run_demo(
+    "fraud",
+    tables={"txns": ["account", "amount", "merchant"]},
+    sql={
+        "volume": "SELECT account, count(*) AS n, sum(amount) AS total "
+                  "FROM txns GROUP BY account HAVING sum(amount) > 900",
+        "whales": "SELECT account, amount FROM txns WHERE amount > "
+                  "(SELECT avg(amount) FROM txns) * 2",
+    },
+    feeds=[("txns", [[1, 500, 9], [1, 450, 9], [2, 40, 3], [2, 30, 3],
+                     [3, 980, 4]])],
+    reads=["volume", "whales"],
+)

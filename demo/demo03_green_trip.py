@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""GreenTrip (reference: demo/project_demo03-GreenTrip): taxi-trip style
+analytics — per-zone stats with ORDER BY / LIMIT leaderboards."""
+
+from _common import run_demo
+
+run_demo(
+    "green-trip",
+    tables={"trips": ["zone", "distance", "fare"]},
+    sql={
+        "zone_stats": "SELECT zone, count(*) AS trips, avg(fare) AS avg_fare "
+                      "FROM trips GROUP BY zone",
+        "top_zones": "SELECT zone, sum(fare) AS revenue FROM trips "
+                     "GROUP BY zone ORDER BY revenue DESC LIMIT 3",
+    },
+    feeds=[("trips", [[1, 5, 120], [1, 3, 90], [2, 11, 310], [3, 2, 55],
+                      [4, 7, 160], [4, 6, 150], [2, 9, 275]])],
+    reads=["zone_stats", "top_zones"],
+)

@@ -1,0 +1,66 @@
+"""Round-2 fidelity fixes: sentinel-domain guard and the q21/q22 string
+dictionary (device arithmetic == real string operations)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dbsp_tpu.circuit import RootCircuit
+from dbsp_tpu.nexmark import strings
+from dbsp_tpu.operators import add_input_zset
+from dbsp_tpu.zset.batch import Batch
+
+
+def test_sentinel_keys_rejected_at_input_boundary():
+    with pytest.raises(ValueError, match="sentinel"):
+        Batch.from_tuples([((np.iinfo(np.int64).max,), 1)], (jnp.int64,))
+    with pytest.raises(ValueError, match="sentinel"):
+        Batch.from_tuples([((1, np.iinfo(np.int32).max), 1)],
+                          (jnp.int64,), (jnp.int32,))
+    # ordinary large values stay legal
+    b = Batch.from_tuples([((np.iinfo(np.int64).max - 1,), 1)], (jnp.int64,))
+    assert b.to_dict() == {(np.iinfo(np.int64).max - 1,): 1}
+
+
+def test_q21_channel_ids_match_string_case():
+    """The circuit's arithmetic CASE must equal the reference's CASE over
+    the DECODED channel strings (named channels + url extraction)."""
+    from dbsp_tpu.nexmark import build_inputs, queries
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q21(*streams).output()
+
+    circuit, ((hp, ha, hb), out) = RootCircuit.build(build)
+    rows = [((a, 5, 100 + a, ch, 1000 + a), 1)
+            for a, ch in enumerate([0, 1, 2, 3, 7, 12, 400])]
+    for r, w in rows:
+        hb.push(r, w)
+    circuit.step()
+    got = out.to_dict()
+    for (auction, bidder, price, ch, chan_id), w in got.items():
+        # evaluate the REAL string CASE via the dictionary
+        name = strings.decode_channel(ch)
+        if name in strings.NAMED_CHANNELS:
+            want = strings.NAMED_CHANNELS.index(name)
+        else:
+            want = int(strings.channel_url(ch).split("channel_id=")[1])
+        assert chan_id == want == strings.channel_id_of(ch)
+
+
+def test_q22_url_splits_match_string_split():
+    from dbsp_tpu.nexmark import build_inputs, queries
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q22(*streams).output()
+
+    circuit, ((hp, ha, hb), out) = RootCircuit.build(build)
+    for a, ch in enumerate([0, 3, 9, 55, 800]):
+        hb.push((a, 5, 100, ch, 1000), 1)
+    circuit.step()
+    got = {r[0]: r[3:] for r in out.to_dict()}
+    for a, ch in enumerate([0, 3, 9, 55, 800]):
+        s1, s2, s3 = strings.url_dirs_of(ch)
+        want = (int(s1[1:]), int(s2[1:]), int(s3[1:]))  # 'd<k>' -> k
+        assert got[a] == want

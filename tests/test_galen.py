@@ -1,0 +1,73 @@
+"""Galen program (mutual recursion, 6 rules) vs a Python semi-naive oracle,
+including an incremental second epoch. Reference: benches/galen.rs."""
+
+import random
+import sys
+import os
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benches"))
+
+from dbsp_tpu.circuit import Runtime  # noqa: E402
+
+
+def galen_oracle(p, q, r, c, u, s):
+    p, q = set(p), set(q)
+    while True:
+        np_ = set()
+        nq = set()
+        np_ |= {(x, z) for (x, y) in p for (y2, z) in p if y == y2}
+        np_ |= {(x, z) for (y, w) in p for (w2, r2, z) in u if w == w2
+                for (x, r3, y2) in q if r3 == r2 and y2 == y}
+        np_ |= {(x, z) for (y, w, z) in c for (x, w2) in p if w2 == w
+                if (x, y) in p}
+        nq |= {(x, r2, z) for (x, y) in p for (y2, r2, z) in q if y2 == y}
+        nq |= {(x, q2, z) for (x, r2, z) in q for (r3, q2) in s if r3 == r2}
+        nq |= {(x, e, o) for (x, y, z) in q for (y2, u2, e) in r if y2 == y
+               for (z2, u3, o) in q if z2 == z and u3 == u2}
+        if np_ <= p and nq <= q:
+            return p, q
+        p |= np_
+        q |= nq
+
+
+def _mini_data(rng, n=12):
+    dom = range(6)
+    p = {(rng.randrange(6), rng.randrange(6)) for _ in range(n)}
+    q = {(rng.randrange(6), rng.randrange(3), rng.randrange(6))
+         for _ in range(n)}
+    r = {(rng.randrange(3), rng.randrange(3), rng.randrange(3))
+         for _ in range(4)}
+    c = {(rng.randrange(6), rng.randrange(6), rng.randrange(6))
+         for _ in range(4)}
+    u = {(rng.randrange(6), rng.randrange(3), rng.randrange(6))
+         for _ in range(4)}
+    s = {(rng.randrange(3), rng.randrange(3)) for _ in range(3)}
+    return p, q, r, c, u, s
+
+
+def test_galen_mini_oracle_and_incremental():
+    from galen import build_circuit
+
+    rng = random.Random(21)
+    p, q, r, c, u, s = _mini_data(rng)
+
+    handle, (handles, outs) = Runtime.init_circuit(1, build_circuit)
+    hp, hq, hr, hc, hu, hs = handles
+    for h, rows in ((hp, p), (hq, q), (hr, r), (hc, c), (hu, u), (hs, s)):
+        h.extend([(row, 1) for row in rows])
+    handle.step()
+    want_p, want_q = galen_oracle(p, q, r, c, u, s)
+    assert outs[0].to_dict() == {t: 1 for t in want_p}
+    assert outs[1].to_dict() == {t: 1 for t in want_q}
+
+    # epoch 2: add one p edge and remove one original q fact
+    new_p = (0, 5)
+    dead_q = next(iter(q))
+    hp.push(new_p, 1)
+    hq.push(dead_q, -1)
+    handle.step()
+    want_p2, want_q2 = galen_oracle(p | {new_p}, q - {dead_q}, r, c, u, s)
+    assert outs[0].to_dict() == {t: 1 for t in want_p2}
+    assert outs[1].to_dict() == {t: 1 for t in want_q2}

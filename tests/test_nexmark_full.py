@@ -168,13 +168,27 @@ def test_q15_q16(gen):
     assert got15 == want15 and want15
 
     got16 = run_accumulated(queries.q16, gen, 4000, 4)
-    tot, uniq = {}, {}
+
+    def rank(price):
+        return 1 if price < queries.Q16_RANK1 else \
+            (2 if price < queries.Q16_RANK2 else 3)
+
+    groups = {}
     for i in range(len(b["bidder"])):
         k = (int(b["channel"][i]), int(b["date_time"][i]) // DAY)
-        tot[k] = tot.get(k, 0) + 1
-        uniq.setdefault(k, set()).add(int(b["bidder"][i]))
-    want16 = {(ch, d, tot[(ch, d)], len(u)): 1
-              for (ch, d), u in uniq.items()}
+        g = groups.setdefault(
+            k, {"bids": [0, 0, 0, 0], "bidders": [set() for _ in range(4)],
+                "auctions": [set() for _ in range(4)]})
+        r = rank(int(b["price"][i]))
+        for slot in (0, r):
+            g["bids"][slot] += 1
+            g["bidders"][slot].add(int(b["bidder"][i]))
+            g["auctions"][slot].add(int(b["auction"][i]))
+    want16 = {}
+    for (ch, d), g in groups.items():
+        row = (ch, d, *g["bids"], *(len(s) for s in g["bidders"]),
+               *(len(s) for s in g["auctions"]))
+        want16[row] = 1
     assert got16 == want16 and want16
 
 

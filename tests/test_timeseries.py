@@ -133,13 +133,16 @@ def run_accumulated(build_query, gen, n_events, steps):
 def test_q5(gen):
     got = run_accumulated(queries.q5, gen, 4000, 4)
     b = gen.generate(0, 4000)["bids"]
+    wm = int(b["date_time"].max())
+    cutoff = wm - queries.Q5_RETAIN_MS  # retired windows are retracted (GC)
     counts = {}
     for i in range(len(b["auction"])):
         ts, a = int(b["date_time"][i]), int(b["auction"][i])
         base = (ts // queries.Q5_HOP_MS) * queries.Q5_HOP_MS
         for k in range(queries.Q5_WINDOW_MS // queries.Q5_HOP_MS):
             w = base - k * queries.Q5_HOP_MS
-            counts[(w, a)] = counts.get((w, a), 0) + 1
+            if w >= cutoff:
+                counts[(w, a)] = counts.get((w, a), 0) + 1
     maxes = {}
     for (w, a), n in counts.items():
         maxes[w] = max(maxes.get(w, 0), n)
