@@ -25,10 +25,19 @@ vs_baseline is events/sec divided by the reference protocol's 10M events/s
 offered rate (the closest in-tree number; BASELINE.json publishes no absolute
 reference results).
 
+Execution mode: BENCH_MODE=compiled (default) runs the circuit through
+``dbsp_tpu.compiled`` — the whole tick is ONE jitted XLA program including
+device-side event generation, so the hot loop does zero host<->device
+transfers (critical over the tunneled TPU, where one scalar fetch costs
+~90ms) and validates capacity requirements every BENCH_VALIDATE_EVERY ticks
+with snapshot/replay on overflow. BENCH_MODE=host uses the host-driven
+scheduler path (the general-purpose mode).
+
 Env knobs: BENCH_EVENTS (total; default 2_000_000 on TPU, 500_000 on CPU),
 BENCH_BATCH (events/tick, default 100_000), BENCH_QUERY (default q4),
 BENCH_WARM_TICKS (default 4), BENCH_PLATFORM (cpu|tpu|probe, default probe),
-BENCH_PROBE_TIMEOUT_S (default 75).
+BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE (compiled|host),
+BENCH_VALIDATE_EVERY (default 8).
 """
 
 import json
@@ -109,6 +118,98 @@ def _select_platform() -> tuple[str, dict]:
     return platform, info
 
 
+def _knobs(platform: str):
+    """Env-knob parsing shared by both execution modes."""
+    default_events = 2_000_000 if platform != "cpu" else 500_000
+    return (int(os.environ.get("BENCH_EVENTS", default_events)),
+            int(os.environ.get("BENCH_BATCH", 100_000)),
+            os.environ.get("BENCH_QUERY", "q4"),
+            int(os.environ.get("BENCH_WARM_TICKS", 4)))
+
+
+def run_compiled(platform: str, detail: dict) -> float:
+    """Compiled-mode measurement: one XLA program per tick, device-side
+    generation, periodic validation (see module doc)."""
+    import time as _time
+
+    import jax
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import compile_circuit
+    from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
+                                  queries)
+
+    total, batch, qname, warm_ticks = _knobs(platform)
+    validate_every = int(os.environ.get("BENCH_VALIDATE_EVERY", 8))
+    query = getattr(queries, qname)
+    # device generation needs whole 50-event epochs; warmup needs >= 1 tick
+    # for capacity discovery + presize
+    batch = max(batch // 50, 1) * 50
+    warm_ticks = max(warm_ticks, 1)
+    ept = batch // 50  # epochs (50-event groups) per tick
+
+    platform = jax.devices()[0].platform
+    detail.update(platform=platform, query=qname, batch_per_tick=batch,
+                  mode="compiled", events=0)
+    cfg = GeneratorConfig(seed=1)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, query(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * ept, ept)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+
+    ticks = total // batch
+    # Warmup: let capacities grow (validating every tick so overflow replays
+    # are single-tick), then pre-size them for the full run length so the
+    # measured phase executes ONE stable compiled program.
+    t0 = _time.perf_counter()
+    ch.run_ticks(0, warm_ticks, validate_every=1)
+    ch.presize((warm_ticks + ticks) / warm_ticks)
+    ch.step(tick=warm_ticks, block=True)  # compile the presized program
+    ch.validate()
+    warm_ticks += 1
+    ticks = max(ticks - 1, 1)
+    ch.block()
+    detail["warmup_s"] = round(_time.perf_counter() - t0, 3)
+
+    ch.step_times_ns.clear()
+    t0 = _time.perf_counter()
+    done = {"ticks": 0}
+
+    def progress(next_tick):
+        done["ticks"] = next_tick - warm_ticks
+        detail.update(events=done["ticks"] * batch,
+                      elapsed_s=round(_time.perf_counter() - t0, 3))
+
+    ch.run_ticks(warm_ticks, ticks, validate_every=validate_every,
+                 on_validated=progress, block_each=True)
+    ch.block()
+    elapsed = _time.perf_counter() - t0
+    measured = ticks * batch
+
+    eps = measured / elapsed
+    lat = sorted(ch.step_times_ns)
+    if lat:
+        detail.update(
+            p50_step_ms=round(lat[len(lat) // 2] / 1e6, 2),
+            p99_step_ms=round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] / 1e6, 2))
+    # len(lat) > ticks means presize under-predicted: some intervals were
+    # replayed after a grow+retrace, whose compile time sits in the latency
+    # tail — reported, not hidden
+    detail.update(elapsed_s=round(elapsed, 3), events=measured,
+                  ticks=ticks, replayed_ticks=len(lat) - ticks)
+    return eps
+
+
 def run(platform: str, detail: dict) -> float:
     """Measure; fills ``detail`` as it goes so a mid-run crash still reports
     platform + progress in the JSON line."""
@@ -118,16 +219,19 @@ def run(platform: str, detail: dict) -> float:
     from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
                                   build_inputs, queries)
 
-    default_events = 2_000_000 if platform != "cpu" else 500_000
-    total = int(os.environ.get("BENCH_EVENTS", default_events))
-    batch = int(os.environ.get("BENCH_BATCH", 100_000))
-    qname = os.environ.get("BENCH_QUERY", "q4")
-    warm_ticks = int(os.environ.get("BENCH_WARM_TICKS", 4))
+    if os.environ.get("BENCH_MODE", "compiled") == "compiled":
+        try:
+            return run_compiled(platform, detail)
+        except NotImplementedError as e:
+            # query uses operators outside the compiled set — host path
+            detail["compiled_fallback"] = str(e)[:160]
+
+    total, batch, qname, warm_ticks = _knobs(platform)
     query = getattr(queries, qname)
 
     platform = jax.devices()[0].platform  # actual backend that came up
     detail.update(platform=platform, query=qname, batch_per_tick=batch,
-                  events=0)
+                  mode="host", events=0)
     gen = NexmarkGenerator(GeneratorConfig(seed=1))
 
     def build(c):

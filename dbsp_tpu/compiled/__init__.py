@@ -1,0 +1,9 @@
+"""Compiled circuit execution: the whole step as ONE jitted XLA program.
+
+See :mod:`dbsp_tpu.compiled.compiler` for the design rationale.
+"""
+
+from dbsp_tpu.compiled.compiler import (CompiledHandle, CompiledOverflow,
+                                        compile_circuit)
+
+__all__ = ["CompiledHandle", "CompiledOverflow", "compile_circuit"]

@@ -1,0 +1,334 @@
+"""Pure-functional operator evals for compiled circuit execution.
+
+Each compiled node (``C*`` class) mirrors one host operator class from
+``dbsp_tpu/operators/`` but expresses its per-tick eval as a PURE function
+``eval(ctx, state, inputs) -> (state', output)`` over static-capacity device
+batches, so the scheduler's whole eval sequence can be traced into one XLA
+program (see compiler.py). The algorithms are the same — the kernels are
+literally shared with the host path (``_join_level_impl``,
+``_reduce_groups_impl``, ...); what changes is the *driver*: grow-on-demand
+host loops and per-eval ``device_get`` checks become static capacities plus
+device-side "required capacity" scalars that the runner validates out of the
+hot loop (reference analog: the dataflow-jit backend compiles circuits whose
+shapes Rust generics would otherwise fix at compile time,
+``crates/dataflow-jit/src/dataflow/mod.rs``).
+
+State capacities live in ``self.caps`` (plain ints). Every eval registers its
+requirements via ``ctx.require(self, cap_key, device_scalar)``; the runner
+compares the running max of those scalars against the configured caps at
+validation points and grows + retraces on overflow.
+
+The trace state here is deliberately simpler than the host path's LSM spine:
+a SINGLE consolidated batch per trace, merged with each tick's delta by one
+rank-based sorted-merge kernel. O(trace) HBM traffic per tick instead of the
+spine's amortized O(log n) levels — the right trade on TPU, where a 2M-row
+merge is a few ms of vector work but every host round-trip to *schedule*
+spine merges costs ~100ms over a tunneled accelerator. (The spine remains
+the right structure for the host-driven path and for states that outgrow
+single-kernel merges.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu.zset import kernels
+from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
+
+# ---------------------------------------------------------------------------
+# Static single-batch trace
+# ---------------------------------------------------------------------------
+
+
+def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
+    """Merge ``delta`` into a fixed-capacity trace batch.
+
+    Returns (new trace at the SAME capacity, required live rows). Live rows
+    pack to the front after a merge, so slicing back to the trace capacity
+    drops only dead tail — unless required > cap, which the runner detects.
+    """
+    merged = trace.merge_with(delta)
+    required = merged.live_count()
+    return merged.with_cap(trace.cap), required
+
+
+@dataclasses.dataclass
+class CView:
+    """Compiled analog of ``operators.trace_op.TraceView``: the trace of a
+    stream before (z^-1) and after this tick's append."""
+
+    delta: Batch
+    pre: Batch
+    post: Batch
+
+
+class CNode:
+    """Base: a compiled counterpart of one circuit node.
+
+    ``caps`` holds named static capacities; ``init_state`` builds the state
+    pytree (or None for stateless nodes); ``eval`` must be pure/traceable.
+
+    ``MONOTONE_CAPS`` names the capacities that integrate the stream (trace
+    sizes, per-key gathers against growing groups): their requirements grow
+    roughly linearly with tick count, so a warmed-up run can pre-size them
+    for a planned run length (compiler.presize) instead of climbing the
+    grow/retrace ladder during measurement.
+    """
+
+    MONOTONE_CAPS: frozenset = frozenset()
+
+    def __init__(self, node, op):
+        self.node = node
+        self.op = op
+        self.caps: Dict[str, int] = {}
+
+    def init_state(self):
+        return None
+
+    def eval(self, ctx, state, inputs):  # -> (state', output)
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Stateless nodes
+# ---------------------------------------------------------------------------
+
+
+class CInput(CNode):
+    """Source: the tick's feed batch (from the traced generator or the feeds
+    argument). The compiler injects the value via ctx.feeds."""
+
+    def eval(self, ctx, state, inputs):
+        batch = ctx.feeds.get(self.node.index)
+        if batch is None:
+            sch = (self.op.key_dtypes, self.op.val_dtypes)
+            batch = Batch.empty(*sch)
+        return None, batch
+
+
+class CPure(CNode):
+    """Map/filter/flat_map — the host op's kernel is already a pure
+    Batch -> Batch function; reuse it directly."""
+
+    def eval(self, ctx, state, inputs):
+        return None, self.op._inner(inputs[0])
+
+
+class CPlus(CNode):
+    def eval(self, ctx, state, inputs):
+        a, b = inputs
+        return None, a.merge_with(b)
+
+
+class CMinus(CNode):
+    def eval(self, ctx, state, inputs):
+        return None, inputs[0].merge_with(inputs[1].neg())
+
+
+class CStreamDistinct(CNode):
+    def eval(self, ctx, state, inputs):
+        return None, type(self.op)._kernel(inputs[0])
+
+
+class CNeg(CNode):
+    def eval(self, ctx, state, inputs):
+        return None, inputs[0].neg()
+
+
+class CSumN(CNode):
+    def eval(self, ctx, state, inputs):
+        return None, concat_batches(list(inputs)).consolidate()
+
+
+class COutput(CNode):
+    """Sink: expose the batch as a per-tick run output."""
+
+    def eval(self, ctx, state, inputs):
+        ctx.outputs[self.node.index] = inputs[0]
+        return None, None
+
+
+# ---------------------------------------------------------------------------
+# Stateful nodes
+# ---------------------------------------------------------------------------
+
+
+def _migrate_spine(spine) -> Optional[Batch]:
+    """One consolidated batch of a host-path spine (None if empty) — the
+    state-migration bridge for warm starts; consolidates ONCE."""
+    if not spine.batches:
+        return None
+    return spine.consolidated()
+
+
+class CTrace(CNode):
+    """integrate_trace as a single consolidated batch (see module doc)."""
+
+    MONOTONE_CAPS = frozenset({"trace"})
+    DEFAULT_CAP = 1024
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self._migrated = _migrate_spine(op.spine)
+        live = 0 if self._migrated is None \
+            else int(self._migrated.live_count())
+        self.caps["trace"] = bucket_cap(max(live * 2, self.DEFAULT_CAP))
+
+    def init_state(self):
+        if self._migrated is not None:
+            return self._migrated.with_cap(self.caps["trace"])
+        sch = (self.op.key_dtypes, self.op.val_dtypes)
+        return Batch.empty(*sch, cap=self.caps["trace"])
+
+    def eval(self, ctx, state, inputs):
+        delta = inputs[0]
+        post, required = static_append(state, delta)
+        ctx.require(self, "trace", required)
+        return post, CView(delta=delta, pre=state, post=post)
+
+
+class CJoin(CNode):
+    """Bilinear incremental join over CViews (operators/join.py semantics:
+    ΔA ⋈ trace(B)_post  +  ΔB ⋈ trace(A)_pre), one consolidation."""
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["left"] = 0    # sized on first trace from delta caps
+        self.caps["right"] = 0
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.join import _join_level_impl
+
+        left, right = inputs
+        nk = self.op._left_core.nk
+        fn = self.op._left_core.fn
+        flipped = self.op._right_core.fn
+        if not self.caps["left"]:
+            self.caps["left"] = max(64, left.delta.cap)
+        if not self.caps["right"]:
+            self.caps["right"] = max(64, right.delta.cap)
+        lout, ltot = _join_level_impl(left.delta, right.post, nk, fn,
+                                      self.caps["left"])
+        rout, rtot = _join_level_impl(right.delta, left.pre, nk, flipped,
+                                      self.caps["right"])
+        ctx.require(self, "left", ltot)
+        ctx.require(self, "right", rtot)
+        out = concat_batches([lout, rout]).consolidate()
+        return None, out
+
+
+class CAggregate(CNode):
+    """General incremental aggregate (Min/Max/Fold): gather touched groups
+    from the input trace view, reduce, diff against own output trace."""
+
+    # gather grows too: touched groups' FULL histories come back from the
+    # input trace, and hot groups accumulate rows over the run
+    MONOTONE_CAPS = frozenset({"out_trace", "gather"})
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["gather"] = 0
+        self.caps["out_trace"] = 0
+
+    def init_state(self):
+        migrated = _migrate_spine(self.op.out_spine)
+        if not self.caps["out_trace"]:
+            live = 0 if migrated is None else int(migrated.live_count())
+            self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
+        if migrated is not None:
+            return migrated.with_cap(self.caps["out_trace"])
+        return Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"])
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.aggregate import (_TupleMax,
+                                                  _diff_outputs_impl,
+                                                  _gather_level_impl,
+                                                  _reduce_groups_impl,
+                                                  _unique_keys_impl)
+
+        view: CView = inputs[0]
+        agg = self.op.agg
+        nk = len(self.op.key_dtypes)
+        delta = view.delta
+        qkeys, qlive = _unique_keys_impl(delta, nk)
+        q_cap = qlive.shape[-1]
+        if not self.caps["gather"]:
+            self.caps["gather"] = max(64, 2 * q_cap)
+
+        qrow, vals, w, total = _gather_level_impl(qkeys, qlive, view.post,
+                                                  self.caps["gather"])
+        ctx.require(self, "gather", total)
+        new_vals, new_present = _reduce_groups_impl(
+            ((qrow, vals, w),), agg, q_cap)
+
+        # own output trace holds exactly one live row per present key, so a
+        # q_cap-sized expansion always suffices
+        oqrow, ovals, ow, _ = _gather_level_impl(qkeys, qlive, state, q_cap)
+        old_vals, old_present = _reduce_groups_impl(
+            ((oqrow, ovals, ow),), _TupleMax(len(agg.out_dtypes)), q_cap)
+
+        cols, w = _diff_outputs_impl(qkeys, qlive, new_vals, new_present,
+                                     old_vals, old_present)
+        out = Batch(cols[:nk], cols[nk:], w)
+        state2, required = static_append(state, out)
+        ctx.require(self, "out_trace", required)
+        return state2, out
+
+
+class CLinearAggregate(CNode):
+    """Linear fast path: per-key accumulator state in a static trace batch."""
+
+    MONOTONE_CAPS = frozenset({"acc_trace"})
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["acc_trace"] = 0
+
+    def init_state(self):
+        migrated = _migrate_spine(self.op.acc_spine)
+        if not self.caps["acc_trace"]:
+            live = 0 if migrated is None else int(migrated.live_count())
+            self.caps["acc_trace"] = bucket_cap(max(live * 2, 1024))
+        if migrated is not None:
+            return migrated.with_cap(self.caps["acc_trace"])
+        return Batch.empty(*self.op._state_schema, cap=self.caps["acc_trace"])
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.aggregate import _unique_keys_impl
+        from dbsp_tpu.operators.aggregate_linear import (_combine_diff_impl,
+                                                         _net_state_impl,
+                                                         _weigh_deltas_impl)
+
+        agg = self.op.agg
+        nk = len(self.op.key_dtypes)
+        delta = inputs[0]
+        qkeys, qlive = _unique_keys_impl(delta, nk)
+        q_cap = qlive.shape[-1]
+        acc_delta, cnt_delta = _weigh_deltas_impl(delta, agg, nk)
+
+        # acc state: one live row per present key -> q_cap expansion suffices
+        from dbsp_tpu.operators.aggregate import _gather_level_impl
+
+        qrow, vals, w, _ = _gather_level_impl(qkeys, qlive, state, q_cap)
+        old = _net_state_impl(((qrow, vals, w),), q_cap)
+        out, sdiff = _combine_diff_impl(qkeys, qlive, tuple(acc_delta),
+                                        cnt_delta, *old, agg, nk)
+        state2, required = static_append(state, sdiff)
+        ctx.require(self, "acc_trace", required)
+        return state2, out
+
+
+class CDistinct(CNode):
+    """Incremental distinct over a CView (stateless given the view)."""
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.distinct import (_distinct_delta_impl,
+                                                 _old_weights_level_impl)
+
+        view: CView = inputs[0]
+        old_w = _old_weights_level_impl(view.delta, view.pre)
+        return None, _distinct_delta_impl(view.delta, old_w)

@@ -1,0 +1,321 @@
+"""Compile a built circuit into ONE jitted step function.
+
+Why this exists (the TPU-first argument): the host-driven scheduler evaluates
+operators one kernel launch at a time and makes host-side decisions (grow-on-
+demand capacities, spine merge scheduling, overflow checks) that each cost a
+device->host round-trip. On a directly-attached accelerator those are ~us;
+over a tunneled TPU they measure ~90ms EACH, and even locally they forbid XLA
+from fusing across operator boundaries. Compiled mode removes the host from
+the per-tick path entirely:
+
+  * the scheduler's toposort eval sequence is traced ONCE into a single
+    ``step(states, tick, feeds) -> (states', outputs, required)`` function —
+    XLA sees the whole tick and fuses/overlaps across operators;
+  * every state (traces, accumulators) is a fixed-capacity device batch
+    threaded through the function — no Python bookkeeping per tick;
+  * all data-dependent capacity decisions become device-side "required
+    capacity" scalars, reduced to a running max; the runner checks them at
+    validation points (every N ticks / end of run), and on overflow grows the
+    capacity, re-traces, and REPLAYS from the last validated snapshot —
+    deterministic inputs (tick-indexed generators, retained feeds) make the
+    replay exact. Optimistic execution + epoch validation, in place of the
+    host path's per-eval synchronous checks.
+
+The input side can be closed over too: pass ``gen_fn(tick) -> feeds`` (e.g.
+:func:`dbsp_tpu.nexmark.device_gen.generate_tick`) and event generation joins
+the same XLA program — a benchmark tick then transfers NOTHING between host
+and device.
+
+Reference analog: ``crates/dataflow-jit`` (compile the dataflow once,
+schema-driven, no per-record interpretation) — here XLA is the codegen and
+the circuit graph is the IR (SURVEY.md §2.4).
+
+Supported operators: input/output handles, map/filter/flat_map/index, plus/
+minus/neg/sum, trace, join, aggregate (general + linear), distinct. Circuits
+using other operators (nested/recursive children, time-series windows, host
+``apply`` callbacks, async transports) stay on the host-driven path — the two
+modes share kernels and state layouts, so they compose (warm up host-side,
+then compile; or run host-side features around a compiled core).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dbsp_tpu.circuit.scheduler import static_schedule
+from dbsp_tpu.compiled import cnodes
+from dbsp_tpu.compiled.cnodes import CNode
+from dbsp_tpu.zset.batch import Batch, bucket_cap
+
+
+class CompiledOverflow(RuntimeError):
+    """A static capacity was exceeded since the last validation point.
+
+    ``items`` is a list of (cnode, cap_key, required) — the runner's
+    ``grow()`` consumes it; state since the last snapshot is invalid and must
+    be replayed after growing.
+    """
+
+    def __init__(self, items):
+        self.items = items
+        msg = ", ".join(f"{c.op.name}.{k}: need {r} > cap {c.caps[k]}"
+                        for c, k, r in items)
+        super().__init__(f"compiled capacities exceeded: {msg}")
+
+
+class _Ctx:
+    """Per-trace context: feeds in, outputs + capacity requirements out."""
+
+    def __init__(self, feeds):
+        self.feeds = feeds
+        self.outputs: Dict[int, Batch] = {}
+        self.reqs: List[jnp.ndarray] = []
+        self.req_index: List[Tuple[CNode, str]] = []
+
+    def require(self, cnode: CNode, key: str, scalar) -> None:
+        self.req_index.append((cnode, key))
+        self.reqs.append(jnp.asarray(scalar, jnp.int64))
+
+
+def _cnode_for(node) -> CNode:
+    from dbsp_tpu.operators.aggregate import AggregateOp
+    from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+    from dbsp_tpu.operators.basic import Minus, Neg, Plus, SumN
+    from dbsp_tpu.operators.distinct import DistinctOp, StreamDistinct
+    from dbsp_tpu.operators.filter_map import FilterOp, FlatMapOp, MapOp
+    from dbsp_tpu.operators.io_handles import OutputOperator, ZSetInput
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.operators.trace_op import TraceOp
+
+    op = node.operator
+    if isinstance(op, ZSetInput):
+        return cnodes.CInput(node, op)
+    if isinstance(op, (MapOp, FilterOp, FlatMapOp)):
+        return cnodes.CPure(node, op)
+    if isinstance(op, StreamDistinct):
+        return cnodes.CStreamDistinct(node, op)
+    if isinstance(op, TraceOp):
+        return cnodes.CTrace(node, op)
+    if isinstance(op, JoinOp):
+        return cnodes.CJoin(node, op)
+    if isinstance(op, AggregateOp):
+        return cnodes.CAggregate(node, op)
+    if isinstance(op, LinearAggregateOp):
+        return cnodes.CLinearAggregate(node, op)
+    if isinstance(op, DistinctOp):
+        return cnodes.CDistinct(node, op)
+    if isinstance(op, Plus):
+        return cnodes.CPlus(node, op)
+    if isinstance(op, Neg):
+        return cnodes.CNeg(node, op)
+    if isinstance(op, SumN):
+        return cnodes.CSumN(node, op)
+    if isinstance(op, OutputOperator):
+        return cnodes.COutput(node, op)
+    if isinstance(op, Minus):
+        return cnodes.CMinus(node, op)
+    raise NotImplementedError(
+        f"operator {op.name!r} ({type(op).__name__}) has no compiled "
+        "equivalent yet — run this circuit on the host-driven path")
+
+
+class CompiledHandle:
+    """Drives a compiled circuit: step / validate / grow / snapshot-replay."""
+
+    def __init__(self, circuit, gen_fn: Optional[Callable] = None):
+        self.circuit = circuit
+        self.order = static_schedule(circuit)
+        self.cnodes: List[CNode] = [_cnode_for(n) for n in self.order]
+        self.by_index = {cn.node.index: cn for cn in self.cnodes}
+        # map host InputHandle ops -> node indices (for feeds dicts)
+        self._op_to_index = {id(n.operator): n.index for n in self.order}
+        self._gen_fn = gen_fn
+        self.states: Dict[str, Any] = {}
+        for cn in self.cnodes:
+            st = cn.init_state()
+            if st is not None:
+                self.states[str(cn.node.index)] = st
+        self._step_jit = None
+        self._checks: List[Tuple[CNode, str]] = []
+        self._req = None          # device running-max of requirements
+        self._max_jit = jax.jit(jnp.maximum)
+        self.last_outputs: Dict[int, Batch] = {}
+        self.step_times_ns: List[int] = []
+
+    # -- feeds ---------------------------------------------------------------
+    def _feed_indices(self, feeds: Dict) -> Dict[int, Batch]:
+        out = {}
+        for h, b in feeds.items():
+            op = getattr(h, "_op", h)  # InputHandle or raw operator
+            out[self._op_to_index[id(op)]] = b
+        return out
+
+    # -- tracing -------------------------------------------------------------
+    def _make_step(self):
+        gen_fn = self._gen_fn
+        feed_map = self._op_to_index
+
+        def step_fn(states, tick, feeds):
+            if gen_fn is not None:
+                raw = gen_fn(tick)
+                feeds = {feed_map[id(getattr(h, "_op", h))]: b
+                         for h, b in raw.items()}
+            ctx = _Ctx(feeds)
+            values: Dict[int, Any] = {}
+            new_states = {}
+            for cn in self.cnodes:
+                ins = [values[i] for i in cn.node.inputs]
+                st = states.get(str(cn.node.index))
+                st2, out = cn.eval(ctx, st, ins)
+                if st2 is not None:
+                    new_states[str(cn.node.index)] = st2
+                values[cn.node.index] = out
+            req = (jnp.stack(ctx.reqs) if ctx.reqs
+                   else jnp.zeros((0,), jnp.int64))
+            self._checks = ctx.req_index  # same order every trace
+            return new_states, ctx.outputs, req
+
+        return jax.jit(step_fn)
+
+    # -- stepping ------------------------------------------------------------
+    def step(self, tick: int = 0, feeds: Optional[Dict] = None,
+             block: bool = False) -> None:
+        """Dispatch one tick. No host sync unless ``block``; call
+        :meth:`validate` (one sync) before trusting outputs/state."""
+        import time
+
+        if self._step_jit is None:
+            self._step_jit = self._make_step()
+        t0 = time.perf_counter_ns()
+        f = self._feed_indices(feeds) if feeds else {}
+        states, outputs, req = self._step_jit(
+            self.states, jnp.asarray(tick, jnp.int64), f)
+        self.states = states
+        self.last_outputs = outputs
+        self._req = req if self._req is None else self._max_jit(self._req, req)
+        if block:
+            self.block()
+        self.step_times_ns.append(time.perf_counter_ns() - t0)
+
+    def block(self) -> None:
+        """Wait for dispatched work (cheap sync, no data transfer)."""
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready(), self.states)
+
+    # -- validation / growth -------------------------------------------------
+    def validate(self) -> None:
+        """ONE device->host fetch: check every capacity requirement recorded
+        since the last validation. Raises :class:`CompiledOverflow`."""
+        if self._req is None or not self._checks:
+            return
+        req = np.asarray(jax.device_get(self._req))
+        items = []
+        for (cn, key), r in zip(self._checks, req):
+            if int(r) > cn.caps[key]:
+                items.append((cn, key, int(r)))
+        self.last_req = req  # validated requirement levels (for presize)
+        self._req = jnp.zeros_like(self._req)
+        if items:
+            raise CompiledOverflow(items)
+
+    def presize(self, ratio: float, safety: float = 1.3) -> None:
+        """Scale capacities for a run ~``ratio``x longer than what produced
+        the last validated requirements: monotone capacities (traces, group
+        gathers — they integrate the stream) are projected linearly; stable
+        ones (join fan-outs — per-delta) just get doubled headroom. One
+        re-trace now instead of a grow/replay ladder mid-measurement."""
+        if getattr(self, "last_req", None) is None:
+            return
+        changed = False
+        for (cn, key), r in zip(self._checks, self.last_req):
+            r = int(r)
+            if r <= 0:
+                continue
+            target = int(r * ratio * safety) if key in cn.MONOTONE_CAPS \
+                else 2 * r
+            if bucket_cap(target) > cn.caps[key]:
+                cn.caps[key] = bucket_cap(target)
+                changed = True
+        if changed:
+            snap = self.snapshot()
+            self._step_jit = None
+            self._req = None
+            self.restore(snap)  # re-pad states to the new capacities
+
+    def grow(self, overflow: CompiledOverflow, headroom: int = 2) -> None:
+        """Grow the overflowed capacities (with headroom, so a growing state
+        doesn't re-overflow next interval) and force a re-trace.
+
+        State since the last validated snapshot is invalid — callers MUST
+        follow with :meth:`restore` of a validated snapshot (which re-pads
+        it to the new capacities)."""
+        for cn, key, required in overflow.items:
+            cn.caps[key] = bucket_cap(required * headroom)
+        self._step_jit = None
+        self._req = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A restorable reference-copy of the current (validated) states."""
+        return dict(self.states)
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Restore a snapshot, re-padding trace states to the current
+        capacities (no-op when capacities haven't changed)."""
+        states = dict(snap)
+        for cn in self.cnodes:
+            key = str(cn.node.index)
+            if key in states:
+                st = states[key]
+                cap_key = next((k for k in ("trace", "out_trace", "acc_trace")
+                                if k in cn.caps), None)
+                if cap_key and isinstance(st, Batch) \
+                        and st.cap != cn.caps[cap_key]:
+                    states[key] = st.with_cap(cn.caps[cap_key])
+        self.states = states
+
+    # -- checkpointed run -----------------------------------------------------
+    def run_ticks(self, t0: int, n: int, validate_every: int = 16,
+                  on_validated: Optional[Callable] = None,
+                  block_each: bool = False) -> None:
+        """Run ticks [t0, t0+n) under a ``gen_fn`` with periodic validation
+        and snapshot/replay on overflow (exact: inputs are functions of the
+        tick index). ``on_validated(next_tick)`` fires after each validated
+        interval. ``block_each`` waits per tick so ``step_times_ns`` records
+        true per-tick latency instead of dispatch time (a bare device sync is
+        ~0.1ms even over the tunnel; only data fetches are expensive)."""
+        assert self._gen_fn is not None, "run_ticks needs a gen_fn"
+        snap = self.snapshot()
+        t = t0
+        while t < t0 + n:
+            upto = min(t + validate_every, t0 + n)
+            for tt in range(t, upto):
+                self.step(tick=tt, block=block_each)
+            try:
+                self.validate()
+            except CompiledOverflow as e:
+                self.grow(e)
+                self.restore(snap)
+                continue  # replay the interval at the new capacities
+            snap = self.snapshot()
+            t = upto
+            if on_validated is not None:
+                on_validated(t)
+
+    # -- host views -----------------------------------------------------------
+    def output(self, handle_or_op) -> Optional[Batch]:
+        """Latest output batch for an output handle (device; un-fetched)."""
+        op = getattr(handle_or_op, "_op", handle_or_op)
+        return self.last_outputs.get(self._op_to_index[id(op)])
+
+
+def compile_circuit(handle, gen_fn: Optional[Callable] = None
+                    ) -> CompiledHandle:
+    """Compile a host :class:`~dbsp_tpu.circuit.runtime.CircuitHandle`'s
+    circuit. Existing operator state (spines warmed by host-path steps)
+    migrates into the compiled states — warm up host-side, then compile."""
+    return CompiledHandle(handle.circuit, gen_fn=gen_fn)

@@ -40,7 +40,7 @@ def _mix64(seed: int, x: np.ndarray) -> np.ndarray:
     return z ^ (z >> np.uint64(31))
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class GeneratorConfig:
     """Mirrors the knobs of the reference bench config (nexmark/src/config.rs)."""
 

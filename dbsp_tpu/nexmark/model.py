@@ -40,4 +40,5 @@ FIRST_CATEGORY_ID = 10
 NUM_CATEGORIES = 5
 PERSON_PROPORTION = 1
 AUCTION_PROPORTION = 3
+BID_PROPORTION = 46
 PROPORTION_DENOMINATOR = 50  # 1 + 3 + 46
