@@ -100,14 +100,46 @@ class CNode:
 
 class CInput(CNode):
     """Source: the tick's feed batch (from the traced generator or the feeds
-    argument). The compiler injects the value via ctx.feeds."""
+    argument). The compiler injects the value via ctx.feeds.
+
+    Sharded mode: the host input handle hash-distributes pushed rows
+    (io_handles.py sets ``key_sharded`` on sources, mirroring the
+    reference's key-hash input routing, input.rs:309-311), so the compiled
+    source must uphold the same placement. A traced ``gen_fn`` produces the
+    FULL tick batch on every worker (counter-based generation is pure ALU —
+    replicating it is far cheaper than exchanging rows over the
+    interconnect); each worker then keeps its key-hash share and compacts
+    to a per-worker capacity (compaction preserves sort order, so the
+    slice stays consolidated)."""
 
     def eval(self, ctx, state, inputs):
         batch = ctx.feeds.get(self.node.index)
         if batch is None:
             sch = (self.op.key_dtypes, self.op.val_dtypes)
             batch = Batch.empty(*sch)
-        return None, batch
+        lead = getattr(self, "lead", ())
+        if not lead:
+            return None, batch
+        # Sharded: ALWAYS register the requirement (a conditional check
+        # would shift the _checks/_req index when a feed appears between
+        # retraces and desynchronize validation).
+        from jax import lax
+
+        from dbsp_tpu.parallel.exchange import worker_of
+        from dbsp_tpu.parallel.mesh import WORKER_AXIS
+
+        workers = lead[0]
+        w = lax.axis_index(WORKER_AXIS)
+        keep = (batch.weights != 0) & \
+            (worker_of(batch.keys[0], workers) == w)
+        cols, wts = kernels.compact(batch.cols, batch.weights, keep)
+        nk = len(batch.keys)
+        out = Batch(cols[:nk], cols[nk:], wts)
+        if not self.caps.get("input"):
+            # balanced-hash estimate; skew is caught by the requirement
+            self.caps["input"] = bucket_cap(max(batch.cap // workers, 8) * 2)
+        ctx.require(self, "input", out.live_count())
+        return None, out.with_cap(self.caps["input"])
 
 
 class CPure(CNode):
@@ -175,14 +207,15 @@ class CTrace(CNode):
         super().__init__(node, op)
         self._migrated = _migrate_spine(op.spine)
         live = 0 if self._migrated is None \
-            else int(self._migrated.live_count())
+            else int(self._migrated.max_worker_live())
         self.caps["trace"] = bucket_cap(max(live * 2, self.DEFAULT_CAP))
 
     def init_state(self):
         if self._migrated is not None:
             return self._migrated.with_cap(self.caps["trace"])
         sch = (self.op.key_dtypes, self.op.val_dtypes)
-        return Batch.empty(*sch, cap=self.caps["trace"])
+        return Batch.empty(*sch, cap=self.caps["trace"],
+                           lead=getattr(self, "lead", ()))
 
     def eval(self, ctx, state, inputs):
         delta = inputs[0]
@@ -237,11 +270,12 @@ class CAggregate(CNode):
     def init_state(self):
         migrated = _migrate_spine(self.op.out_spine)
         if not self.caps["out_trace"]:
-            live = 0 if migrated is None else int(migrated.live_count())
+            live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
         if migrated is not None:
             return migrated.with_cap(self.caps["out_trace"])
-        return Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"])
+        return Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"],
+                           lead=getattr(self, "lead", ()))
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.operators.aggregate import (_TupleMax,
@@ -291,11 +325,12 @@ class CLinearAggregate(CNode):
     def init_state(self):
         migrated = _migrate_spine(self.op.acc_spine)
         if not self.caps["acc_trace"]:
-            live = 0 if migrated is None else int(migrated.live_count())
+            live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["acc_trace"] = bucket_cap(max(live * 2, 1024))
         if migrated is not None:
             return migrated.with_cap(self.caps["acc_trace"])
-        return Batch.empty(*self.op._state_schema, cap=self.caps["acc_trace"])
+        return Batch.empty(*self.op._state_schema, cap=self.caps["acc_trace"],
+                           lead=getattr(self, "lead", ()))
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.operators.aggregate import _unique_keys_impl
@@ -332,3 +367,51 @@ class CDistinct(CNode):
         view: CView = inputs[0]
         old_w = _old_weights_level_impl(view.delta, view.pre)
         return None, _distinct_delta_impl(view.delta, old_w)
+
+
+# ---------------------------------------------------------------------------
+# Communication nodes (sharded compiled step only; the whole step runs under
+# one shard_map, so these are plain collective calls)
+# ---------------------------------------------------------------------------
+
+
+class CExchange(CNode):
+    """Key-hash repartition (shard.rs:89): bucket + all_to_all + compact to
+    a static per-worker capacity. The all_to_all's raw output capacity is
+    W x cap_local (worst-case skew); the compiled path re-buckets to
+    ``caps['exchange']`` with a requirement check instead of the host path's
+    per-eval scalar sync."""
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.parallel.exchange import exchange_local
+
+        batch = inputs[0]
+        out = exchange_local(batch, self.op.nworkers)
+        if not self.caps.get("exchange"):
+            self.caps["exchange"] = batch.cap  # balanced-hash estimate
+        ctx.require(self, "exchange", out.live_count())
+        return None, out.with_cap(self.caps["exchange"])
+
+
+class CUnshard(CNode):
+    """All-to-one gather (gather.rs:41): the union lands on worker 0; every
+    other worker holds an empty (dead-sentinel) slice. Keeping exactly ONE
+    live copy preserves Z-set weights through whatever follows — a
+    re-exchange re-distributes rows (not W copies of them) and an output
+    union counts each row once. Output capacity is exact (sum of per-worker
+    caps), so no requirement check is needed."""
+
+    def eval(self, ctx, state, inputs):
+        from jax import lax
+
+        from dbsp_tpu.parallel.exchange import gather_local
+        from dbsp_tpu.parallel.mesh import WORKER_AXIS
+
+        union = gather_local(inputs[0])
+        mine = lax.axis_index(WORKER_AXIS) == 0
+        cols = tuple(
+            jnp.where(mine, c, kernels.sentinel_for(c.dtype))
+            for c in union.cols)
+        w = jnp.where(mine, union.weights, 0)
+        nk = len(union.keys)
+        return None, Batch(cols[:nk], cols[nk:], w)

@@ -119,6 +119,12 @@ def _cnode_for(node) -> CNode:
         return cnodes.COutput(node, op)
     if isinstance(op, Minus):
         return cnodes.CMinus(node, op)
+    from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+
+    if isinstance(op, ExchangeOp):
+        return cnodes.CExchange(node, op)
+    if isinstance(op, UnshardOp):
+        return cnodes.CUnshard(node, op)
     raise NotImplementedError(
         f"operator {op.name!r} ({type(op).__name__}) has no compiled "
         "equivalent yet — run this circuit on the host-driven path")
@@ -127,8 +133,11 @@ def _cnode_for(node) -> CNode:
 class CompiledHandle:
     """Drives a compiled circuit: step / validate / grow / snapshot-replay."""
 
-    def __init__(self, circuit, gen_fn: Optional[Callable] = None):
+    def __init__(self, circuit, gen_fn: Optional[Callable] = None,
+                 runtime=None):
         self.circuit = circuit
+        self.mesh = getattr(runtime, "mesh", None)
+        self.workers = getattr(runtime, "workers", 1)
         self.order = static_schedule(circuit)
         self.cnodes: List[CNode] = [_cnode_for(n) for n in self.order]
         self.by_index = {cn.node.index: cn for cn in self.cnodes}
@@ -137,8 +146,13 @@ class CompiledHandle:
         self._gen_fn = gen_fn
         self.states: Dict[str, Any] = {}
         for cn in self.cnodes:
+            cn.lead = (self.workers,) if self.workers > 1 else ()
             st = cn.init_state()
             if st is not None:
+                if self.workers > 1:
+                    from dbsp_tpu.parallel.mesh import worker_sharding
+
+                    st = jax.device_put(st, worker_sharding(self.mesh))
                 self.states[str(cn.node.index)] = st
         self._step_jit = None
         self._checks: List[Tuple[CNode, str]] = []
@@ -156,29 +170,62 @@ class CompiledHandle:
         return out
 
     # -- tracing -------------------------------------------------------------
+    def _run_nodes(self, states, tick, feeds):
+        """The scheduler's eval sequence as a pure traced function (shared
+        by the single-worker and SPMD step builders)."""
+        if self._gen_fn is not None:
+            raw = self._gen_fn(tick)
+            feeds = {self._op_to_index[id(getattr(h, "_op", h))]: b
+                     for h, b in raw.items()}
+        ctx = _Ctx(feeds)
+        values: Dict[int, Any] = {}
+        new_states = {}
+        for cn in self.cnodes:
+            ins = [values[i] for i in cn.node.inputs]
+            st = states.get(str(cn.node.index))
+            st2, out = cn.eval(ctx, st, ins)
+            if st2 is not None:
+                new_states[str(cn.node.index)] = st2
+            values[cn.node.index] = out
+        req = (jnp.stack(ctx.reqs) if ctx.reqs
+               else jnp.zeros((0,), jnp.int64))
+        self._checks = ctx.req_index  # same order every trace
+        return new_states, ctx.outputs, req
+
     def _make_step(self):
-        gen_fn = self._gen_fn
-        feed_map = self._op_to_index
+        if self.mesh is None:
+            def step_fn(states, tick, feeds):
+                return self._run_nodes(states, tick, feeds)
+
+            return jax.jit(step_fn)
+
+        # SPMD: ONE shard_map around the whole eval sequence. Inside, every
+        # batch is its [cap_local] worker slice, operators run their plain
+        # single-worker kernels, and exchange/unshard nodes are the only
+        # cross-worker communication (all_to_all / all_gather over the mesh
+        # axis) — the reference's worker/exchange architecture as a single
+        # SPMD program (shard.rs:35-101, exchange.rs:586).
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from dbsp_tpu.parallel.mesh import WORKER_AXIS
+
+        W = P(WORKER_AXIS)
 
         def step_fn(states, tick, feeds):
-            if gen_fn is not None:
-                raw = gen_fn(tick)
-                feeds = {feed_map[id(getattr(h, "_op", h))]: b
-                         for h, b in raw.items()}
-            ctx = _Ctx(feeds)
-            values: Dict[int, Any] = {}
-            new_states = {}
-            for cn in self.cnodes:
-                ins = [values[i] for i in cn.node.inputs]
-                st = states.get(str(cn.node.index))
-                st2, out = cn.eval(ctx, st, ins)
-                if st2 is not None:
-                    new_states[str(cn.node.index)] = st2
-                values[cn.node.index] = out
-            req = (jnp.stack(ctx.reqs) if ctx.reqs
-                   else jnp.zeros((0,), jnp.int64))
-            self._checks = ctx.req_index  # same order every trace
-            return new_states, ctx.outputs, req
+            def body(states_l, tick_l, feeds_l):
+                squeeze = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a: a[0], t)
+                expand = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a: a[None], t)
+                new_states, outputs, req = self._run_nodes(
+                    squeeze(states_l), tick_l, squeeze(feeds_l))
+                return expand(new_states), expand(outputs), req[None]
+
+            ns, outs, reqw = shard_map(
+                body, mesh=self.mesh, in_specs=(W, P(), W),
+                out_specs=(W, W, W))(states, tick, feeds)
+            return ns, outs, jnp.max(reqw, axis=0)
 
         return jax.jit(step_fn)
 
@@ -317,5 +364,17 @@ def compile_circuit(handle, gen_fn: Optional[Callable] = None
                     ) -> CompiledHandle:
     """Compile a host :class:`~dbsp_tpu.circuit.runtime.CircuitHandle`'s
     circuit. Existing operator state (spines warmed by host-path steps)
-    migrates into the compiled states — warm up host-side, then compile."""
-    return CompiledHandle(handle.circuit, gen_fn=gen_fn)
+    migrates into the compiled states — warm up host-side, then compile.
+
+    Multi-worker circuits (built with ``Runtime.init_circuit(N, ...)``)
+    compile to a single SPMD program over the runtime's mesh; in that case a
+    ``gen_fn`` runs per-worker inside the program and may use
+    ``jax.lax.axis_index("workers")`` to generate its slice."""
+    from dbsp_tpu.circuit.runtime import Runtime
+
+    rt = getattr(handle, "runtime", None)
+    prev, Runtime._current = Runtime._current, rt
+    try:
+        return CompiledHandle(handle.circuit, gen_fn=gen_fn, runtime=rt)
+    finally:
+        Runtime._current = prev

@@ -145,6 +145,34 @@ def test_compiled_warm_start_from_host_state():
     assert outs[2] == host[2] and outs[3] == host[3]
 
 
+def test_compiled_sharded_matches_single_worker():
+    """The whole sharded step as ONE shard_map'd program (8 virtual
+    workers): device generation replicated per worker + hash-share inputs +
+    all_to_all exchanges + per-worker kernels == the single-worker compiled
+    run, tick for tick (the reference's identical-output-across-worker-
+    counts contract, shard.rs:35-88)."""
+
+    def run(workers):
+        handle, (handles, out) = Runtime.init_circuit(workers, _q4_build)
+        hp, ha, hb = handles
+
+        def gen_fn(tick):
+            p, a, b = device_gen.generate_tick(CFG, tick * EPT, EPT)
+            return {hp: p, ha: a, hb: b}
+
+        ch = compile_circuit(handle, gen_fn=gen_fn)
+        outs = {}
+
+        def capture(next_tick):
+            b = ch.output(out)
+            outs[next_tick - 1] = b.to_dict() if b is not None else {}
+
+        ch.run_ticks(0, TICKS, validate_every=1, on_validated=capture)
+        return [outs[t] for t in range(TICKS)]
+
+    assert run(8) == run(1)
+
+
 def test_compiled_feeds_mode_distinct_plus():
     """Feed-dict mode (no gen_fn) over a circuit exercising distinct and
     plus; differential vs the host path with identical pushed batches."""
