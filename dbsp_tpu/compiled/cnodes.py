@@ -130,11 +130,8 @@ class CInput(CNode):
 
         workers = lead[0]
         w = lax.axis_index(WORKER_AXIS)
-        keep = (batch.weights != 0) & \
-            (worker_of(batch.keys[0], workers) == w)
-        cols, wts = kernels.compact(batch.cols, batch.weights, keep)
-        nk = len(batch.keys)
-        out = Batch(cols[:nk], cols[nk:], wts)
+        out = batch.compacted((batch.weights != 0) &
+                              (worker_of(batch.keys[0], workers) == w))
         if not self.caps.get("input"):
             # balanced-hash estimate; skew is caught by the requirement
             self.caps["input"] = bucket_cap(max(batch.cap // workers, 8) * 2)
@@ -357,6 +354,66 @@ class CLinearAggregate(CNode):
         return state2, out
 
 
+class CTopK(CNode):
+    """Incremental per-key top-K (operators/topk.py): recompute touched
+    groups' top-K from the input trace view, diff against the previous
+    output kept in a static out-trace batch. The old-output gather needs no
+    requirement check — the out trace holds at most k live rows per key, so
+    ``q_cap * k`` is an exact bound."""
+
+    MONOTONE_CAPS = frozenset({"out_trace", "gather"})
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["gather"] = 0
+        self.caps["old_gather"] = 0
+        self.caps["out_trace"] = 0
+
+    def init_state(self):
+        migrated = _migrate_spine(self.op.out_spine)
+        if not self.caps["out_trace"]:
+            live = 0 if migrated is None else int(migrated.max_worker_live())
+            self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
+        if migrated is not None:
+            return migrated.with_cap(self.caps["out_trace"])
+        return Batch.empty(*self.op.schema, cap=self.caps["out_trace"],
+                           lead=getattr(self, "lead", ()))
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.aggregate import (_gather_level_impl,
+                                                  _unique_keys_impl)
+        from dbsp_tpu.operators.topk import _topk_rows
+
+        view: CView = inputs[0]
+        nk = len(self.op.schema[0])
+        delta = view.delta
+        qkeys, qlive = _unique_keys_impl(delta, nk)
+        q_cap = qlive.shape[-1]
+        if not self.caps["gather"]:
+            self.caps["gather"] = max(64, 2 * q_cap)
+        if not self.caps["old_gather"]:
+            # trained like the new-side gather; q_cap * k is the hard upper
+            # bound (<= k live out rows per touched key) but materializing
+            # it every tick would dwarf the actual touched set
+            self.caps["old_gather"] = max(64, 2 * q_cap)
+
+        qrow, vals, w, total = _gather_level_impl(qkeys, qlive, view.post,
+                                                  self.caps["gather"])
+        ctx.require(self, "gather", total)
+        new_part = _topk_rows(qrow, qkeys, vals, w, self.op.k,
+                              self.op.largest, 1, q_cap)
+        oqrow, ovals, ow, old_total = _gather_level_impl(
+            qkeys, qlive, state, min(self.caps["old_gather"],
+                                     q_cap * self.op.k))
+        ctx.require(self, "old_gather", old_total)
+        old_part = _topk_rows(oqrow, qkeys, ovals, ow, self.op.k,
+                              self.op.largest, -1, q_cap)
+        out = concat_batches([new_part, old_part]).consolidate()
+        state2, required = static_append(state, out)
+        ctx.require(self, "out_trace", required)
+        return state2, out
+
+
 class CDistinct(CNode):
     """Incremental distinct over a CView (stateless given the view)."""
 
@@ -367,6 +424,132 @@ class CDistinct(CNode):
         view: CView = inputs[0]
         old_w = _old_weights_level_impl(view.delta, view.pre)
         return None, _distinct_delta_impl(view.delta, old_w)
+
+
+# ---------------------------------------------------------------------------
+# Time-series nodes (watermark / apply / window)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CMaybe:
+    """A device scalar stream value that may not exist yet (the host path's
+    ``None`` before the first event — e.g. a watermark). ``value`` is
+    arbitrary traced arithmetic; ``valid`` masks every consumer, so the
+    garbage value computed before the first event never becomes observable."""
+
+    valid: jnp.ndarray
+    value: object
+
+
+_WM_FLOOR = int(jnp.iinfo(jnp.int64).min) // 4  # headroom for bound arithmetic
+
+
+def truncate_below(batch: Batch, bound) -> Batch:
+    """Drop rows whose leading key is below ``bound`` (compiled analog of
+    ``Spine.truncate_keys_below`` — the TraceBound GC, operator/trace.rs:29);
+    capacity unchanged, live rows stay packed + sorted."""
+    k0 = batch.keys[0]
+    return batch.compacted(
+        (batch.weights != 0) & (k0 >= jnp.asarray(bound, k0.dtype)))
+
+
+class CWatermark(CNode):
+    """``watermark_monotonic`` (watermark.rs:33): running max of a live
+    timestamp column minus lateness, as device scalars — state is
+    (wm, valid) instead of the host path's ``None``-able Python int."""
+
+    def init_state(self):
+        if getattr(self, "lead", ()):
+            raise NotImplementedError(
+                "watermark: sharded compiled circuits not supported yet "
+                "(window traces are not shard-lifted on the host path either)")
+        return (jnp.asarray(_WM_FLOOR, jnp.int64), jnp.asarray(False))
+
+    def eval(self, ctx, state, inputs):
+        batch = inputs[0]
+        ts = self.op.ts_fn(batch.keys, batch.vals).astype(jnp.int64)
+        live = batch.weights != 0
+        m = jnp.max(jnp.where(live, ts, _WM_FLOOR))
+        any_live = jnp.any(live)
+        wm0, valid0 = state
+        wm1 = jnp.where(any_live,
+                        jnp.maximum(wm0, m - self.op.lateness), wm0)
+        valid1 = valid0 | any_live
+        return (wm1, valid1), CMaybe(valid1, wm1)
+
+
+class CApply(CNode):
+    """Host ``apply`` over scalar streams: trace the Python fn on the device
+    value. A ``CMaybe`` input keeps its validity (the fn's host-side
+    ``None`` branch is unreachable under tracing — tracers are never None)."""
+
+    def eval(self, ctx, state, inputs):
+        v = inputs[0]
+        if isinstance(v, CMaybe):
+            return None, CMaybe(v.valid, self.op.fn(v.value))
+        return None, self.op.fn(v)
+
+
+class CWindow(CNode):
+    """Moving-bounds window (window.rs:75-130) over a compiled trace view.
+
+    Same three-part delta as the host op (new rows in [a1,b1); minus rows
+    that slid out of [a0,min(a1,b0)); plus rows that slid in from
+    [max(b0,a1),b1)) — but range extraction is two masked slices of the
+    SINGLE consolidated trace batch instead of per-spine-level cursors, and
+    the pre-first-bounds tick is expressed by masking (weights to 0) rather
+    than an early return. With ``gc=True`` the lower bound feeds back into
+    the trace node's state via ``ctx.gc_bounds`` — the compiler truncates
+    the trace inside the same XLA program (TraceBound GC)."""
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["slide_out"] = 0
+        self.caps["slide_in"] = 0
+
+    def init_state(self):
+        if getattr(self, "lead", ()):
+            raise NotImplementedError(
+                "window: sharded compiled circuits not supported yet")
+        # (a0, b0, had_bounds)
+        return (jnp.asarray(0, jnp.int64), jnp.asarray(0, jnp.int64),
+                jnp.asarray(False))
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.timeseries.window import _filter_window, _slice_range
+
+        view, bounds = inputs
+        if not isinstance(bounds, CMaybe):
+            bounds = CMaybe(jnp.asarray(True), bounds)
+        a1, b1 = (jnp.asarray(x, jnp.int64) for x in bounds.value)
+        valid1 = bounds.valid
+        a0, b0, had = state
+        # first bounds ever -> previous window is the empty range [a1, a1)
+        a0e = jnp.where(had, a0, a1)
+        b0e = jnp.where(had, b0, a1)
+
+        if not self.caps["slide_out"]:
+            cap = max(64, view.delta.cap)
+            self.caps["slide_out"] = cap
+            self.caps["slide_in"] = cap
+        p_new = _filter_window(view.delta, a1, b1)
+        out_b, n_out = _slice_range(view.pre, a0e, jnp.minimum(a1, b0e),
+                                    self.caps["slide_out"])
+        in_b, n_in = _slice_range(view.pre, jnp.maximum(b0e, a1), b1,
+                                  self.caps["slide_in"])
+        ctx.require(self, "slide_out", n_out)
+        ctx.require(self, "slide_in", n_in)
+        # masked: everything is dead until bounds exist
+        out = concat_batches([p_new, out_b.neg(), in_b]).consolidate() \
+            .masked(valid1)
+
+        if self.op.gc:
+            ctx.gc_bounds[self.node.inputs[0]] = \
+                jnp.where(valid1, a1, jnp.asarray(_WM_FLOOR, jnp.int64))
+        state2 = (jnp.where(valid1, a1, a0), jnp.where(valid1, b1, b0),
+                  had | valid1)
+        return state2, out
 
 
 # ---------------------------------------------------------------------------
@@ -408,10 +591,4 @@ class CUnshard(CNode):
         from dbsp_tpu.parallel.mesh import WORKER_AXIS
 
         union = gather_local(inputs[0])
-        mine = lax.axis_index(WORKER_AXIS) == 0
-        cols = tuple(
-            jnp.where(mine, c, kernels.sentinel_for(c.dtype))
-            for c in union.cols)
-        w = jnp.where(mine, union.weights, 0)
-        nk = len(union.keys)
-        return None, Batch(cols[:nk], cols[nk:], w)
+        return None, union.masked(lax.axis_index(WORKER_AXIS) == 0)

@@ -31,11 +31,13 @@ schema-driven, no per-record interpretation) — here XLA is the codegen and
 the circuit graph is the IR (SURVEY.md §2.4).
 
 Supported operators: input/output handles, map/filter/flat_map/index, plus/
-minus/neg/sum, trace, join, aggregate (general + linear), distinct. Circuits
-using other operators (nested/recursive children, time-series windows, host
-``apply`` callbacks, async transports) stay on the host-driven path — the two
-modes share kernels and state layouts, so they compose (warm up host-side,
-then compile; or run host-side features around a compiled core).
+minus/neg/sum, trace, join, aggregate (general + linear), distinct,
+watermark/apply/window (scalar streams become (valid, value) device pairs;
+window GC feeds back into the trace state inside the program). Circuits
+using other operators (nested/recursive children, async transports) stay on
+the host-driven path — the two modes share kernels and state layouts, so
+they compose (warm up host-side, then compile; or run host-side features
+around a compiled core).
 """
 
 from __future__ import annotations
@@ -76,6 +78,9 @@ class _Ctx:
         self.outputs: Dict[int, Batch] = {}
         self.reqs: List[jnp.ndarray] = []
         self.req_index: List[Tuple[CNode, str]] = []
+        # trace-node index -> lower bound: window GC feeding back into the
+        # trace state within the same program (TraceBound semantics)
+        self.gc_bounds: Dict[int, jnp.ndarray] = {}
 
     def require(self, cnode: CNode, key: str, scalar) -> None:
         self.req_index.append((cnode, key))
@@ -119,12 +124,25 @@ def _cnode_for(node) -> CNode:
         return cnodes.COutput(node, op)
     if isinstance(op, Minus):
         return cnodes.CMinus(node, op)
+    from dbsp_tpu.operators.basic import Apply
     from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+    from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
+    from dbsp_tpu.timeseries.window import WindowOp
 
     if isinstance(op, ExchangeOp):
         return cnodes.CExchange(node, op)
     if isinstance(op, UnshardOp):
         return cnodes.CUnshard(node, op)
+    from dbsp_tpu.operators.topk import TopKOp
+
+    if isinstance(op, TopKOp):
+        return cnodes.CTopK(node, op)
+    if isinstance(op, WatermarkMonotonic):
+        return cnodes.CWatermark(node, op)
+    if isinstance(op, Apply):
+        return cnodes.CApply(node, op)
+    if isinstance(op, WindowOp):
+        return cnodes.CWindow(node, op)
     raise NotImplementedError(
         f"operator {op.name!r} ({type(op).__name__}) has no compiled "
         "equivalent yet — run this circuit on the host-driven path")
@@ -141,6 +159,14 @@ class CompiledHandle:
         self.order = static_schedule(circuit)
         self.cnodes: List[CNode] = [_cnode_for(n) for n in self.order]
         self.by_index = {cn.node.index: cn for cn in self.cnodes}
+        # a GC'd trace is bounded by the window span, not the run length:
+        # exclude it from linear presize projection (instance attr shadows
+        # the class-level MONOTONE_CAPS)
+        for cn in self.cnodes:
+            if isinstance(cn, cnodes.CWindow) and cn.op.gc:
+                tgt = self.by_index.get(cn.node.inputs[0])
+                if isinstance(tgt, cnodes.CTrace):
+                    tgt.MONOTONE_CAPS = frozenset()
         # map host InputHandle ops -> node indices (for feeds dicts)
         self._op_to_index = {id(n.operator): n.index for n in self.order}
         self._gen_fn = gen_fn
@@ -187,6 +213,11 @@ class CompiledHandle:
             if st2 is not None:
                 new_states[str(cn.node.index)] = st2
             values[cn.node.index] = out
+        for idx, bound in ctx.gc_bounds.items():
+            key = str(idx)
+            if key in new_states:
+                new_states[key] = cnodes.truncate_below(
+                    new_states[key], bound)
         req = (jnp.stack(ctx.reqs) if ctx.reqs
                else jnp.zeros((0,), jnp.int64))
         self._checks = ctx.req_index  # same order every trace
@@ -228,6 +259,58 @@ class CompiledHandle:
             return ns, outs, jnp.max(reqw, axis=0)
 
         return jax.jit(step_fn)
+
+    def _make_scan(self, n: int):
+        """A jitted program running ``n`` ticks of the eval sequence inside
+        one ``lax.scan`` — ONE dispatch (and one host round-trip, if the
+        caller blocks) per n ticks. Over a tunneled accelerator a cached
+        single-tick dispatch still costs ~1.5s of RPC overhead; scanning
+        amortizes it to ~1.5s/n. Requirements reduce to a running max across
+        iterations; outputs are the LAST tick's (carried, not stacked — no
+        n-times memory blowup). gen_fn mode only (feeds are host values)."""
+        assert self._gen_fn is not None, "scan mode needs a gen_fn"
+        assert self.mesh is None, "scan mode is single-worker for now"
+
+        def scan_fn(states, t0):
+            outs_shape = jax.eval_shape(
+                lambda s, t: self._run_nodes(s, t, {})[1], states, t0)
+            init_outs = jax.tree_util.tree_map(
+                lambda sh: jnp.zeros(sh.shape, sh.dtype), outs_shape)
+
+            def body(carry, i):
+                st, _ = carry
+                ns, outs, req = self._run_nodes(st, t0 + i, {})
+                # states absent from ns (stateless ticks) carry through
+                merged = {**st, **ns}
+                return (merged, outs), req
+
+            (ns, outs), reqs = jax.lax.scan(
+                body, (states, init_outs), jnp.arange(n, dtype=jnp.int64))
+            req = (jnp.max(reqs, axis=0) if reqs.shape[1]
+                   else jnp.zeros((0,), jnp.int64))
+            return ns, outs, req
+
+        return jax.jit(scan_fn)
+
+    def step_scanned(self, t0: int, n: int, block: bool = False) -> None:
+        """Run ticks [t0, t0+n) as one scanned dispatch (see _make_scan).
+        Programs are cached per chunk length n."""
+        import time
+
+        cache = getattr(self, "_scan_jits", None)
+        if cache is None:
+            cache = self._scan_jits = {}
+        fn = cache.get(n)
+        if fn is None:
+            fn = cache[n] = self._make_scan(n)
+        t_start = time.perf_counter_ns()
+        states, outputs, req = fn(self.states, jnp.asarray(t0, jnp.int64))
+        self.states = states
+        self.last_outputs = outputs
+        self._req = req if self._req is None else self._max_jit(self._req, req)
+        if block:
+            self.block()
+        self.step_times_ns.append(time.perf_counter_ns() - t_start)
 
     # -- stepping ------------------------------------------------------------
     def step(self, tick: int = 0, feeds: Optional[Dict] = None,
@@ -291,19 +374,30 @@ class CompiledHandle:
         if changed:
             snap = self.snapshot()
             self._step_jit = None
+            self._scan_jits = {}
             self._req = None
             self.restore(snap)  # re-pad states to the new capacities
 
-    def grow(self, overflow: CompiledOverflow, headroom: int = 2) -> None:
+    def grow(self, overflow: CompiledOverflow, headroom: int = 2,
+             project_ratio: float = 1.0) -> None:
         """Grow the overflowed capacities (with headroom, so a growing state
         doesn't re-overflow next interval) and force a re-trace.
+
+        ``project_ratio`` > 1 folds the presize projection into the grow:
+        monotone capacities (traces — they integrate the stream) jump
+        straight to their projected end-of-run size. On a tunneled
+        accelerator each re-trace costs a full program compile (~minutes),
+        so one projected grow beats a doubling ladder by several compiles.
 
         State since the last validated snapshot is invalid — callers MUST
         follow with :meth:`restore` of a validated snapshot (which re-pads
         it to the new capacities)."""
         for cn, key, required in overflow.items:
-            cn.caps[key] = bucket_cap(required * headroom)
+            factor = max(headroom, project_ratio * 1.3) \
+                if key in cn.MONOTONE_CAPS else headroom
+            cn.caps[key] = bucket_cap(int(required * factor))
         self._step_jit = None
+        self._scan_jits = {}
         self._req = None
 
     def snapshot(self) -> Dict[str, Any]:
@@ -328,24 +422,33 @@ class CompiledHandle:
     # -- checkpointed run -----------------------------------------------------
     def run_ticks(self, t0: int, n: int, validate_every: int = 16,
                   on_validated: Optional[Callable] = None,
-                  block_each: bool = False) -> None:
+                  block_each: bool = False, scan: bool = False,
+                  project_ratio: float = 1.0) -> None:
         """Run ticks [t0, t0+n) under a ``gen_fn`` with periodic validation
         and snapshot/replay on overflow (exact: inputs are functions of the
         tick index). ``on_validated(next_tick)`` fires after each validated
         interval. ``block_each`` waits per tick so ``step_times_ns`` records
         true per-tick latency instead of dispatch time (a bare device sync is
-        ~0.1ms even over the tunnel; only data fetches are expensive)."""
+        ~0.1ms even over the tunnel; only data fetches are expensive).
+
+        ``scan=True`` runs each validation interval as ONE scanned dispatch
+        (see :meth:`step_scanned`) — per-tick latency is then the chunk time
+        / chunk length. ``project_ratio`` is handed to :meth:`grow` so an
+        overflow mid-run jumps monotone capacities to end-of-run size."""
         assert self._gen_fn is not None, "run_ticks needs a gen_fn"
         snap = self.snapshot()
         t = t0
         while t < t0 + n:
             upto = min(t + validate_every, t0 + n)
-            for tt in range(t, upto):
-                self.step(tick=tt, block=block_each)
+            if scan:
+                self.step_scanned(t, upto - t, block=block_each)
+            else:
+                for tt in range(t, upto):
+                    self.step(tick=tt, block=block_each)
             try:
                 self.validate()
             except CompiledOverflow as e:
-                self.grow(e)
+                self.grow(e, project_ratio=project_ratio)
                 self.restore(snap)
                 continue  # replay the interval at the new capacities
             snap = self.snapshot()

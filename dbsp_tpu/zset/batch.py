@@ -170,6 +170,22 @@ class Batch:
         nk = len(self.keys)
         return Batch(cols[:nk], cols[nk:], w)
 
+    def compacted(self, keep: jnp.ndarray) -> "Batch":
+        """Rows where ``keep`` holds, packed to the front (dead-sentinel
+        tail), same capacity; preserves sort order."""
+        cols, w = kernels.compact(self.cols, self.weights, keep)
+        nk = len(self.keys)
+        return Batch(cols[:nk], cols[nk:], w)
+
+    def masked(self, cond) -> "Batch":
+        """The whole batch where ``cond`` (broadcastable) holds, dead
+        (sentinel cols, zero weight) where it doesn't — the traced analog of
+        'empty until X' host logic."""
+        cols = tuple(jnp.where(cond, c, kernels.sentinel_for(c.dtype))
+                     for c in self.cols)
+        nk = len(self.keys)
+        return Batch(cols[:nk], cols[nk:], jnp.where(cond, self.weights, 0))
+
     def with_cap(self, cap: int) -> "Batch":
         """Grow or shrink row capacity (last axis). Shrinking assumes live
         rows fit (caller checked the live count); consolidated batches keep
@@ -226,16 +242,29 @@ class Batch:
 
     # -- host-side views (tests / output handles) ---------------------------
     def to_dict(self) -> Dict[Row, int]:
-        """Materialize as {(key..., val...): weight} — the test oracle format.
-        A sharded batch materializes the union over all worker slices."""
-        cols = [np.asarray(c).reshape(-1) for c in self.cols]
+        """Materialize as {(key..., val...): weight} — the test oracle format
+        and the serving-path row view. A sharded batch materializes the
+        union over all worker slices. Vectorized: one boolean-mask gather +
+        ``tolist`` per column instead of a per-row Python loop (the
+        host-side analog of compaction; NDJSON encoders and HTTP output
+        endpoints sit on this path at rate)."""
         ws = np.asarray(self.weights).reshape(-1)
+        live = ws != 0
+        if not live.any():
+            return {}
+        ws = ws[live]
+        if not self.cols:  # unit-keyed batch: all rows are ()
+            total = int(ws.sum())
+            return {(): total} if total else {}
+        cols = [np.asarray(c).reshape(-1)[live].tolist() for c in self.cols]
         out: Dict[Row, int] = {}
-        for i in range(len(ws)):
-            if ws[i] != 0:
-                row = tuple(c[i].item() for c in cols)
-                out[row] = out.get(row, 0) + int(ws[i])
-        return {r: w for r, w in out.items() if w != 0}
+        for row, w in zip(zip(*cols), ws.tolist()):
+            nw = out.get(row, 0) + w
+            if nw:
+                out[row] = nw
+            else:
+                out.pop(row, None)
+        return out
 
 
 @jax.jit
