@@ -89,6 +89,15 @@ class CNode:
     def init_state(self):
         return None
 
+    def repad_state(self, st):
+        """Re-fit a snapshotted state to the CURRENT capacities (after a
+        grow); default handles the single-Batch trace states."""
+        cap_key = next((k for k in ("trace", "out_trace", "acc_trace")
+                        if k in self.caps), None)
+        if cap_key and isinstance(st, Batch) and st.cap != self.caps[cap_key]:
+            return st.with_cap(self.caps[cap_key])
+        return st
+
     def eval(self, ctx, state, inputs):  # -> (state', output)
         raise NotImplementedError
 
@@ -253,7 +262,22 @@ class CJoin(CNode):
 
 class CAggregate(CNode):
     """General incremental aggregate (Min/Max/Fold): gather touched groups
-    from the input trace view, reduce, diff against own output trace."""
+    from the input trace view, reduce, diff against own output trace.
+
+    Semigroup aggregates (``agg.insert_combinable`` — Min/Max) take a fast
+    path: groups whose delta holds only insertions combine the delta's own
+    reduction with the previous output (new max = max(old max, delta max)),
+    so NO history comes back from the input trace — per-tick cost is
+    O(delta), not O(touched history). The combine is only sound while every
+    net weight in the integrated trace is non-negative (a positive delta
+    row could otherwise partially cancel an over-retracted trace row and
+    surface a value that is NOT present); the state carries an
+    ``ever_negative`` flag — once ANY retraction has entered the stream,
+    touched groups re-gather (requirement-checked; stays zero on
+    append-only streams like Nexmark bids). The reference's eval
+    (aggregate/mod.rs:600) always walks the touched groups' trace cursors —
+    this is a strict improvement enabled by keeping the previous outputs in
+    a probe-able batch."""
 
     # gather grows too: touched groups' FULL histories come back from the
     # input trace, and hot groups accumulate rows over the run
@@ -263,6 +287,9 @@ class CAggregate(CNode):
         super().__init__(node, op)
         self.caps["gather"] = 0
         self.caps["out_trace"] = 0
+        if getattr(op.agg, "insert_combinable", False):
+            # the gather only serves retracted groups -> not monotone
+            self.MONOTONE_CAPS = frozenset({"out_trace"})
 
     def init_state(self):
         migrated = _migrate_spine(self.op.out_spine)
@@ -270,9 +297,19 @@ class CAggregate(CNode):
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
         if migrated is not None:
-            return migrated.with_cap(self.caps["out_trace"])
-        return Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"],
-                           lead=getattr(self, "lead", ()))
+            # a host-warmed spine has unknown retraction history — the fast
+            # path must assume the worst
+            return (migrated.with_cap(self.caps["out_trace"]),
+                    jnp.asarray(True))
+        return (Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"],
+                            lead=getattr(self, "lead", ())),
+                jnp.asarray(False))
+
+    def repad_state(self, st):
+        batch, ever_neg = st
+        if batch.cap != self.caps["out_trace"]:
+            batch = batch.with_cap(self.caps["out_trace"])
+        return (batch, ever_neg)
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.operators.aggregate import (_TupleMax,
@@ -282,32 +319,64 @@ class CAggregate(CNode):
                                                   _unique_keys_impl)
 
         view: CView = inputs[0]
+        out_trace, ever_neg = state
         agg = self.op.agg
         nk = len(self.op.key_dtypes)
         delta = view.delta
         qkeys, qlive = _unique_keys_impl(delta, nk)
         q_cap = qlive.shape[-1]
+        fast = getattr(agg, "insert_combinable", False)
         if not self.caps["gather"]:
-            self.caps["gather"] = max(64, 2 * q_cap)
-
-        qrow, vals, w, total = _gather_level_impl(qkeys, qlive, view.post,
-                                                  self.caps["gather"])
-        ctx.require(self, "gather", total)
-        new_vals, new_present = _reduce_groups_impl(
-            ((qrow, vals, w),), agg, q_cap)
+            self.caps["gather"] = 64 if fast else max(64, 2 * q_cap)
 
         # own output trace holds exactly one live row per present key, so a
         # q_cap-sized expansion always suffices
-        oqrow, ovals, ow, _ = _gather_level_impl(qkeys, qlive, state, q_cap)
+        oqrow, ovals, ow, _ = _gather_level_impl(qkeys, qlive, out_trace,
+                                                 q_cap)
         old_vals, old_present = _reduce_groups_impl(
             ((oqrow, ovals, ow),), _TupleMax(len(agg.out_dtypes)), q_cap)
+
+        ever_neg = ever_neg | jnp.any(delta.weights < 0)
+        if fast:
+            # segment id per delta row: live rows are a packed prefix of the
+            # consolidated delta, in qkeys order
+            anylive = delta.weights != 0
+            first = ~kernels.rows_equal_prev(delta.keys[:nk], n=delta.cap)
+            seg = jnp.cumsum(jnp.where(first & anylive, 1, 0)) - 1
+            seg = jnp.where(anylive, seg, q_cap).astype(jnp.int32)
+            d_vals = tuple(o[:q_cap] for o in agg.reduce(
+                delta.vals, delta.weights, seg, q_cap + 1))
+            one = jnp.where(delta.weights > 0, 1, 0)
+            d_present = jax.ops.segment_max(
+                one, seg, num_segments=q_cap + 1)[:q_cap] > 0
+            fast_vals = agg.combine(old_vals, old_present, d_vals, d_present)
+            fast_present = old_present | d_present
+            # re-gather every touched group once ANY retraction has entered
+            # the stream (a positive delta may then partially cancel a
+            # net-negative trace row — combine would be unsound); stays
+            # empty (lo==hi) on append-only streams
+            slow = qlive & jnp.broadcast_to(ever_neg, qlive.shape)
+            qrow, vals, w, total = _gather_level_impl(
+                qkeys, slow, view.post, self.caps["gather"])
+            ctx.require(self, "gather", total)
+            slow_vals, slow_present = _reduce_groups_impl(
+                ((qrow, vals, w),), agg, q_cap)
+            new_vals = tuple(jnp.where(slow, sv.astype(fv.dtype), fv)
+                             for sv, fv in zip(slow_vals, fast_vals))
+            new_present = jnp.where(slow, slow_present, fast_present)
+        else:
+            qrow, vals, w, total = _gather_level_impl(
+                qkeys, qlive, view.post, self.caps["gather"])
+            ctx.require(self, "gather", total)
+            new_vals, new_present = _reduce_groups_impl(
+                ((qrow, vals, w),), agg, q_cap)
 
         cols, w = _diff_outputs_impl(qkeys, qlive, new_vals, new_present,
                                      old_vals, old_present)
         out = Batch(cols[:nk], cols[nk:], w)
-        state2, required = static_append(state, out)
+        state2, required = static_append(out_trace, out)
         ctx.require(self, "out_trace", required)
-        return state2, out
+        return (state2, ever_neg), out
 
 
 class CLinearAggregate(CNode):

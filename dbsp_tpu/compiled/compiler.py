@@ -411,12 +411,7 @@ class CompiledHandle:
         for cn in self.cnodes:
             key = str(cn.node.index)
             if key in states:
-                st = states[key]
-                cap_key = next((k for k in ("trace", "out_trace", "acc_trace")
-                                if k in cn.caps), None)
-                if cap_key and isinstance(st, Batch) \
-                        and st.cap != cn.caps[cap_key]:
-                    states[key] = st.with_cap(cn.caps[cap_key])
+                states[key] = cn.repad_state(states[key])
         self.states = states
 
     # -- checkpointed run -----------------------------------------------------
