@@ -292,6 +292,10 @@ class CAggregate(CNode):
             self.MONOTONE_CAPS = frozenset({"out_trace"})
 
     def init_state(self):
+        # ever_neg carries the same per-worker lead axis as the batch state:
+        # every state leaf must be rank>=1 under PartitionSpec('workers') and
+        # the shard_map squeeze (a[0]) assumes a leading worker axis
+        lead = getattr(self, "lead", ())
         migrated = _migrate_spine(self.op.out_spine)
         if not self.caps["out_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
@@ -300,10 +304,10 @@ class CAggregate(CNode):
             # a host-warmed spine has unknown retraction history — the fast
             # path must assume the worst
             return (migrated.with_cap(self.caps["out_trace"]),
-                    jnp.asarray(True))
+                    jnp.full(lead, True))
         return (Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"],
-                            lead=getattr(self, "lead", ())),
-                jnp.asarray(False))
+                            lead=lead),
+                jnp.full(lead, False))
 
     def repad_state(self, st):
         batch, ever_neg = st
