@@ -98,6 +98,11 @@ class CNode:
             return st.with_cap(self.caps[cap_key])
         return st
 
+    def note_requirement(self, key: str, required: int) -> None:
+        """Hook fired with each VALIDATED requirement level — lets a node
+        reclassify a capacity once observed behavior contradicts its static
+        assumption (see CAggregate's gather)."""
+
     def eval(self, ctx, state, inputs):  # -> (state', output)
         raise NotImplementedError
 
@@ -288,8 +293,18 @@ class CAggregate(CNode):
         self.caps["gather"] = 0
         self.caps["out_trace"] = 0
         if getattr(op.agg, "insert_combinable", False):
-            # the gather only serves retracted groups -> not monotone
+            # the gather only serves retracted groups -> not monotone...
             self.MONOTONE_CAPS = frozenset({"out_trace"})
+
+    def note_requirement(self, key, required):
+        # ...until a retraction actually engages the slow path: from then on
+        # every touched group re-gathers its FULL history, so the gather
+        # requirement does grow with the run — reclassify it as monotone so
+        # presize projects it linearly instead of climbing a grow/retrace
+        # ladder (each retrace ~minutes over a tunneled accelerator)
+        if key == "gather" and required > 0 \
+                and "gather" not in self.MONOTONE_CAPS:
+            self.MONOTONE_CAPS = self.MONOTONE_CAPS | {"gather"}
 
     def init_state(self):
         # ever_neg carries the same per-worker lead axis as the batch state:
@@ -521,10 +536,13 @@ _WM_FLOOR = int(jnp.iinfo(jnp.int64).min) // 4  # headroom for bound arithmetic
 def truncate_below(batch: Batch, bound) -> Batch:
     """Drop rows whose leading key is below ``bound`` (compiled analog of
     ``Spine.truncate_keys_below`` — the TraceBound GC, operator/trace.rs:29);
-    capacity unchanged, live rows stay packed + sorted."""
+    capacity unchanged, live rows stay packed + sorted. The comparison runs
+    in int64: the pre-first-bounds sentinel (_WM_FLOOR) would wrap if cast
+    down to an int32 key column and truncate live negative-key rows."""
     k0 = batch.keys[0]
     return batch.compacted(
-        (batch.weights != 0) & (k0 >= jnp.asarray(bound, k0.dtype)))
+        (batch.weights != 0) &
+        (k0.astype(jnp.int64) >= jnp.asarray(bound, jnp.int64)))
 
 
 class CWatermark(CNode):

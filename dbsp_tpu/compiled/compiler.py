@@ -346,6 +346,7 @@ class CompiledHandle:
         req = np.asarray(jax.device_get(self._req))
         items = []
         for (cn, key), r in zip(self._checks, req):
+            cn.note_requirement(key, int(r))
             if int(r) > cn.caps[key]:
                 items.append((cn, key, int(r)))
         self.last_req = req  # validated requirement levels (for presize)
