@@ -18,19 +18,24 @@ requirements via ``ctx.require(self, cap_key, device_scalar)``; the runner
 compares the running max of those scalars against the configured caps at
 validation points and grows + retraces on overflow.
 
-The trace state here is deliberately simpler than the host path's LSM spine:
-a SINGLE consolidated batch per trace, merged with each tick's delta by one
-rank-based sorted-merge kernel. O(trace) HBM traffic per tick instead of the
-spine's amortized O(log n) levels — the right trade on TPU, where a 2M-row
-merge is a few ms of vector work but every host round-trip to *schedule*
-spine merges costs ~100ms over a tunneled accelerator. (The spine remains
-the right structure for the host-driven path and for states that outgrow
-single-kernel merges.)
+Trace states are LEVELED inside the program — the spine, compiled
+(reference: the fueled spine's amortization contract,
+``crates/dbsp/src/trace/spine_fueled.rs:1-81``). Each trace is a static
+tuple of K consolidated level batches in geometric capacity classes; a
+tick's delta rank-merges into level 0 (O(|L0|+|Δ|)), and a level that fills
+past half its capacity spills into the next via ``lax.cond`` — so a big
+merge touching the tail runs only every ~cap(K-2)/2 appended rows, and
+per-tick HBM traffic is O(Δ·levels) amortized instead of O(state). The
+spill decision is a device scalar: no host round-trip ever schedules a
+merge, which is what the reference's fuel bookkeeping exists to do.
+Consumers fan out over the K levels exactly like host operators fan out
+over ``spine.batches`` — the level kernels are shared.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -40,30 +45,94 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 
 # ---------------------------------------------------------------------------
-# Static single-batch trace
+# Static leveled trace (the in-program spine)
 # ---------------------------------------------------------------------------
 
+# Level count K (including the tail) and the default capacity ratio between
+# adjacent levels. Level capacities self-scale to the observed delta size
+# through the requirement/grow machinery; these only seed the ladder.
+TRACE_LEVELS = int(os.environ.get("DBSP_TPU_TRACE_LEVELS", "4"))
+LEVEL0_CAP = int(os.environ.get("DBSP_TPU_TRACE_L0", "1024"))
+LEVEL_GROWTH = int(os.environ.get("DBSP_TPU_TRACE_GROWTH", "8"))
 
-def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
-    """Merge ``delta`` into a fixed-capacity trace batch.
 
-    Returns (new trace at the SAME capacity, required live rows). Live rows
-    pack to the front after a merge, so slicing back to the trace capacity
-    drops only dead tail — unless required > cap, which the runner detects.
-    """
-    merged = trace.merge_with(delta)
-    required = merged.live_count()
-    return merged.with_cap(trace.cap), required
+class _Leveled:
+    """Mixin managing a leveled static trace state: a tuple of K consolidated
+    batches (level 0 smallest, last = tail). Capacity keys are "l0".."l{K-2}"
+    plus the subclass's ``TAIL_KEY`` (which keeps its legacy name so
+    MONOTONE_CAPS / presize semantics carry over unchanged)."""
+
+    TAIL_KEY = "trace"
+
+    def _init_level_caps(self) -> None:
+        n = max(1, TRACE_LEVELS)
+        self.level_keys: Tuple[str, ...] = tuple(
+            f"l{k}" for k in range(n - 1)) + (self.TAIL_KEY,)
+        cap = LEVEL0_CAP
+        for key in self.level_keys[:-1]:
+            self.caps.setdefault(key, bucket_cap(cap))
+            cap *= LEVEL_GROWTH
+
+    def _levels_init(self, schema, lead, migrated: Optional[Batch]
+                     ) -> Tuple[Batch, ...]:
+        lv = [Batch.empty(*schema, cap=self.caps[k], lead=lead)
+              for k in self.level_keys]
+        if migrated is not None:
+            # warm start: the host spine's consolidated state becomes the tail
+            lv[-1] = migrated.with_cap(self.caps[self.TAIL_KEY])
+        return tuple(lv)
+
+    def _levels_append(self, ctx, levels: Tuple[Batch, ...], delta: Batch
+                       ) -> Tuple[Batch, ...]:
+        """Merge a delta into level 0, then cascade half-full spills upward.
+
+        Every level registers its requirement every tick (receiving level:
+        live(self)+live(below) — a conservative preview, so capacity grows
+        BEFORE the spill that would overflow it); the spill itself runs
+        under ``lax.cond`` so non-spill ticks pay only the live-count sums.
+        """
+        from jax import lax
+
+        new = list(levels)
+        m0 = new[0].merge_with(delta)
+        ctx.require(self, self.level_keys[0], m0.live_count())
+        new[0] = m0.with_cap(self.caps[self.level_keys[0]])
+        # the tail must eventually absorb every level, so its requirement is
+        # the TOTAL live count — the whole-trace size metric (GC plateau
+        # checks and presize's monotone projection both key off it)
+        total = sum(b.live_count() for b in new)
+        for k in range(len(new) - 1):
+            lk, lk1 = new[k], new[k + 1]
+            lk_live = lk.live_count()
+            receiver = self.level_keys[k + 1]
+            ctx.require(self, receiver,
+                        total if receiver == self.TAIL_KEY
+                        else lk1.live_count() + lk_live)
+            spill = lk_live * 2 >= lk.cap
+            new[k], new[k + 1] = lax.cond(
+                spill,
+                lambda ab: (ab[0].masked(False),
+                            ab[1].merge_with(ab[0]).with_cap(ab[1].cap)),
+                lambda ab: ab,
+                (lk, lk1))
+        return tuple(new)
+
+    def _levels_repad(self, levels: Tuple[Batch, ...]) -> Tuple[Batch, ...]:
+        return tuple(
+            b.with_cap(self.caps[k]) if b.cap != self.caps[k] else b
+            for b, k in zip(levels, self.level_keys))
 
 
 @dataclasses.dataclass
 class CView:
     """Compiled analog of ``operators.trace_op.TraceView``: the trace of a
-    stream before (z^-1) and after this tick's append."""
+    stream before (z^-1) and after this tick's append. ``pre``/``post`` are
+    the LEVEL TUPLES of the leveled trace state — consumers fan out over
+    them like host operators fan out over ``spine.batches``."""
 
     delta: Batch
-    pre: Batch
-    post: Batch
+    pre: Tuple[Batch, ...]
+    post: Tuple[Batch, ...]
 
 
 class CNode:
@@ -208,10 +277,11 @@ def _migrate_spine(spine) -> Optional[Batch]:
     return spine.consolidated()
 
 
-class CTrace(CNode):
-    """integrate_trace as a single consolidated batch (see module doc)."""
+class CTrace(CNode, _Leveled):
+    """integrate_trace as a leveled static trace (see module doc)."""
 
     MONOTONE_CAPS = frozenset({"trace"})
+    TAIL_KEY = "trace"
     DEFAULT_CAP = 1024
 
     def __init__(self, node, op):
@@ -220,18 +290,19 @@ class CTrace(CNode):
         live = 0 if self._migrated is None \
             else int(self._migrated.max_worker_live())
         self.caps["trace"] = bucket_cap(max(live * 2, self.DEFAULT_CAP))
+        self._init_level_caps()
 
     def init_state(self):
-        if self._migrated is not None:
-            return self._migrated.with_cap(self.caps["trace"])
         sch = (self.op.key_dtypes, self.op.val_dtypes)
-        return Batch.empty(*sch, cap=self.caps["trace"],
-                           lead=getattr(self, "lead", ()))
+        return self._levels_init(sch, getattr(self, "lead", ()),
+                                 self._migrated)
+
+    def repad_state(self, st):
+        return self._levels_repad(st)
 
     def eval(self, ctx, state, inputs):
         delta = inputs[0]
-        post, required = static_append(state, delta)
-        ctx.require(self, "trace", required)
+        post = self._levels_append(ctx, state, delta)
         return post, CView(delta=delta, pre=state, post=post)
 
 
@@ -255,17 +326,25 @@ class CJoin(CNode):
             self.caps["left"] = max(64, left.delta.cap)
         if not self.caps["right"]:
             self.caps["right"] = max(64, right.delta.cap)
-        lout, ltot = _join_level_impl(left.delta, right.post, nk, fn,
-                                      self.caps["left"])
-        rout, rtot = _join_level_impl(right.delta, left.pre, nk, flipped,
-                                      self.caps["right"])
-        ctx.require(self, "left", ltot)
-        ctx.require(self, "right", rtot)
-        out = concat_batches([lout, rout]).consolidate()
+        # ΔL joins every level of trace(R) post-append; ΔR every level of
+        # trace(L) pre-append — the out cap is shared across a side's levels
+        # (the requirement's running max sizes it to the worst level)
+        outs = []
+        for lvl in right.post:
+            lout, ltot = _join_level_impl(left.delta, lvl, nk, fn,
+                                          self.caps["left"])
+            ctx.require(self, "left", ltot)
+            outs.append(lout)
+        for lvl in left.pre:
+            rout, rtot = _join_level_impl(right.delta, lvl, nk, flipped,
+                                          self.caps["right"])
+            ctx.require(self, "right", rtot)
+            outs.append(rout)
+        out = concat_batches(outs).consolidate()
         return None, out
 
 
-class CAggregate(CNode):
+class CAggregate(CNode, _Leveled):
     """General incremental aggregate (Min/Max/Fold): gather touched groups
     from the input trace view, reduce, diff against own output trace.
 
@@ -287,11 +366,14 @@ class CAggregate(CNode):
     # gather grows too: touched groups' FULL histories come back from the
     # input trace, and hot groups accumulate rows over the run
     MONOTONE_CAPS = frozenset({"out_trace", "gather"})
+    TAIL_KEY = "out_trace"
 
     def __init__(self, node, op):
         super().__init__(node, op)
         self.caps["gather"] = 0
+        self.caps["old_gather"] = 0
         self.caps["out_trace"] = 0
+        self._init_level_caps()
         if getattr(op.agg, "insert_combinable", False):
             # the gather only serves retracted groups -> not monotone...
             self.MONOTONE_CAPS = frozenset({"out_trace"})
@@ -315,30 +397,34 @@ class CAggregate(CNode):
         if not self.caps["out_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
-        if migrated is not None:
-            # a host-warmed spine has unknown retraction history — the fast
-            # path must assume the worst
-            return (migrated.with_cap(self.caps["out_trace"]),
-                    jnp.full(lead, True))
-        return (Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"],
-                            lead=lead),
-                jnp.full(lead, False))
+        # a host-warmed spine has unknown retraction history — the fast
+        # path must assume the worst
+        return (self._levels_init(self.op.out_schema, lead, migrated),
+                jnp.full(lead, migrated is not None))
 
     def repad_state(self, st):
-        batch, ever_neg = st
-        if batch.cap != self.caps["out_trace"]:
-            batch = batch.with_cap(self.caps["out_trace"])
-        return (batch, ever_neg)
+        levels, ever_neg = st
+        return (self._levels_repad(levels), ever_neg)
+
+    def _gather_parts(self, ctx, qkeys, mask, levels, cap_key):
+        from dbsp_tpu.operators.aggregate import _gather_level_impl
+
+        parts = []
+        for lvl in levels:
+            qrow, vals, w, total = _gather_level_impl(
+                qkeys, mask, lvl, self.caps[cap_key])
+            ctx.require(self, cap_key, total)
+            parts.append((qrow, vals, w))
+        return tuple(parts)
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.operators.aggregate import (_TupleMax,
                                                   _diff_outputs_impl,
-                                                  _gather_level_impl,
                                                   _reduce_groups_impl,
                                                   _unique_keys_impl)
 
         view: CView = inputs[0]
-        out_trace, ever_neg = state
+        out_levels, ever_neg = state
         agg = self.op.agg
         nk = len(self.op.key_dtypes)
         delta = view.delta
@@ -347,13 +433,15 @@ class CAggregate(CNode):
         fast = getattr(agg, "insert_combinable", False)
         if not self.caps["gather"]:
             self.caps["gather"] = 64 if fast else max(64, 2 * q_cap)
+        if not self.caps["old_gather"]:
+            # a key's current output may be spread as insert/retract rows
+            # over several out levels until a spill nets them
+            self.caps["old_gather"] = max(64, 2 * q_cap)
 
-        # own output trace holds exactly one live row per present key, so a
-        # q_cap-sized expansion always suffices
-        oqrow, ovals, ow, _ = _gather_level_impl(qkeys, qlive, out_trace,
-                                                 q_cap)
+        oparts = self._gather_parts(ctx, qkeys, qlive, out_levels,
+                                    "old_gather")
         old_vals, old_present = _reduce_groups_impl(
-            ((oqrow, ovals, ow),), _TupleMax(len(agg.out_dtypes)), q_cap)
+            oparts, _TupleMax(len(agg.out_dtypes)), q_cap)
 
         ever_neg = ever_neg | jnp.any(delta.weights < 0)
         if fast:
@@ -375,50 +463,50 @@ class CAggregate(CNode):
             # net-negative trace row — combine would be unsound); stays
             # empty (lo==hi) on append-only streams
             slow = qlive & jnp.broadcast_to(ever_neg, qlive.shape)
-            qrow, vals, w, total = _gather_level_impl(
-                qkeys, slow, view.post, self.caps["gather"])
-            ctx.require(self, "gather", total)
-            slow_vals, slow_present = _reduce_groups_impl(
-                ((qrow, vals, w),), agg, q_cap)
+            sparts = self._gather_parts(ctx, qkeys, slow, view.post,
+                                        "gather")
+            slow_vals, slow_present = _reduce_groups_impl(sparts, agg, q_cap)
             new_vals = tuple(jnp.where(slow, sv.astype(fv.dtype), fv)
                              for sv, fv in zip(slow_vals, fast_vals))
             new_present = jnp.where(slow, slow_present, fast_present)
         else:
-            qrow, vals, w, total = _gather_level_impl(
-                qkeys, qlive, view.post, self.caps["gather"])
-            ctx.require(self, "gather", total)
-            new_vals, new_present = _reduce_groups_impl(
-                ((qrow, vals, w),), agg, q_cap)
+            parts = self._gather_parts(ctx, qkeys, qlive, view.post,
+                                       "gather")
+            new_vals, new_present = _reduce_groups_impl(parts, agg, q_cap)
 
         cols, w = _diff_outputs_impl(qkeys, qlive, new_vals, new_present,
                                      old_vals, old_present)
         out = Batch(cols[:nk], cols[nk:], w)
-        state2, required = static_append(out_trace, out)
-        ctx.require(self, "out_trace", required)
+        state2 = self._levels_append(ctx, out_levels, out)
         return (state2, ever_neg), out
 
 
-class CLinearAggregate(CNode):
-    """Linear fast path: per-key accumulator state in a static trace batch."""
+class CLinearAggregate(CNode, _Leveled):
+    """Linear fast path: per-key accumulator state in a leveled trace."""
 
     MONOTONE_CAPS = frozenset({"acc_trace"})
+    TAIL_KEY = "acc_trace"
 
     def __init__(self, node, op):
         super().__init__(node, op)
         self.caps["acc_trace"] = 0
+        self.caps["acc_gather"] = 0
+        self._init_level_caps()
 
     def init_state(self):
         migrated = _migrate_spine(self.op.acc_spine)
         if not self.caps["acc_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["acc_trace"] = bucket_cap(max(live * 2, 1024))
-        if migrated is not None:
-            return migrated.with_cap(self.caps["acc_trace"])
-        return Batch.empty(*self.op._state_schema, cap=self.caps["acc_trace"],
-                           lead=getattr(self, "lead", ()))
+        return self._levels_init(self.op._state_schema,
+                                 getattr(self, "lead", ()), migrated)
+
+    def repad_state(self, st):
+        return self._levels_repad(st)
 
     def eval(self, ctx, state, inputs):
-        from dbsp_tpu.operators.aggregate import _unique_keys_impl
+        from dbsp_tpu.operators.aggregate import (_gather_level_impl,
+                                                  _unique_keys_impl)
         from dbsp_tpu.operators.aggregate_linear import (_combine_diff_impl,
                                                          _net_state_impl,
                                                          _weigh_deltas_impl)
@@ -430,46 +518,64 @@ class CLinearAggregate(CNode):
         q_cap = qlive.shape[-1]
         acc_delta, cnt_delta = _weigh_deltas_impl(delta, agg, nk)
 
-        # acc state: one live row per present key -> q_cap expansion suffices
-        from dbsp_tpu.operators.aggregate import _gather_level_impl
-
-        qrow, vals, w, _ = _gather_level_impl(qkeys, qlive, state, q_cap)
-        old = _net_state_impl(((qrow, vals, w),), q_cap)
+        if not self.caps["acc_gather"]:
+            # a key's accumulator may be spread as diff rows over several
+            # levels until a spill nets them (linearity makes the sum exact)
+            self.caps["acc_gather"] = max(64, 2 * q_cap)
+        parts = []
+        for lvl in state:
+            qrow, vals, w, total = _gather_level_impl(
+                qkeys, qlive, lvl, self.caps["acc_gather"])
+            ctx.require(self, "acc_gather", total)
+            parts.append((qrow, vals, w))
+        old = _net_state_impl(tuple(parts), q_cap)
         out, sdiff = _combine_diff_impl(qkeys, qlive, tuple(acc_delta),
                                         cnt_delta, *old, agg, nk)
-        state2, required = static_append(state, sdiff)
-        ctx.require(self, "acc_trace", required)
+        state2 = self._levels_append(ctx, state, sdiff)
         return state2, out
 
 
-class CTopK(CNode):
+class CTopK(CNode, _Leveled):
     """Incremental per-key top-K (operators/topk.py): recompute touched
     groups' top-K from the input trace view, diff against the previous
-    output kept in a static out-trace batch. The old-output gather needs no
-    requirement check — the out trace holds at most k live rows per key, so
-    ``q_cap * k`` is an exact bound."""
+    output kept in a leveled out trace. Both gathers fan out over levels
+    and combine with :func:`concat_parts` exactly like the host op."""
 
     MONOTONE_CAPS = frozenset({"out_trace", "gather"})
+    TAIL_KEY = "out_trace"
 
     def __init__(self, node, op):
         super().__init__(node, op)
         self.caps["gather"] = 0
         self.caps["old_gather"] = 0
         self.caps["out_trace"] = 0
+        self._init_level_caps()
 
     def init_state(self):
         migrated = _migrate_spine(self.op.out_spine)
         if not self.caps["out_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
-        if migrated is not None:
-            return migrated.with_cap(self.caps["out_trace"])
-        return Batch.empty(*self.op.schema, cap=self.caps["out_trace"],
-                           lead=getattr(self, "lead", ()))
+        return self._levels_init(self.op.schema, getattr(self, "lead", ()),
+                                 migrated)
+
+    def repad_state(self, st):
+        return self._levels_repad(st)
+
+    def _gathered(self, ctx, qkeys, qlive, levels, cap_key):
+        from dbsp_tpu.operators.aggregate import (_gather_level_impl,
+                                                  concat_parts)
+
+        parts = []
+        for lvl in levels:
+            qrow, vals, w, total = _gather_level_impl(
+                qkeys, qlive, lvl, self.caps[cap_key])
+            ctx.require(self, cap_key, total)
+            parts.append((qrow, vals, w))
+        return concat_parts(parts)
 
     def eval(self, ctx, state, inputs):
-        from dbsp_tpu.operators.aggregate import (_gather_level_impl,
-                                                  _unique_keys_impl)
+        from dbsp_tpu.operators.aggregate import _unique_keys_impl
         from dbsp_tpu.operators.topk import _topk_rows
 
         view: CView = inputs[0]
@@ -480,25 +586,16 @@ class CTopK(CNode):
         if not self.caps["gather"]:
             self.caps["gather"] = max(64, 2 * q_cap)
         if not self.caps["old_gather"]:
-            # trained like the new-side gather; q_cap * k is the hard upper
-            # bound (<= k live out rows per touched key) but materializing
-            # it every tick would dwarf the actual touched set
             self.caps["old_gather"] = max(64, 2 * q_cap)
 
-        qrow, vals, w, total = _gather_level_impl(qkeys, qlive, view.post,
-                                                  self.caps["gather"])
-        ctx.require(self, "gather", total)
-        new_part = _topk_rows(qrow, qkeys, vals, w, self.op.k,
+        g = self._gathered(ctx, qkeys, qlive, view.post, "gather")
+        new_part = _topk_rows(g[0], qkeys, g[1], g[2], self.op.k,
                               self.op.largest, 1, q_cap)
-        oqrow, ovals, ow, old_total = _gather_level_impl(
-            qkeys, qlive, state, min(self.caps["old_gather"],
-                                     q_cap * self.op.k))
-        ctx.require(self, "old_gather", old_total)
-        old_part = _topk_rows(oqrow, qkeys, ovals, ow, self.op.k,
+        o = self._gathered(ctx, qkeys, qlive, state, "old_gather")
+        old_part = _topk_rows(o[0], qkeys, o[1], o[2], self.op.k,
                               self.op.largest, -1, q_cap)
         out = concat_batches([new_part, old_part]).consolidate()
-        state2, required = static_append(state, out)
-        ctx.require(self, "out_trace", required)
+        state2 = self._levels_append(ctx, state, out)
         return state2, out
 
 
@@ -510,7 +607,10 @@ class CDistinct(CNode):
                                                  _old_weights_level_impl)
 
         view: CView = inputs[0]
-        old_w = _old_weights_level_impl(view.delta, view.pre)
+        old_w = None
+        for lvl in view.pre:
+            w = _old_weights_level_impl(view.delta, lvl)
+            old_w = w if old_w is None else old_w + w
         return None, _distinct_delta_impl(view.delta, old_w)
 
 
@@ -624,16 +724,20 @@ class CWindow(CNode):
             cap = max(64, view.delta.cap)
             self.caps["slide_out"] = cap
             self.caps["slide_in"] = cap
-        p_new = _filter_window(view.delta, a1, b1)
-        out_b, n_out = _slice_range(view.pre, a0e, jnp.minimum(a1, b0e),
-                                    self.caps["slide_out"])
-        in_b, n_in = _slice_range(view.pre, jnp.maximum(b0e, a1), b1,
-                                  self.caps["slide_in"])
-        ctx.require(self, "slide_out", n_out)
-        ctx.require(self, "slide_in", n_in)
+        # slide ranges are extracted per trace level (shared slide caps —
+        # the requirement's running max sizes them to the worst level)
+        parts = [_filter_window(view.delta, a1, b1)]
+        for lvl in view.pre:
+            out_b, n_out = _slice_range(lvl, a0e, jnp.minimum(a1, b0e),
+                                        self.caps["slide_out"])
+            ctx.require(self, "slide_out", n_out)
+            parts.append(out_b.neg())
+            in_b, n_in = _slice_range(lvl, jnp.maximum(b0e, a1), b1,
+                                      self.caps["slide_in"])
+            ctx.require(self, "slide_in", n_in)
+            parts.append(in_b)
         # masked: everything is dead until bounds exist
-        out = concat_batches([p_new, out_b.neg(), in_b]).consolidate() \
-            .masked(valid1)
+        out = concat_batches(parts).consolidate().masked(valid1)
 
         if self.op.gc:
             ctx.gc_bounds[self.node.inputs[0]] = \

@@ -215,9 +215,10 @@ class CompiledHandle:
             values[cn.node.index] = out
         for idx, bound in ctx.gc_bounds.items():
             key = str(idx)
-            if key in new_states:
-                new_states[key] = cnodes.truncate_below(
-                    new_states[key], bound)
+            if key in new_states:  # a leveled trace: truncate every level
+                new_states[key] = tuple(
+                    cnodes.truncate_below(lvl, bound)
+                    for lvl in new_states[key])
         req = (jnp.stack(ctx.reqs) if ctx.reqs
                else jnp.zeros((0,), jnp.int64))
         self._checks = ctx.req_index  # same order every trace
@@ -396,7 +397,11 @@ class CompiledHandle:
         for cn, key, required in overflow.items:
             factor = max(headroom, project_ratio * 1.3) \
                 if key in cn.MONOTONE_CAPS else headroom
-            cn.caps[key] = bucket_cap(int(required * factor))
+            # max: a capacity key can overflow at several sites in one
+            # interval (e.g. one requirement per trace level) — never let a
+            # later, smaller item shrink the grown cap
+            cn.caps[key] = max(cn.caps[key],
+                               bucket_cap(int(required * factor)))
         self._step_jit = None
         self._scan_jits = {}
         self._req = None
