@@ -35,11 +35,25 @@ transfers (critical over the tunneled TPU, where one scalar fetch costs
 with snapshot/replay on overflow. BENCH_MODE=host uses the host-driven
 scheduler path (the general-purpose mode).
 
-Env knobs: BENCH_EVENTS (total; default 2_000_000 on TPU, 500_000 on CPU),
-BENCH_BATCH (events/tick, default 100_000), BENCH_QUERY (default q4),
-BENCH_WARM_TICKS (default 4), BENCH_PLATFORM (cpu|tpu|probe, default probe),
-BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE (compiled|host),
-BENCH_VALIDATE_EVERY (default 8).
+Latency protocol: on CPU the measured run blocks per tick (scan=False), so
+step_times_ns holds >= 100 true per-tick samples and p50/p99 are a real
+distribution. Over the tunneled TPU per-tick dispatch costs ~1.5s of RPC
+overhead, so there the run keeps the scanned-chunk mode (one dispatch per
+validation interval) and latency granularity degrades to chunk-level —
+reported as such.
+
+Multi-query: BENCH_QUERIES (default "q3,q4,q8" — the north-star set) runs
+each query through its own circuit; the headline metric/value is q4's (or
+the first measured query's), with every query's numbers under
+detail["queries"]. A query that exceeds the remaining time budget is
+skipped and marked.
+
+Env knobs: BENCH_EVENTS (per query; default 750_000 on CPU — >=100 ticks
+at the CPU batch — 2_000_000 on TPU), BENCH_BATCH (events/tick, default
+7_500 on CPU / 100_000 on TPU), BENCH_QUERIES, BENCH_QUERY (headline
+override), BENCH_WARM_TICKS (default 4), BENCH_PLATFORM (cpu|tpu|probe,
+default probe), BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE
+(compiled|host), BENCH_VALIDATE_EVERY (default 8).
 """
 
 import json
@@ -175,26 +189,27 @@ def _supervise() -> int:
 
 def _knobs(platform: str):
     """Env-knob parsing shared by both execution modes."""
-    default_events = 2_000_000 if platform != "cpu" else 500_000
+    cpu = platform == "cpu"
+    default_events = 750_000 if cpu else 2_000_000
+    default_batch = 7_500 if cpu else 100_000
     return (int(os.environ.get("BENCH_EVENTS", default_events)),
-            int(os.environ.get("BENCH_BATCH", 100_000)),
+            int(os.environ.get("BENCH_BATCH", default_batch)),
             os.environ.get("BENCH_QUERY", "q4"),
             int(os.environ.get("BENCH_WARM_TICKS", 4)))
 
 
-def run_compiled(platform: str, detail: dict) -> float:
-    """Compiled-mode measurement: one XLA program per tick, device-side
-    generation, periodic validation (see module doc)."""
+def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
+    """Measure one query in compiled mode (one XLA program per tick,
+    device-side generation, periodic validation — see module doc).
+    Fills ``detail`` incrementally so a mid-run failure reports progress."""
     import time as _time
-
-    import jax
 
     from dbsp_tpu.circuit import Runtime
     from dbsp_tpu.compiled import compile_circuit
     from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
                                   queries)
 
-    total, batch, qname, warm_ticks = _knobs(platform)
+    total, batch, _, warm_ticks = _knobs(platform)
     validate_every = int(os.environ.get("BENCH_VALIDATE_EVERY", 8))
     query = getattr(queries, qname)
     # device generation needs whole 50-event epochs; warmup needs >= 1 tick
@@ -202,10 +217,11 @@ def run_compiled(platform: str, detail: dict) -> float:
     batch = max(batch // 50, 1) * 50
     warm_ticks = max(warm_ticks, 1)
     ept = batch // 50  # epochs (50-event groups) per tick
+    # per-tick blocking gives a true latency distribution; over the tunnel
+    # (~1.5s RPC per dispatch) the scanned-chunk mode is the only viable one
+    scan = platform != "cpu"
 
-    platform = jax.devices()[0].platform
-    detail.update(platform=platform, query=qname, batch_per_tick=batch,
-                  mode="compiled", events=0)
+    detail.update(query=qname, batch_per_tick=batch, events=0)
     cfg = GeneratorConfig(seed=1)
 
     def build(c):
@@ -219,12 +235,20 @@ def run_compiled(platform: str, detail: dict) -> float:
         p, a, b = device_gen.generate_tick(cfg, tick * ept, ept)
         return {hp: p, ha: a, hb: b}
 
-    ch = compile_circuit(handle, gen_fn=gen_fn)
-
     # round the measured run to whole validation intervals so the scanned
     # program compiles for exactly ONE chunk length
     ticks = max(total // batch // validate_every, 1) * validate_every
     run_len = warm_ticks + ticks
+    # pick the trace level count for THIS run length (short runs want a
+    # shallow ladder, long runs a deep one — see cnodes.levels_for_run);
+    # an explicit env override wins
+    from dbsp_tpu.compiled import cnodes
+
+    if "DBSP_TPU_TRACE_LEVELS" not in os.environ:
+        cnodes.TRACE_LEVELS = cnodes.levels_for_run(ticks)
+    detail["trace_levels"] = cnodes.TRACE_LEVELS
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
     # Warmup protocol tuned for tunnel-scale compile costs (~3 min per
     # program): validate every tick, and on the FIRST overflow jump monotone
     # capacities straight to their projected end-of-run size
@@ -232,7 +256,7 @@ def run_compiled(platform: str, detail: dict) -> float:
     t0 = _time.perf_counter()
 
     def warm_progress(next_tick):
-        _debug(f"warmup tick {next_tick - 1} done "
+        _debug(f"[{qname}] warmup tick {next_tick - 1} done "
                f"({_time.perf_counter() - t0:.1f}s)")
 
     # moderate projection during warmup: a big jump from tick-0 requirements
@@ -241,60 +265,120 @@ def run_compiled(platform: str, detail: dict) -> float:
     # ticks' calibrated requirements instead
     ch.run_ticks(0, warm_ticks, validate_every=1,
                  on_validated=warm_progress, project_ratio=4.0)
-    _debug(f"warmup ticks done at {_time.perf_counter() - t0:.1f}s; "
-           "presizing")
     # residual projection from the last warm tick's validated requirements
     ch.presize(run_len / warm_ticks)
+    # one post-presize tick so the measured run starts on a compiled program
+    ch.run_ticks(warm_ticks, 1, validate_every=1, project_ratio=4.0)
     detail["warmup_s"] = round(_time.perf_counter() - t0, 3)
-    _debug(f"warmup total {detail['warmup_s']}s (caps: "
+    _debug(f"[{qname}] warmup total {detail['warmup_s']}s (caps: "
            f"{ {cn.op.name: dict(cn.caps) for cn in ch.cnodes if cn.caps} })")
 
-    # Measured run: each validation interval is ONE scanned dispatch
-    # (lax.scan over the tick index) — per-tick dispatch overhead over the
-    # tunnel (~1.5s/launch) amortizes across the chunk, and requirements
-    # reduce on-device. The first chunk's compile counts toward elapsed
-    # (reported separately as scan_compile_s for visibility).
+    # Measured run. CPU: per-tick blocking (true latency distribution).
+    # TPU: each validation interval is ONE scanned dispatch (lax.scan over
+    # the tick index) — per-tick dispatch overhead over the tunnel amortizes
+    # across the chunk; the first chunk's compile counts toward elapsed
+    # (reported separately as scan_compile_s).
     ch.step_times_ns.clear()
     t0 = _time.perf_counter()
-    done = {"ticks": 0}
+    m0 = warm_ticks + 1
 
     def progress(next_tick):
-        done["ticks"] = next_tick - warm_ticks
-        detail.update(events=done["ticks"] * batch,
+        detail.update(events=(next_tick - m0) * batch,
                       elapsed_s=round(_time.perf_counter() - t0, 3))
-        _debug(f"measured through tick {next_tick - 1} "
+        _debug(f"[{qname}] measured through tick {next_tick - 1} "
                f"({detail['elapsed_s']}s, {detail['events']} events)")
 
-    ch.run_ticks(warm_ticks, ticks, validate_every=validate_every,
-                 on_validated=progress, block_each=True, scan=True,
+    ch.run_ticks(m0, ticks, validate_every=validate_every,
+                 on_validated=progress, block_each=True, scan=scan,
                  project_ratio=4.0)
     ch.block()
     elapsed = _time.perf_counter() - t0
     measured = ticks * batch
 
     eps = measured / elapsed
-    chunks = sorted(ch.step_times_ns)
-    if chunks:
+    samples = list(ch.step_times_ns)
+    if samples and scan:
         # first chunk carries the scan-program compile; report it apart and
         # exclude it from the steady-state latency stats when possible
-        detail["scan_compile_s"] = round(
-            (ch.step_times_ns[0] - chunks[0]) / 1e9, 2) \
-            if len(chunks) > 1 else 0.0
-        steady = sorted(ch.step_times_ns[1:]) or chunks
-        per_tick = [c / validate_every for c in steady]
+        csort = sorted(samples)
+        detail["scan_compile_s"] = round((samples[0] - csort[0]) / 1e9, 2) \
+            if len(samples) > 1 else 0.0
+        steady = samples[1:] or samples
+        per_tick = sorted(c / validate_every for c in steady)
+        gran = f"chunk/{validate_every}"
+        steady_ns = sum(steady)
+        steady_events = len(steady) * validate_every * batch
+    elif samples:
+        # overflow replays re-run ticks: extra samples carry real time but
+        # re-deliver the same events — count DISTINCT events over all time
+        per_tick = sorted(samples)
+        gran = "tick"
+        steady_ns = sum(samples)
+        steady_events = min(len(samples), ticks) * batch
+    if samples:
         detail.update(
             p50_tick_ms=round(per_tick[len(per_tick) // 2] / 1e6, 2),
             p99_tick_ms=round(
                 per_tick[min(len(per_tick) - 1,
                              int(len(per_tick) * 0.99))] / 1e6, 2),
-            latency_granularity=f"chunk/{validate_every}")
-        steady_eps = (len(steady) * validate_every * batch) \
-            / (sum(steady) / 1e9)
-        detail["steady_state_events_per_s"] = round(steady_eps, 1)
-    detail.update(elapsed_s=round(elapsed, 3), events=measured,
-                  ticks=ticks,
-                  replayed_chunks=len(ch.step_times_ns)
-                  - (ticks // validate_every))
+            latency_samples=len(per_tick),
+            latency_granularity=gran,
+            steady_state_events_per_s=round(steady_events
+                                            / (steady_ns / 1e9), 1))
+    expected = (ticks // validate_every + (1 if ticks % validate_every else 0)
+                ) if scan else ticks
+    detail.update(elapsed_s=round(elapsed, 3), events=measured, ticks=ticks,
+                  replayed_intervals=max(0, len(samples) - expected))
+    return eps
+
+
+def run_compiled(platform: str, detail: dict) -> float:
+    """Compiled-mode driver: measure every query in BENCH_QUERIES, headline
+    the BENCH_QUERY one (default q4). Queries that would overrun the time
+    budget are skipped and marked."""
+    import time as _time
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 1080))
+    started = _time.perf_counter()
+    qnames = [q.strip() for q in
+              os.environ.get("BENCH_QUERIES", "q3,q4,q8").split(",")
+              if q.strip()]
+    headline = os.environ.get("BENCH_QUERY", "q4")
+    if headline not in qnames:
+        qnames.insert(0, headline)
+    # measure the headline query FIRST so a budget overrun still reports it
+    qnames.sort(key=lambda q: q != headline)
+
+    detail.update(platform=platform, mode="compiled", queries={})
+    eps = 0.0
+    for qn in qnames:
+        left = budget - (_time.perf_counter() - started)
+        d: dict = {}
+        detail["queries"][qn] = d
+        if qn != headline and left < 180:
+            d["skipped"] = f"time budget ({left:.0f}s left)"
+            continue
+        try:
+            q_eps = _measure_compiled_query(qn, platform, d)
+            d["events_per_s"] = round(q_eps, 1)
+        except NotImplementedError as e:
+            if qn == headline:
+                raise  # headline falls back to host mode
+            d["compiled_fallback"] = str(e)[:160]
+        except _Deadline:
+            raise
+        except Exception as e:  # noqa: BLE001 — other queries still report
+            if qn == headline:
+                raise  # a broken headline must FAIL the bench, not emit 0.0
+            d["error"] = f"{type(e).__name__}: {e}"[:300]
+        if qn == headline:
+            eps = d.get("events_per_s", 0.0)
+            detail.update({k: v for k, v in d.items()
+                           if k != "queries"})  # headline fields top-level
+        jax.clear_caches()  # bound live executables between circuits
     return eps
 
 

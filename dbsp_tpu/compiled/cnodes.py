@@ -18,18 +18,34 @@ requirements via ``ctx.require(self, cap_key, device_scalar)``; the runner
 compares the running max of those scalars against the configured caps at
 validation points and grows + retraces on overflow.
 
-Trace states are LEVELED inside the program — the spine, compiled
-(reference: the fueled spine's amortization contract,
-``crates/dbsp/src/trace/spine_fueled.rs:1-81``). Each trace is a static
-tuple of K consolidated level batches in geometric capacity classes; a
-tick's delta rank-merges into level 0 (O(|L0|+|Δ|)), and a level that fills
-past half its capacity spills into the next via ``lax.cond`` — so a big
-merge touching the tail runs only every ~cap(K-2)/2 appended rows, and
-per-tick HBM traffic is O(Δ·levels) amortized instead of O(state). The
-spill decision is a device scalar: no host round-trip ever schedules a
-merge, which is what the reference's fuel bookkeeping exists to do.
-Consumers fan out over the K levels exactly like host operators fan out
-over ``spine.batches`` — the level kernels are shared.
+INPUT trace states (CTrace — the integrators consumers probe) are LEVELED
+inside the program — the spine, compiled (reference: the fueled spine's
+amortization contract, ``crates/dbsp/src/trace/spine_fueled.rs:1-81``).
+Each trace is a static tuple of K consolidated level batches in geometric
+capacity classes; a tick's delta rank-merges into level 0 (O(|L0|+|Δ|)),
+and a level that fills past half its capacity spills into the next via
+``lax.cond`` — so a big merge touching the tail runs only every
+~cap(K-2)/2 appended rows, and per-tick HBM traffic is O(Δ·levels)
+amortized instead of O(state). The spill decision is a device scalar: no
+host round-trip ever schedules a merge, which is what the reference's fuel
+bookkeeping exists to do.
+
+Two design rules keep leveling from costing more than it saves (measured
+on Nexmark q4, CPU backend — violating either regressed steady-state ~5x):
+
+  * Consumers combine their K per-level probe results into ONE shared
+    static buffer at running offsets (:func:`join_levels`,
+    :func:`gather_levels`) and consolidate ONCE — sort volume stays
+    O(out_cap), not O(K·out_cap), and the probes themselves are
+    delta-proportional binary searches, so fan-out over levels is cheap.
+  * OUTPUT traces (an aggregate's previous-outputs batch, a topk's, a
+    linear aggregate's accumulators) are NOT leveled: consolidated they
+    hold exactly one live row per key, so the old-value gather is an
+    exact q_cap expansion. Leveled, a key's current value smears into
+    un-netted insert/retract pairs across levels and the gather
+    requirement grows with the RUN (observed: 98k rows gathered per tick
+    for a 12.5k-event delta) — strictly worse than the single O(keys)
+    merge they pay per tick.
 """
 
 from __future__ import annotations
@@ -51,9 +67,29 @@ from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 # Level count K (including the tail) and the default capacity ratio between
 # adjacent levels. Level capacities self-scale to the observed delta size
 # through the requirement/grow machinery; these only seed the ladder.
+# Read at CTrace construction time (not import) so harnesses that know the
+# planned run length can pick K before compiling — see levels_for_run().
 TRACE_LEVELS = int(os.environ.get("DBSP_TPU_TRACE_LEVELS", "4"))
 LEVEL0_CAP = int(os.environ.get("DBSP_TPU_TRACE_L0", "1024"))
 LEVEL_GROWTH = int(os.environ.get("DBSP_TPU_TRACE_GROWTH", "8"))
+
+
+def levels_for_run(ticks: int) -> int:
+    """Level count that amortizes tail merges for a planned run length.
+
+    State ≈ ticks·Δ and L0 holds ~2 deltas, so with growth ratio g the tail
+    absorbs a spill every ~2·g^(K-2) ticks; K = 2 + log_g(ticks/8) keeps
+    that to a handful per run. Short runs (few large batches) get K=1-2 —
+    measured on Nexmark q4/CPU, a K too high for the run length loses
+    ~1.8x steady-state to spill overhead, and K too low loses ~1.8x to
+    O(state) re-merges (BENCH round-4 sweep: K=1 2831 ev/s, K=2 4342,
+    K=4 5231 at 96 ticks)."""
+    import math
+
+    if ticks <= 1:
+        return 1
+    extra = max(0.0, math.log(ticks / 8, LEVEL_GROWTH))
+    return max(1, min(4, 2 + math.ceil(extra)))
 
 
 class _Leveled:
@@ -121,6 +157,86 @@ class _Leveled:
         return tuple(
             b.with_cap(self.caps[k]) if b.cap != self.caps[k] else b
             for b, k in zip(levels, self.level_keys))
+
+
+def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
+    """Merge ``delta`` into a fixed-capacity SINGLE-batch trace.
+
+    Returns (new trace at the SAME capacity, required live rows). Live rows
+    pack to the front after a merge, so slicing back to the trace capacity
+    drops only dead tail — unless required > cap, which the runner detects.
+    This is the state layout for operator OUTPUT traces (one live row per
+    key; see module doc for why those must not be leveled)."""
+    merged = trace.merge_with(delta)
+    required = merged.live_count()
+    return merged.with_cap(trace.cap), required
+
+
+def join_levels(delta: Batch, levels: Sequence[Batch], nk: int, fn,
+                out_cap: int) -> Tuple[Batch, jnp.ndarray]:
+    """Join a delta against K trace levels into ONE shared out_cap buffer.
+
+    Each level's matches (packed at the front of its raw
+    :func:`~dbsp_tpu.operators.join._join_level_impl` output) scatter into
+    the shared buffer at the running offset, so downstream pays a single
+    out_cap-sized consolidation instead of sorting K padded buffers. The
+    returned requirement is the UNCLAMPED total across levels — when it
+    exceeds ``out_cap`` later levels' rows drop off the end and the runner's
+    validation grows the cap and replays."""
+    from dbsp_tpu.operators.join import _join_level_impl
+
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    bufs, wbuf = None, None
+    offset = jnp.asarray(0, jnp.int32)
+    req = jnp.asarray(0, jnp.int64)
+    for lvl in levels:
+        out, t = _join_level_impl(delta, lvl, nk, fn, out_cap)
+        req = req + t.astype(jnp.int64)
+        t32 = jnp.minimum(t, out_cap).astype(jnp.int32)
+        idx = jnp.where(j < t32, j + offset, out_cap)  # OOB slots drop
+        if bufs is None:
+            bufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
+                         for c in out.cols)
+            wbuf = jnp.zeros((out_cap,), out.weights.dtype)
+        bufs = tuple(b.at[idx].set(c, mode="drop")
+                     for b, c in zip(bufs, out.cols))
+        wbuf = wbuf.at[idx].set(jnp.where(j < t32, out.weights, 0),
+                                mode="drop")
+        offset = jnp.minimum(offset + t32, out_cap)
+    nko = len(out.keys)
+    return Batch(bufs[:nko], bufs[nko:], wbuf), req
+
+
+def gather_levels(qkeys, qlive, levels: Sequence[Batch], out_cap: int):
+    """Gather the query keys' rows from K trace levels into ONE shared
+    (qrow, vals, w) part of capacity ``out_cap`` (same offset-scatter scheme
+    as :func:`join_levels`). Dead slots carry qrow == q_cap + sentinel vals.
+    Returns (part, unclamped total). NOTE: with K > 1 the combined part may
+    hold cross-level insert/retract rows for the same (qrow, vals) — reducers
+    must net them (``_reduce_groups_impl(..., net=True)``)."""
+    from dbsp_tpu.operators.aggregate import _gather_level_impl
+
+    q_cap = qlive.shape[-1]
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    qbuf = jnp.full((out_cap,), jnp.int32(q_cap))
+    vbufs, wbuf = None, None
+    offset = jnp.asarray(0, jnp.int32)
+    req = jnp.asarray(0, jnp.int64)
+    for lvl in levels:
+        qrow, vals, w, t = _gather_level_impl(qkeys, qlive, lvl, out_cap)
+        req = req + t.astype(jnp.int64)
+        t32 = jnp.minimum(t, out_cap).astype(jnp.int32)
+        idx = jnp.where(j < t32, j + offset, out_cap)
+        if vbufs is None:
+            vbufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
+                          for c in vals)
+        qbuf = qbuf.at[idx].set(qrow, mode="drop")
+        vbufs = tuple(b.at[idx].set(c, mode="drop")
+                      for b, c in zip(vbufs, vals))
+        wbuf = (jnp.zeros((out_cap,), w.dtype) if wbuf is None else wbuf
+                ).at[idx].set(jnp.where(j < t32, w, 0), mode="drop")
+        offset = jnp.minimum(offset + t32, out_cap)
+    return (qbuf, vbufs, wbuf), req
 
 
 @dataclasses.dataclass
@@ -316,8 +432,6 @@ class CJoin(CNode):
         self.caps["right"] = 0
 
     def eval(self, ctx, state, inputs):
-        from dbsp_tpu.operators.join import _join_level_impl
-
         left, right = inputs
         nk = self.op._left_core.nk
         fn = self.op._left_core.fn
@@ -327,24 +441,20 @@ class CJoin(CNode):
         if not self.caps["right"]:
             self.caps["right"] = max(64, right.delta.cap)
         # ΔL joins every level of trace(R) post-append; ΔR every level of
-        # trace(L) pre-append — the out cap is shared across a side's levels
-        # (the requirement's running max sizes it to the worst level)
-        outs = []
-        for lvl in right.post:
-            lout, ltot = _join_level_impl(left.delta, lvl, nk, fn,
-                                          self.caps["left"])
-            ctx.require(self, "left", ltot)
-            outs.append(lout)
-        for lvl in left.pre:
-            rout, rtot = _join_level_impl(right.delta, lvl, nk, flipped,
-                                          self.caps["right"])
-            ctx.require(self, "right", rtot)
-            outs.append(rout)
-        out = concat_batches(outs).consolidate()
+        # trace(L) pre-append — each side's K level results land in ONE
+        # shared buffer (requirement = total across levels), so the final
+        # consolidate sorts 2 buffers regardless of K
+        lout, ltot = join_levels(left.delta, right.post, nk, fn,
+                                 self.caps["left"])
+        ctx.require(self, "left", ltot)
+        rout, rtot = join_levels(right.delta, left.pre, nk, flipped,
+                                 self.caps["right"])
+        ctx.require(self, "right", rtot)
+        out = concat_batches([lout, rout]).consolidate()
         return None, out
 
 
-class CAggregate(CNode, _Leveled):
+class CAggregate(CNode):
     """General incremental aggregate (Min/Max/Fold): gather touched groups
     from the input trace view, reduce, diff against own output trace.
 
@@ -366,14 +476,11 @@ class CAggregate(CNode, _Leveled):
     # gather grows too: touched groups' FULL histories come back from the
     # input trace, and hot groups accumulate rows over the run
     MONOTONE_CAPS = frozenset({"out_trace", "gather"})
-    TAIL_KEY = "out_trace"
 
     def __init__(self, node, op):
         super().__init__(node, op)
         self.caps["gather"] = 0
-        self.caps["old_gather"] = 0
         self.caps["out_trace"] = 0
-        self._init_level_caps()
         if getattr(op.agg, "insert_combinable", False):
             # the gather only serves retracted groups -> not monotone...
             self.MONOTONE_CAPS = frozenset({"out_trace"})
@@ -397,34 +504,30 @@ class CAggregate(CNode, _Leveled):
         if not self.caps["out_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
-        # a host-warmed spine has unknown retraction history — the fast
-        # path must assume the worst
-        return (self._levels_init(self.op.out_schema, lead, migrated),
-                jnp.full(lead, migrated is not None))
+        if migrated is not None:
+            # a host-warmed spine has unknown retraction history — the fast
+            # path must assume the worst
+            return (migrated.with_cap(self.caps["out_trace"]),
+                    jnp.full(lead, True))
+        return (Batch.empty(*self.op.out_schema, cap=self.caps["out_trace"],
+                            lead=lead),
+                jnp.full(lead, False))
 
     def repad_state(self, st):
-        levels, ever_neg = st
-        return (self._levels_repad(levels), ever_neg)
-
-    def _gather_parts(self, ctx, qkeys, mask, levels, cap_key):
-        from dbsp_tpu.operators.aggregate import _gather_level_impl
-
-        parts = []
-        for lvl in levels:
-            qrow, vals, w, total = _gather_level_impl(
-                qkeys, mask, lvl, self.caps[cap_key])
-            ctx.require(self, cap_key, total)
-            parts.append((qrow, vals, w))
-        return tuple(parts)
+        batch, ever_neg = st
+        if batch.cap != self.caps["out_trace"]:
+            batch = batch.with_cap(self.caps["out_trace"])
+        return (batch, ever_neg)
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.operators.aggregate import (_TupleMax,
                                                   _diff_outputs_impl,
+                                                  _gather_level_impl,
                                                   _reduce_groups_impl,
                                                   _unique_keys_impl)
 
         view: CView = inputs[0]
-        out_levels, ever_neg = state
+        out_trace, ever_neg = state
         agg = self.op.agg
         nk = len(self.op.key_dtypes)
         delta = view.delta
@@ -433,15 +536,13 @@ class CAggregate(CNode, _Leveled):
         fast = getattr(agg, "insert_combinable", False)
         if not self.caps["gather"]:
             self.caps["gather"] = 64 if fast else max(64, 2 * q_cap)
-        if not self.caps["old_gather"]:
-            # a key's current output may be spread as insert/retract rows
-            # over several out levels until a spill nets them
-            self.caps["old_gather"] = max(64, 2 * q_cap)
 
-        oparts = self._gather_parts(ctx, qkeys, qlive, out_levels,
-                                    "old_gather")
+        # own output trace holds exactly one live row per present key, so a
+        # q_cap-sized expansion always suffices
+        oqrow, ovals, ow, _ = _gather_level_impl(qkeys, qlive, out_trace,
+                                                 q_cap)
         old_vals, old_present = _reduce_groups_impl(
-            oparts, _TupleMax(len(agg.out_dtypes)), q_cap)
+            ((oqrow, ovals, ow),), _TupleMax(len(agg.out_dtypes)), q_cap)
 
         ever_neg = ever_neg | jnp.any(delta.weights < 0)
         if fast:
@@ -463,46 +564,49 @@ class CAggregate(CNode, _Leveled):
             # net-negative trace row — combine would be unsound); stays
             # empty (lo==hi) on append-only streams
             slow = qlive & jnp.broadcast_to(ever_neg, qlive.shape)
-            sparts = self._gather_parts(ctx, qkeys, slow, view.post,
-                                        "gather")
-            slow_vals, slow_present = _reduce_groups_impl(sparts, agg, q_cap)
+            spart, stot = gather_levels(qkeys, slow, view.post,
+                                        self.caps["gather"])
+            ctx.require(self, "gather", stot)
+            slow_vals, slow_present = _reduce_groups_impl(
+                (spart,), agg, q_cap, net=len(view.post) > 1)
             new_vals = tuple(jnp.where(slow, sv.astype(fv.dtype), fv)
                              for sv, fv in zip(slow_vals, fast_vals))
             new_present = jnp.where(slow, slow_present, fast_present)
         else:
-            parts = self._gather_parts(ctx, qkeys, qlive, view.post,
-                                       "gather")
-            new_vals, new_present = _reduce_groups_impl(parts, agg, q_cap)
+            part, tot = gather_levels(qkeys, qlive, view.post,
+                                      self.caps["gather"])
+            ctx.require(self, "gather", tot)
+            new_vals, new_present = _reduce_groups_impl(
+                (part,), agg, q_cap, net=len(view.post) > 1)
 
         cols, w = _diff_outputs_impl(qkeys, qlive, new_vals, new_present,
                                      old_vals, old_present)
         out = Batch(cols[:nk], cols[nk:], w)
-        state2 = self._levels_append(ctx, out_levels, out)
+        state2, required = static_append(out_trace, out)
+        ctx.require(self, "out_trace", required)
         return (state2, ever_neg), out
 
 
-class CLinearAggregate(CNode, _Leveled):
-    """Linear fast path: per-key accumulator state in a leveled trace."""
+class CLinearAggregate(CNode):
+    """Linear fast path: per-key accumulator state in a static trace batch
+    (one live row per key — NOT leveled, see module doc)."""
 
     MONOTONE_CAPS = frozenset({"acc_trace"})
-    TAIL_KEY = "acc_trace"
 
     def __init__(self, node, op):
         super().__init__(node, op)
         self.caps["acc_trace"] = 0
-        self.caps["acc_gather"] = 0
-        self._init_level_caps()
 
     def init_state(self):
         migrated = _migrate_spine(self.op.acc_spine)
         if not self.caps["acc_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["acc_trace"] = bucket_cap(max(live * 2, 1024))
-        return self._levels_init(self.op._state_schema,
-                                 getattr(self, "lead", ()), migrated)
-
-    def repad_state(self, st):
-        return self._levels_repad(st)
+        if migrated is not None:
+            return migrated.with_cap(self.caps["acc_trace"])
+        return Batch.empty(*self.op._state_schema,
+                           cap=self.caps["acc_trace"],
+                           lead=getattr(self, "lead", ()))
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.operators.aggregate import (_gather_level_impl,
@@ -518,64 +622,43 @@ class CLinearAggregate(CNode, _Leveled):
         q_cap = qlive.shape[-1]
         acc_delta, cnt_delta = _weigh_deltas_impl(delta, agg, nk)
 
-        if not self.caps["acc_gather"]:
-            # a key's accumulator may be spread as diff rows over several
-            # levels until a spill nets them (linearity makes the sum exact)
-            self.caps["acc_gather"] = max(64, 2 * q_cap)
-        parts = []
-        for lvl in state:
-            qrow, vals, w, total = _gather_level_impl(
-                qkeys, qlive, lvl, self.caps["acc_gather"])
-            ctx.require(self, "acc_gather", total)
-            parts.append((qrow, vals, w))
-        old = _net_state_impl(tuple(parts), q_cap)
+        # the consolidated accumulator trace holds one live row per key, so
+        # a q_cap expansion is exact — no requirement check needed
+        qrow, vals, w, _ = _gather_level_impl(qkeys, qlive, state, q_cap)
+        old = _net_state_impl(((qrow, vals, w),), q_cap)
         out, sdiff = _combine_diff_impl(qkeys, qlive, tuple(acc_delta),
                                         cnt_delta, *old, agg, nk)
-        state2 = self._levels_append(ctx, state, sdiff)
+        state2, required = static_append(state, sdiff)
+        ctx.require(self, "acc_trace", required)
         return state2, out
 
 
-class CTopK(CNode, _Leveled):
+class CTopK(CNode):
     """Incremental per-key top-K (operators/topk.py): recompute touched
     groups' top-K from the input trace view, diff against the previous
-    output kept in a leveled out trace. Both gathers fan out over levels
-    and combine with :func:`concat_parts` exactly like the host op."""
+    output kept in a static out trace (k live rows per key — NOT leveled,
+    see module doc; the old gather is exact at k*q_cap)."""
 
     MONOTONE_CAPS = frozenset({"out_trace", "gather"})
-    TAIL_KEY = "out_trace"
 
     def __init__(self, node, op):
         super().__init__(node, op)
         self.caps["gather"] = 0
-        self.caps["old_gather"] = 0
         self.caps["out_trace"] = 0
-        self._init_level_caps()
 
     def init_state(self):
         migrated = _migrate_spine(self.op.out_spine)
         if not self.caps["out_trace"]:
             live = 0 if migrated is None else int(migrated.max_worker_live())
             self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
-        return self._levels_init(self.op.schema, getattr(self, "lead", ()),
-                                 migrated)
-
-    def repad_state(self, st):
-        return self._levels_repad(st)
-
-    def _gathered(self, ctx, qkeys, qlive, levels, cap_key):
-        from dbsp_tpu.operators.aggregate import (_gather_level_impl,
-                                                  concat_parts)
-
-        parts = []
-        for lvl in levels:
-            qrow, vals, w, total = _gather_level_impl(
-                qkeys, qlive, lvl, self.caps[cap_key])
-            ctx.require(self, cap_key, total)
-            parts.append((qrow, vals, w))
-        return concat_parts(parts)
+        if migrated is not None:
+            return migrated.with_cap(self.caps["out_trace"])
+        return Batch.empty(*self.op.schema, cap=self.caps["out_trace"],
+                           lead=getattr(self, "lead", ()))
 
     def eval(self, ctx, state, inputs):
-        from dbsp_tpu.operators.aggregate import _unique_keys_impl
+        from dbsp_tpu.operators.aggregate import (_gather_level_impl,
+                                                  _unique_keys_impl)
         from dbsp_tpu.operators.topk import _topk_rows
 
         view: CView = inputs[0]
@@ -585,17 +668,18 @@ class CTopK(CNode, _Leveled):
         q_cap = qlive.shape[-1]
         if not self.caps["gather"]:
             self.caps["gather"] = max(64, 2 * q_cap)
-        if not self.caps["old_gather"]:
-            self.caps["old_gather"] = max(64, 2 * q_cap)
 
-        g = self._gathered(ctx, qkeys, qlive, view.post, "gather")
+        g, gtot = gather_levels(qkeys, qlive, view.post, self.caps["gather"])
+        ctx.require(self, "gather", gtot)
         new_part = _topk_rows(g[0], qkeys, g[1], g[2], self.op.k,
                               self.op.largest, 1, q_cap)
-        o = self._gathered(ctx, qkeys, qlive, state, "old_gather")
+        # the consolidated out trace holds <= k live rows per key: exact cap
+        o = _gather_level_impl(qkeys, qlive, state, self.op.k * q_cap)[:3]
         old_part = _topk_rows(o[0], qkeys, o[1], o[2], self.op.k,
                               self.op.largest, -1, q_cap)
         out = concat_batches([new_part, old_part]).consolidate()
-        state2 = self._levels_append(ctx, state, out)
+        state2, required = static_append(state, out)
+        ctx.require(self, "out_trace", required)
         return state2, out
 
 
@@ -648,14 +732,18 @@ def truncate_below(batch: Batch, bound) -> Batch:
 class CWatermark(CNode):
     """``watermark_monotonic`` (watermark.rs:33): running max of a live
     timestamp column minus lateness, as device scalars — state is
-    (wm, valid) instead of the host path's ``None``-able Python int."""
+    (wm, valid) instead of the host path's ``None``-able Python int.
+
+    Sharded: the watermark is a GLOBAL property of the stream — each
+    worker's local max combines across the mesh with one ``lax.pmax``
+    (the reference computes it on the unsharded stream; a collective is
+    the SPMD equivalent), so every worker carries the same (wm, valid)
+    and downstream window bounds agree everywhere."""
 
     def init_state(self):
-        if getattr(self, "lead", ()):
-            raise NotImplementedError(
-                "watermark: sharded compiled circuits not supported yet "
-                "(window traces are not shard-lifted on the host path either)")
-        return (jnp.asarray(_WM_FLOOR, jnp.int64), jnp.asarray(False))
+        lead = getattr(self, "lead", ())
+        return (jnp.full(lead, _WM_FLOOR, jnp.int64),
+                jnp.full(lead, False))
 
     def eval(self, ctx, state, inputs):
         batch = inputs[0]
@@ -663,6 +751,14 @@ class CWatermark(CNode):
         live = batch.weights != 0
         m = jnp.max(jnp.where(live, ts, _WM_FLOOR))
         any_live = jnp.any(live)
+        if getattr(self, "lead", ()):
+            from jax import lax
+
+            from dbsp_tpu.parallel.mesh import WORKER_AXIS
+
+            m = lax.pmax(m, WORKER_AXIS)
+            any_live = lax.pmax(any_live.astype(jnp.int32),
+                                WORKER_AXIS) > 0
         wm0, valid0 = state
         wm1 = jnp.where(any_live,
                         jnp.maximum(wm0, m - self.op.lateness), wm0)
@@ -700,12 +796,12 @@ class CWindow(CNode):
         self.caps["slide_in"] = 0
 
     def init_state(self):
-        if getattr(self, "lead", ()):
-            raise NotImplementedError(
-                "window: sharded compiled circuits not supported yet")
-        # (a0, b0, had_bounds)
-        return (jnp.asarray(0, jnp.int64), jnp.asarray(0, jnp.int64),
-                jnp.asarray(False))
+        # (a0, b0, had_bounds) — per worker under a mesh (the bounds stream
+        # is globally consistent, see CWatermark, so the slices agree; each
+        # worker windows its own key-hash slice and the union is exact)
+        lead = getattr(self, "lead", ())
+        return (jnp.full(lead, 0, jnp.int64), jnp.full(lead, 0, jnp.int64),
+                jnp.full(lead, False))
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.timeseries.window import _filter_window, _slice_range

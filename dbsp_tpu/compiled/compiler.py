@@ -268,15 +268,27 @@ class CompiledHandle:
         single-tick dispatch still costs ~1.5s of RPC overhead; scanning
         amortizes it to ~1.5s/n. Requirements reduce to a running max across
         iterations; outputs are the LAST tick's (carried, not stacked — no
-        n-times memory blowup). gen_fn mode only (feeds are host values)."""
-        assert self._gen_fn is not None, "scan mode needs a gen_fn"
-        assert self.mesh is None, "scan mode is single-worker for now"
+        n-times memory blowup). gen_fn mode only (feeds are host values).
 
-        def scan_fn(states, t0):
+        Sharded circuits scan INSIDE the shard_map: the whole n-tick loop is
+        one SPMD program whose collectives (exchange/gather/pmax) run per
+        iteration — N ticks per dispatch at any worker count."""
+        assert self._gen_fn is not None, "scan mode needs a gen_fn"
+
+        def _scan_body(states, t0, varying=False):
             outs_shape = jax.eval_shape(
                 lambda s, t: self._run_nodes(s, t, {})[1], states, t0)
             init_outs = jax.tree_util.tree_map(
                 lambda sh: jnp.zeros(sh.shape, sh.dtype), outs_shape)
+            if varying:
+                # inside shard_map the per-tick outputs are worker-varying;
+                # the zero init must carry the same vma type or the scan
+                # carry types mismatch
+                from dbsp_tpu.parallel.mesh import WORKER_AXIS
+
+                init_outs = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pcast(a, (WORKER_AXIS,), to="varying"),
+                    init_outs)
 
             def body(carry, i):
                 st, _ = carry
@@ -290,6 +302,31 @@ class CompiledHandle:
             req = (jnp.max(reqs, axis=0) if reqs.shape[1]
                    else jnp.zeros((0,), jnp.int64))
             return ns, outs, req
+
+        if self.mesh is None:
+            return jax.jit(_scan_body)
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from dbsp_tpu.parallel.mesh import WORKER_AXIS
+
+        W = P(WORKER_AXIS)
+
+        def scan_fn(states, t0):
+            def body(states_l, t0_l):
+                squeeze = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a: a[0], t)
+                expand = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a: a[None], t)
+                ns, outs, req = _scan_body(squeeze(states_l), t0_l,
+                                           varying=True)
+                return expand(ns), expand(outs), req[None]
+
+            ns, outs, reqw = shard_map(
+                body, mesh=self.mesh, in_specs=(W, P()),
+                out_specs=(W, W, W))(states, t0)
+            return ns, outs, jnp.max(reqw, axis=0)
 
         return jax.jit(scan_fn)
 
@@ -477,8 +514,8 @@ def compile_circuit(handle, gen_fn: Optional[Callable] = None
     from dbsp_tpu.circuit.runtime import Runtime
 
     rt = getattr(handle, "runtime", None)
-    prev, Runtime._current = Runtime._current, rt
+    prev = Runtime._swap(rt)
     try:
         return CompiledHandle(handle.circuit, gen_fn=gen_fn, runtime=rt)
     finally:
-        Runtime._current = prev
+        Runtime._swap(prev)

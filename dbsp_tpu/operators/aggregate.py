@@ -306,15 +306,23 @@ def concat_parts(parts):
     return qrow, vals, w
 
 
-def _reduce_groups_impl(parts, agg: Aggregator, q_cap: int):
+def _reduce_groups_impl(parts, agg: Aggregator, q_cap: int,
+                        net: bool | None = None):
     """Net out cross-level duplicates (each part is sorted by (qrow, vals)
     — see :func:`_gather_level`), then run the aggregator per q segment.
 
     One gathered level needs no netting (its rows are unique); multiple
     levels combine with one sort-consolidation on CPU or a fold of
-    rank-merges on TPU (kernels.merge_strategy)."""
+    rank-merges on TPU (kernels.merge_strategy). ``net=True`` forces the
+    consolidation for a SINGLE part that was itself combined from several
+    levels (compiled ``gather_levels``) and so may carry cross-level
+    insert/retract rows for one (qrow, vals)."""
     (qrow, val_cols, w), *rest = parts
     cols = (qrow, *val_cols)
+    if not rest and net:
+        cols, w = kernels.consolidate_cols(cols, w)
+        qrow, val_cols = cols[0], cols[1:]
+        cols = (qrow, *val_cols)
     if rest and kernels.merge_strategy() == "sort":
         all_cols = tuple(
             jnp.concatenate([p[i] if i == 0 else p[1][i - 1]
@@ -336,7 +344,7 @@ def _reduce_groups_impl(parts, agg: Aggregator, q_cap: int):
 
 
 _reduce_groups_jit = jax.jit(_reduce_groups_impl,
-                             static_argnames=("agg", "q_cap"))
+                             static_argnames=("agg", "q_cap", "net"))
 
 
 def _reduce_groups_factory(agg: Aggregator, q_cap: int):
@@ -478,10 +486,18 @@ def aggregate(self: Stream, agg, name=None) -> Stream:
 
     schema = getattr(self, "schema", None)
     assert schema is not None, "aggregate needs stream schema metadata"
-    assert not getattr(self.circuit, "nested_incremental", False), (
-        "aggregates inside an incremental recursive() child are not "
-        "supported yet — restructure so aggregation happens outside the "
-        "fixedpoint, or use an iterate()-style subcircuit (reset-per-epoch)")
+    if getattr(self.circuit, "nested_incremental", False):
+        # inside a recursive() child: aggregate over the (epoch, iteration)
+        # product lattice (reference: aggregate/mod.rs:204,410 is generic
+        # over Timestamp incl. NestedTimestamp32). All aggregator kinds go
+        # through the four-corner path — the linear fast path's
+        # delta-only accumulators are not 2-d-incremental.
+        from dbsp_tpu.operators.nested_ops import NestedAggregateOp
+
+        out = self.circuit.add_unary_operator(
+            NestedAggregateOp(agg, schema, self.circuit, name), self)
+        out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+        return out
     if isinstance(agg, LinearAggregator):
         src = self.shard()  # co-locate keys (no-op on one worker)
         out = src.circuit.add_unary_operator(

@@ -38,9 +38,8 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, concat_batches
 
 
-@partial(jax.jit, static_argnames=("k", "largest", "weight_sign", "q_cap"))
-def _topk_rows(qrow, qkeys, val_cols, w, k: int, largest: bool,
-               weight_sign: int, q_cap: int) -> Batch:
+def _topk_rows_impl(qrow, qkeys, val_cols, w, k: int, largest: bool,
+                    weight_sign: int, q_cap: int) -> Batch:
     """Select the top-K present rows per q segment; emit with ±1 weights.
 
     Segment ids are query-slot indices in [0, q_cap) — sized by q_cap (like
@@ -74,6 +73,27 @@ def _topk_rows(qrow, qkeys, val_cols, w, k: int, largest: bool,
     return Batch(out_cols[:nk], out_cols[nk:], out_w)
 
 
+_topk_rows_jit = jax.jit(_topk_rows_impl,
+                         static_argnames=("k", "largest", "weight_sign",
+                                          "q_cap"))
+
+
+def _topk_rows_factory(k: int, largest: bool, weight_sign: int, q_cap: int):
+    return lambda qrow, qkeys, val_cols, w: _topk_rows_impl(
+        qrow, qkeys, val_cols, w, k, largest, weight_sign, q_cap)
+
+
+def _topk_rows(qrow, qkeys, val_cols, w, k, largest, weight_sign, q_cap):
+    """Dispatch: per-worker under the mesh when the parts are sharded."""
+    if w.ndim > 1:
+        from dbsp_tpu.parallel.lift import lifted
+
+        return lifted(_topk_rows_factory, k, largest, weight_sign, q_cap)(
+            qrow, qkeys, val_cols, w)
+    return _topk_rows_jit(qrow, qkeys, val_cols, w, k, largest, weight_sign,
+                          q_cap)
+
+
 class TopKOp(UnaryOperator):
     def __init__(self, k: int, schema, largest: bool = True, name=None):
         self.k = k
@@ -92,7 +112,8 @@ class TopKOp(UnaryOperator):
         delta = view.delta
         nk = len(self.schema[0])
         if int(delta.live_count()) == 0:
-            return Batch.empty(*self.schema)
+            return Batch.empty(*self.schema,
+                               lead=tuple(delta.weights.shape[:-1]))
         qkeys, qlive = _unique_keys(delta, nk)
         q_cap = qlive.shape[-1]  # trimmed to distinct-key bucket
         parts = []
@@ -127,8 +148,12 @@ def topk(self: Stream, k: int, largest: bool = True, name=None) -> Stream:
     """Top-K rows per key, ordered by the value columns (see module doc)."""
     schema = getattr(self, "schema", None)
     assert schema is not None, "topk needs stream schema metadata"
-    t = self.trace(shard=False)  # not yet shard-lifted
+    # sharded streams stay sharded: rows are key-hash distributed, so every
+    # group lives wholly on one worker and per-worker top-K unions exactly
+    # (the reference's window-function path self-shards the same way)
+    t = self.trace()
     out = self.circuit.add_unary_operator(
         TopKOp(k, (tuple(schema[0]), tuple(schema[1])), largest, name), t)
     out.schema = schema
+    out.key_sharded = getattr(t, "key_sharded", False)
     return out

@@ -36,8 +36,7 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _slice_range(level: Batch, a, b, out_cap: int):
+def _slice_range_impl(level: Batch, a, b, out_cap: int):
     """Rows of a consolidated level with first-key in [a, b); masked slice."""
     k0 = level.keys[0]
     a = jnp.asarray(a, k0.dtype)
@@ -56,8 +55,14 @@ def _slice_range(level: Batch, a, b, out_cap: int):
     return Batch(cols[:nk], cols[nk:], w), total
 
 
-@jax.jit
-def _filter_window(batch: Batch, a, b) -> Batch:
+_slice_range = jax.jit(_slice_range_impl, static_argnames=("out_cap",))
+
+
+def _slice_range_factory(out_cap: int):
+    return lambda level, a, b: _slice_range_impl(level, a, b, out_cap)
+
+
+def _filter_window_impl(batch: Batch, a, b) -> Batch:
     k0 = batch.keys[0]
     keep = (batch.weights != 0) & (k0 >= jnp.asarray(a, k0.dtype)) & \
         (k0 < jnp.asarray(b, k0.dtype))
@@ -66,22 +71,46 @@ def _filter_window(batch: Batch, a, b) -> Batch:
     return Batch(cols[:nk], cols[nk:], w)
 
 
+_filter_window = jax.jit(_filter_window_impl)
+
+
+def _filter_window_factory():
+    return _filter_window_impl
+
+
 class RangeExtract:
-    """Grow-on-demand host driver for [a, b) slices across spine levels."""
+    """Grow-on-demand host driver for [a, b) slices across spine levels.
+    Sharded levels slice per worker (the bounds are global scalars); the
+    capacity check takes the worst worker."""
 
     def __init__(self):
         self.caps: Dict[int, int] = {}
 
+    @staticmethod
+    def _launch(level, a, b, cap):
+        if level.sharded:
+            from dbsp_tpu.parallel.lift import lifted
+
+            # scalars ride the worker axis as [W] broadcasts (spmd shards
+            # every argument; the per-worker body squeezes them back)
+            w = level.weights.shape[0]
+            return lifted(_slice_range_factory, cap)(
+                level, jnp.full((w,), a, jnp.int64),
+                jnp.full((w,), b, jnp.int64))
+        return _slice_range(level, a, b, cap)
+
     def __call__(self, levels, a, b) -> List[Batch]:
+        import numpy as np
+
         outs = []
         for level in levels:
             cap = self.caps.get(level.cap, 64)
-            out, total = _slice_range(level, a, b, cap)
-            t = int(total)
+            out, total = self._launch(level, a, b, cap)
+            t = int(np.max(jax.device_get(total)))
             if t > cap:
                 cap = bucket_cap(t)
                 self.caps[level.cap] = cap
-                out, _ = _slice_range(level, a, b, cap)
+                out, _ = self._launch(level, a, b, cap)
             outs.append(out)
         return outs
 
@@ -100,14 +129,23 @@ class WindowOp(BinaryOperator):
 
     def eval(self, view: TraceView, bounds) -> Batch:
         if bounds is None:
-            return Batch.empty(*self.schema)
+            return Batch.empty(*self.schema,
+                               lead=tuple(view.delta.weights.shape[:-1]))
         a1, b1 = bounds
         a0, b0 = self.prev if self.prev is not None else (a1, a1)
         assert a1 >= a0 and b1 >= b0, (
             f"window bounds must be monotone: {(a0, b0)} -> {(a1, b1)}")
         self.prev = (a1, b1)
 
-        parts = [_filter_window(view.delta, a1, b1)]
+        if view.delta.sharded:
+            from dbsp_tpu.parallel.lift import lifted
+
+            w = view.delta.weights.shape[0]
+            parts = [lifted(_filter_window_factory)(
+                view.delta, jnp.full((w,), a1, jnp.int64),
+                jnp.full((w,), b1, jnp.int64))]
+        else:
+            parts = [_filter_window(view.delta, a1, b1)]
         parts += [b.neg() for b in
                   self._extract(view.pre_levels, a0, min(a1, b0))]
         parts += self._extract(view.pre_levels, max(b0, a1), b1)
@@ -133,10 +171,16 @@ def window(self: Stream, bounds: Stream, gc: bool = False) -> Stream:
     ``gc=True`` reclaims trace state below the lower bound; enable only when
     this window is the sole consumer of the stream's trace (shared traces use
     the tightest common bound — reference TraceBounds semantics — which the
-    host driver must coordinate)."""
+    host driver must coordinate).
+
+    Sharded streams stay sharded (the reference's window self-shards its
+    trace the same way, time_series/mod.rs): bounds are global scalars, each
+    worker slices its own key range, and the union of per-worker slices IS
+    the window of the union."""
     schema = getattr(self, "schema", None)
     assert schema is not None, "window needs stream schema metadata"
-    t = self.trace(shard=False)  # not yet shard-lifted
+    t = self.trace()
     out = self.circuit.add_binary_operator(WindowOp(schema, gc), t, bounds)
     out.schema = schema
+    out.key_sharded = getattr(t, "key_sharded", False)
     return out

@@ -303,6 +303,63 @@ def test_compiled_q6_matches_host():
     assert comp == host
 
 
+def _sharded_run(build, workers, ticks=TICKS):
+    handle, (handles, out) = Runtime.init_circuit(workers, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(CFG, tick * EPT, EPT)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    outs = {}
+
+    def capture(next_tick):
+        b = ch.output(out)
+        outs[next_tick - 1] = b.to_dict() if b is not None else {}
+
+    ch.run_ticks(0, ticks, validate_every=1, on_validated=capture)
+    return [outs[t] for t in range(ticks)], ch
+
+
+@pytest.mark.parametrize("build,qname", [(_q5_build, "q5"),
+                                         (_q7_build, "q7"),
+                                         (_q9_build, "q9")])
+def test_compiled_sharded_timeseries_topk(build, qname):
+    """Shard-lifted watermark/window/topk under the compiled SPMD step:
+    8 workers == 1 worker tick for tick, with NO unshard round-trip inside
+    the circuit (the reference's every-stateful-op-self-shards contract,
+    join.rs:268-270, time_series/rolling_aggregate.rs:235). The watermark
+    rides a pmax collective; windows slice per-worker key ranges."""
+    from dbsp_tpu.operators.shard_op import UnshardOp
+
+    single, _ = _sharded_run(build, 1)
+    sharded, ch = _sharded_run(build, 8)
+    assert sharded == single
+    # the only unshard is the output boundary (outputs collapse to one
+    # batch); stateful operators must consume SHARDED traces
+    unshards = [cn for cn in ch.cnodes if isinstance(cn.op, UnshardOp)]
+    assert len(unshards) <= 1, [cn.op.name for cn in unshards]
+
+
+def test_compiled_sharded_scan_mode():
+    """Multi-worker scan: N ticks per dispatch with the lax.scan INSIDE the
+    shard_map (collectives per iteration); equals per-tick stepping."""
+    per_tick, _ = _sharded_run(_q4_build, 8, ticks=4)
+
+    handle, (handles, out) = Runtime.init_circuit(8, _q4_build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(CFG, tick * EPT, EPT)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    ch.run_ticks(0, 4, validate_every=2, scan=True)
+    b = ch.output(out)
+    assert (b.to_dict() if b is not None else {}) == per_tick[-1]
+
+
 def test_compiled_leveled_trace_spills_match_host(monkeypatch):
     """The in-program spine under stress: tiny level capacities force the
     half-full spill cascade (lax.cond merges) to fire at every level many
