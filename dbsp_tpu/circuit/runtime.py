@@ -18,6 +18,7 @@ deliberately:
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -28,10 +29,21 @@ class RuntimeError_(RuntimeError):
     pass
 
 
-class Runtime:
-    """Execution context: worker (mesh) configuration for a circuit."""
+class _CurrentRuntime(threading.local):
+    rt: Optional["Runtime"] = None
 
-    _current: Optional["Runtime"] = None
+
+class Runtime:
+    """Execution context: worker (mesh) configuration for a circuit.
+
+    The ambient "current runtime" is THREAD-LOCAL: circuit builds and steps
+    happen concurrently on manager handler threads, controller flush
+    threads, and the compiler service's queue worker — a process-global
+    slot would let one thread's save/restore clobber another's mid-build
+    (a multi-worker circuit would then silently build with worker_count()
+    == 1 and no sharding)."""
+
+    _tls = _CurrentRuntime()
 
     def __init__(self, workers: int = 1, mesh=None):
         from dbsp_tpu.parallel.mesh import make_mesh
@@ -42,11 +54,18 @@ class Runtime:
 
     @staticmethod
     def current() -> Optional["Runtime"]:
-        return Runtime._current
+        return Runtime._tls.rt
+
+    @staticmethod
+    def _swap(rt: Optional["Runtime"]) -> Optional["Runtime"]:
+        """Install ``rt`` as this THREAD's current runtime; returns the
+        previous one for the caller's finally-restore."""
+        prev, Runtime._tls.rt = Runtime._tls.rt, rt
+        return prev
 
     @staticmethod
     def worker_count() -> int:
-        rt = Runtime._current
+        rt = Runtime._tls.rt
         return rt.workers if rt is not None else 1
 
     @staticmethod
@@ -56,11 +75,11 @@ class Runtime:
         """Build a circuit configured for ``workers`` SPMD workers and return
         a stepping handle plus the constructor's result (the I/O handles)."""
         runtime = Runtime(workers)
-        prev, Runtime._current = Runtime._current, runtime
+        prev = Runtime._swap(runtime)
         try:
             circuit, result = RootCircuit.build(constructor)
         finally:
-            Runtime._current = prev
+            Runtime._swap(prev)
         return CircuitHandle(circuit, runtime), result
 
 
@@ -78,10 +97,10 @@ class CircuitHandle:
         self.step_times_ns: list[int] = []
 
     def step(self) -> None:
-        prev, Runtime._current = Runtime._current, self.runtime
+        prev = Runtime._swap(self.runtime)
         t0 = time.perf_counter_ns()
         try:
             self.circuit.step()
         finally:
-            Runtime._current = prev
+            Runtime._swap(prev)
         self.step_times_ns.append(time.perf_counter_ns() - t0)

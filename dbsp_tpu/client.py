@@ -104,14 +104,43 @@ class Connection:
         self.host = host
         self.base = f"http://{host}:{port}"
 
-    def create_program(self, name: str, tables: dict, sql: Dict[str, str]
-                       ) -> None:
-        _req(self.base + "/programs",
-             data=json.dumps({"name": name, "tables": tables,
-                              "sql": sql}).encode(), method="POST")
+    def create_program(self, name: str, tables: dict, sql: Dict[str, str],
+                       description: str = "") -> dict:
+        """Create (or update — the manager bumps the version when the code
+        changed). Returns the program descriptor (version/status)."""
+        return _req(self.base + "/programs",
+                    data=json.dumps({"name": name, "tables": tables,
+                                     "sql": sql,
+                                     "description": description}).encode(),
+                    method="POST")
+
+    def update_program(self, name: str, tables: dict, sql: Dict[str, str],
+                       description: str = "") -> dict:
+        return _req(f"{self.base}/programs/{name}",
+                    data=json.dumps({"tables": tables, "sql": sql,
+                                     "description": description}).encode(),
+                    method="POST")
 
     def programs(self) -> List[str]:
         return _req(self.base + "/programs")
+
+    def program(self, name: str) -> dict:
+        """Full descriptor: {name, version, status, error, description}."""
+        return _req(f"{self.base}/programs/{name}")
+
+    def compile_program(self, name: str, version: Optional[int] = None
+                        ) -> dict:
+        """Enqueue a compile of ``version`` (409 -> RuntimeError if stale);
+        poll :meth:`program` for the status to reach success/sql_error."""
+        body = {} if version is None else {"version": version}
+        return _req(f"{self.base}/programs/{name}/compile",
+                    data=json.dumps(body).encode(), method="POST")
+
+    def delete_program(self, name: str) -> None:
+        _req(f"{self.base}/programs/{name}", method="DELETE")
+
+    def delete_pipeline(self, name: str) -> None:
+        _req(f"{self.base}/pipelines/{name}", method="DELETE")
 
     def start_pipeline(self, name: str, program: str) -> PipelineHandle:
         desc = _req(self.base + "/pipelines",

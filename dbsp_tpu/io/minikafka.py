@@ -1,0 +1,196 @@
+"""A minimal in-repo message broker + client for exercising the Kafka
+transports without a Kafka installation.
+
+The environment bakes no Kafka client library or broker, which would leave
+``KafkaInputTransport``/``KafkaOutputTransport`` permanently unexecuted
+(reference CI runs them against a real broker —
+``adapters/src/test/kafka.rs:23-31``). This module provides the smallest
+thing that makes the transport code REAL: a TCP broker with topics,
+offsets, and consumer groups, plus a client exposing the exact call surface
+the transports use (``MiniConsumer.poll/close``, ``MiniProducer.send/
+flush``). Transports select it with a ``mini://host:port`` broker address;
+real ``confluent_kafka`` / ``kafka-python`` addresses are untouched.
+
+Protocol: newline-delimited JSON over TCP, payloads base64. One
+request/response per line:
+    {"op": "produce", "topic": t, "msgs": [b64, ...]}      -> {"ok": true}
+    {"op": "fetch", "topic": t, "group": g, "max": n}      -> {"msgs": [...]}
+Offsets advance on fetch (at-most-once per group — matching the transport's
+auto-commit usage, not the full Kafka contract).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Tuple
+
+
+class MiniKafkaBroker:
+    """Line-JSON TCP broker: topics are append-only lists of byte messages;
+    each (topic, group) pair holds a read offset."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.topics: Dict[str, List[bytes]] = {}
+        self.offsets: Dict[Tuple[str, str], int] = {}
+        self.lock = threading.Lock()
+        broker = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                        resp = broker._handle(req)
+                    except Exception as e:  # noqa: BLE001 — report + serve
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server((host, port), Handler)
+        self.host, self.port = self.server.server_address
+        self.address = f"mini://{self.host}:{self.port}"
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="minikafka")
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        with self.lock:
+            if op == "produce":
+                log = self.topics.setdefault(req["topic"], [])
+                for m in req["msgs"]:
+                    log.append(base64.b64decode(m))
+                return {"ok": True, "end_offset": len(log)}
+            if op == "fetch":
+                log = self.topics.get(req["topic"], [])
+                key = (req["topic"], req.get("group", ""))
+                at = self.offsets.get(key, 0)
+                upto = min(len(log), at + int(req.get("max", 100)))
+                msgs = [base64.b64encode(m).decode() for m in log[at:upto]]
+                self.offsets[key] = upto
+                return {"msgs": msgs, "offset": upto}
+            return {"error": f"unknown op {op!r}"}
+
+    def start(self) -> "MiniKafkaBroker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class _Conn:
+    """One line-JSON request/response TCP connection."""
+
+    def __init__(self, address: str):
+        assert address.startswith("mini://"), address
+        host, port = address[len("mini://"):].rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=10)
+        self.rfile = self.sock.makefile("rb")
+        self.lock = threading.Lock()
+
+    def request(self, req: dict) -> dict:
+        with self.lock:
+            self.sock.sendall(json.dumps(req).encode() + b"\n")
+            line = self.rfile.readline()
+        if not line:
+            raise ConnectionError("minikafka broker closed the connection")
+        resp = json.loads(line)
+        if resp.get("error"):
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Record:
+    """Matches the attribute the transports read (kafka-python's record)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: bytes):
+        self.value = value
+
+
+class MiniConsumer:
+    """kafka-python-shaped consumer over the mini protocol."""
+
+    def __init__(self, *topics: str, bootstrap_servers: str = "",
+                 group_id: str = "dbsp_tpu", **_ignored):
+        self.topics = list(topics)
+        self.group = group_id
+        self.conn = _Conn(bootstrap_servers)
+
+    def poll(self, timeout_ms: int = 500, max_records: int = 500) -> dict:
+        """Fetch once per topic; when everything is empty, block up to
+        ``timeout_ms`` like kafka-python does — the transport's poll loop
+        has no sleep of its own and would otherwise busy-spin a core
+        against the broker."""
+        import time
+
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            out = {}
+            for t in self.topics:
+                resp = self.conn.request({"op": "fetch", "topic": t,
+                                          "group": self.group,
+                                          "max": max_records})
+                if resp["msgs"]:
+                    out[t] = [_Record(base64.b64decode(m))
+                              for m in resp["msgs"]]
+            if out or time.monotonic() >= deadline:
+                return out
+            time.sleep(min(0.02, timeout_ms / 1000.0))
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+# the names KafkaInputTransport/KafkaOutputTransport construct for the
+# "kafka-python" client kind
+KafkaConsumer = MiniConsumer
+
+
+class MiniProducer:
+    """kafka-python-shaped producer over the mini protocol."""
+
+    def __init__(self, bootstrap_servers: str = "", **_ignored):
+        self.conn = _Conn(bootstrap_servers)
+        self._pending: List[Tuple[str, bytes]] = []
+        self.lock = threading.Lock()
+
+    def send(self, topic: str, value: bytes) -> None:
+        with self.lock:
+            self._pending.append((topic, value))
+
+    def flush(self) -> None:
+        with self.lock:
+            pending, self._pending = self._pending, []
+        by_topic: Dict[str, List[bytes]] = {}
+        for t, v in pending:
+            by_topic.setdefault(t, []).append(v)
+        for t, vs in by_topic.items():
+            self.conn.request({"op": "produce", "topic": t,
+                               "msgs": [base64.b64encode(v).decode()
+                                        for v in vs]})
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+KafkaProducer = MiniProducer

@@ -3,12 +3,14 @@
 Reference: ``adapters/src/lib.rs:74-90`` (factory traits) and the file /
 Kafka / HTTP implementations under ``adapters/src/transport/``.
 
-Kafka is gated on an installed client library (``confluent_kafka`` or
-``kafka-python``) — the environment bakes neither, so construction raises a
-clear error instead of import-failing the package; the wiring (poll thread ->
-parser callback, producer flush) is complete and activates when a client is
-present. HTTP input/output endpoints live on the circuit server
-(``io/server.py``), matching the reference's embedded HTTP transport.
+Kafka speaks through an installed client library (``confluent_kafka`` or
+``kafka-python``) against real brokers, or — selected by a ``mini://``
+address — through the in-repo broker/client (``io/minikafka.py``), which is
+how the poll-thread -> parser -> controller wiring and the producer flush
+path run for real in this environment's tests (reference CI does the same
+against a containerized broker, ``adapters/src/test/kafka.rs:23-31``).
+HTTP input/output endpoints live on the circuit server (``io/server.py``),
+matching the reference's embedded HTTP transport.
 """
 
 from __future__ import annotations
@@ -110,7 +112,14 @@ class FileOutputTransport(OutputTransport):
             self._f.flush()
 
 
-def _kafka_client():
+def _kafka_client(brokers: str = ""):
+    if brokers.startswith("mini://"):
+        # in-repo broker/client (io/minikafka.py): same consumer/producer
+        # call surface as kafka-python, selected by address scheme so the
+        # transport wiring below runs for real without a Kafka install
+        from dbsp_tpu.io import minikafka
+
+        return ("kafka-python", minikafka)
     try:
         import confluent_kafka  # type: ignore
 
@@ -133,7 +142,7 @@ class KafkaInputTransport(InputTransport):
 
     def __init__(self, brokers: str, topics, group_id: str = "dbsp_tpu",
                  poll_timeout: float = 0.5):
-        client = _kafka_client()
+        client = _kafka_client(brokers)
         if client is None:
             raise RuntimeError(
                 "Kafka transport needs confluent_kafka or kafka-python "
@@ -198,7 +207,7 @@ class KafkaOutputTransport(OutputTransport):
     name = "kafka_output"
 
     def __init__(self, brokers: str, topic: str):
-        client = _kafka_client()
+        client = _kafka_client(brokers)
         if client is None:
             raise RuntimeError(
                 "Kafka transport needs confluent_kafka or kafka-python "

@@ -6,14 +6,37 @@ compiler.rs, runner.rs). Differences by design: "compilation" here is
 planning SQL onto a circuit in-process (no cargo build / subprocess chain),
 pipelines run as in-process controllers each with their own embedded HTTP
 server (the reference spawns binaries), and program storage is a JSON file
-instead of Postgres — the REST surface (programs/pipelines CRUD, compile
-status, start/stop, per-pipeline port discovery) is preserved.
+instead of Postgres — the REST surface is preserved:
+
+  * programs are VERSIONED: an update whose code differs increments the
+    version and resets compile status (db/mod.rs:436-468);
+  * compile status is a state machine ``none -> pending -> compiling_sql ->
+    success | sql_error`` driven by a background compiler thread working a
+    queue (compiler.rs:59-84 ProjectStatus; the rust stages collapse — XLA
+    is the analog and runs at pipeline start);
+  * programs support update/delete, pipelines support delete, with the
+    reference's conflict rules (outdated version -> 409, delete of a
+    program in use -> 409, delete of a running pipeline -> 409)
+    (main.rs:720-744 update, :846-869 delete, :1406 pipeline_delete).
+
+Routes:
+  GET  /programs                     list names
+  GET  /programs/<name>              full descriptor (version/status/error)
+  POST /programs                     create (or no-op if identical code)
+  POST /programs/<name>              update (version bump on code change)
+  POST /programs/<name>/compile      enqueue {"version": N} (409 if stale)
+  DELETE /programs/<name>            (409 while a pipeline references it)
+  GET  /pipelines, /pipelines/<name>
+  POST /pipelines                    deploy {"name", "program"}
+  POST /pipelines/<name>/shutdown
+  DELETE /pipelines/<name>           (409 while running)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -21,6 +44,31 @@ from typing import Dict, Optional
 import jax.numpy as jnp
 
 DTYPES = {"int32": jnp.int32, "int64": jnp.int64, "float32": jnp.float32}
+
+def _build_fn(program: dict):
+    """The circuit builder for a program dict — shared by the compiler
+    service (validation) and pipeline deployment."""
+    tables = program["tables"]
+    views = program["sql"]
+
+    def build(c):
+        from dbsp_tpu.operators import add_input_zset
+        from dbsp_tpu.sql import SqlContext
+
+        ctx = SqlContext(c)
+        handles = {}
+        for tname, spec in tables.items():
+            dts = [DTYPES[d] for d in spec["dtypes"]]
+            nkeys = spec.get("key_columns", 1)
+            s, h = add_input_zset(c, dts[:nkeys], dts[nkeys:])
+            ctx.register_table(tname, s, spec["columns"])
+            handles[tname] = (h, dts)
+        outs = {}
+        for vname, sql in views.items():
+            outs[vname] = ctx.query(sql).integrate().output()
+        return handles, outs
+
+    return build
 
 
 class Pipeline:
@@ -39,29 +87,10 @@ class Pipeline:
         from dbsp_tpu.circuit import Runtime
         from dbsp_tpu.io import Catalog, CircuitServer, Controller
         from dbsp_tpu.profile import CPUProfiler
-        from dbsp_tpu.sql import SqlContext
-
-        tables = self.program["tables"]
-        views = self.program["sql"]
-
-        def build(c):
-            from dbsp_tpu.operators import add_input_zset
-
-            ctx = SqlContext(c)
-            handles = {}
-            for tname, spec in tables.items():
-                dts = [DTYPES[d] for d in spec["dtypes"]]
-                nkeys = spec.get("key_columns", 1)
-                s, h = add_input_zset(c, dts[:nkeys], dts[nkeys:])
-                ctx.register_table(tname, s, spec["columns"])
-                handles[tname] = (h, dts)
-            outs = {}
-            for vname, sql in views.items():
-                outs[vname] = ctx.query(sql).integrate().output()
-            return handles, outs
 
         self.status = "compiling"
-        handle, (handles, outs) = Runtime.init_circuit(1, build)
+        handle, (handles, outs) = Runtime.init_circuit(
+            1, _build_fn(self.program))
         catalog = Catalog()
         for tname, (h, dts) in handles.items():
             catalog.register_input(tname, h, tuple(dts))
@@ -85,20 +114,76 @@ class Pipeline:
 
     def describe(self) -> dict:
         return {"name": self.name, "status": self.status, "port": self.port,
-                "error": self.error}
+                "error": self.error,
+                "program_version": self.program.get("version")}
+
+
+class _CompilerService:
+    """Background compile queue (compiler.rs): validates a program version
+    by PLANNING its SQL onto a throwaway circuit; status transitions are
+    observable through the program descriptor while it works."""
+
+    def __init__(self, mgr: "PipelineManager"):
+        self.mgr = mgr
+        self.q: "queue.Queue" = queue.Queue()
+        self.thread = threading.Thread(target=self._work, daemon=True,
+                                       name="compiler-service")
+        self.thread.start()
+
+    def submit(self, name: str, version: int) -> None:
+        self.q.put((name, version))
+
+    def _work(self) -> None:
+        from dbsp_tpu.circuit import Runtime
+
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            name, version = item
+            with self.mgr.lock:
+                prog = self.mgr.programs.get(name)
+                # stale request: the program changed (or vanished) since
+                # the compile was enqueued — drop it (compiler.rs picks the
+                # latest version off the queue the same way)
+                if prog is None or prog["version"] != version:
+                    continue
+                prog["status"] = "compiling_sql"
+            try:
+                Runtime.init_circuit(1, _build_fn(prog))
+                status, error = "success", None
+            except Exception as e:  # noqa: BLE001 — surface as sql_error
+                status, error = "sql_error", f"{type(e).__name__}: {e}"
+            with self.mgr.lock:
+                prog = self.mgr.programs.get(name)
+                if prog is not None and prog["version"] == version:
+                    prog["status"] = status
+                    prog["error"] = error
+                    self.mgr._persist()
+
+    def stop(self) -> None:
+        self.q.put(None)
 
 
 class PipelineManager:
-    """REST service: /programs and /pipelines CRUD."""
+    """REST service: /programs and /pipelines CRUD + compile lifecycle."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  storage_path: Optional[str] = None):
         self.programs: Dict[str, dict] = {}
         self.pipelines: Dict[str, Pipeline] = {}
         self.storage_path = storage_path
+        self.lock = threading.RLock()
         if storage_path and os.path.exists(storage_path):
             with open(storage_path) as f:
                 self.programs = json.load(f)
+            for prog in self.programs.values():  # pre-lifecycle files
+                prog.setdefault("version", 1)
+                prog.setdefault("status", "none")
+                prog.setdefault("error", None)
+                if prog["status"] in ("pending", "compiling_sql"):
+                    prog["status"] = "none"  # compile died with the process
+        self.compiler = _CompilerService(self)
         mgr = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -130,9 +215,18 @@ class PipelineManager:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path.rstrip("/") == "/programs":
-                    self._json(sorted(mgr.programs))
+                    with mgr.lock:
+                        self._json(sorted(mgr.programs))
+                elif len(parts) == 3 and parts[1] == "programs":
+                    with mgr.lock:
+                        prog = mgr.programs.get(parts[2])
+                        if prog is None:
+                            return self._json({"error": "not found"}, 404)
+                        self._json(mgr._describe_program(prog))
                 elif self.path.rstrip("/") == "/pipelines":
-                    self._json([p.describe() for p in mgr.pipelines.values()])
+                    with mgr.lock:
+                        self._json([p.describe()
+                                    for p in mgr.pipelines.values()])
                 elif len(parts) == 3 and parts[1] == "pipelines":
                     p = mgr.pipelines.get(parts[2])
                     if p is None:
@@ -147,28 +241,41 @@ class PipelineManager:
                 try:
                     if self.path.rstrip("/") == "/programs":
                         body = self._body()
-                        mgr.programs[body["name"]] = body
-                        mgr._persist()
-                        self._json({"name": body["name"]})
+                        self._json(mgr.upsert_program(body["name"], body))
+                    elif len(parts) == 3 and parts[1] == "programs":
+                        body = self._body()
+                        if parts[2] not in mgr.programs:
+                            return self._json({"error": "not found"}, 404)
+                        self._json(mgr.upsert_program(parts[2], body))
+                    elif len(parts) == 4 and parts[1] == "programs" \
+                            and parts[3] == "compile":
+                        body = self._body()
+                        out, code = mgr.compile_program(
+                            parts[2], body.get("version"))
+                        self._json(out, code)
                     elif self.path.rstrip("/") == "/pipelines":
                         body = self._body()
                         name = body["name"]
-                        if name in mgr.pipelines and \
-                                mgr.pipelines[name].status == "running":
-                            return self._json(
-                                {"error": f"pipeline {name} already running"},
-                                409)
-                        prog = mgr.programs[body["program"]]
-                        p = Pipeline(name, prog)
+                        # reserve the slot UNDER THE LOCK before the (slow)
+                        # compile: delete_program's in-use check and
+                        # delete_pipeline must see mid-deploy pipelines
+                        with mgr.lock:
+                            prev = mgr.pipelines.get(name)
+                            if prev is not None and prev.status in (
+                                    "created", "compiling", "running"):
+                                return self._json(
+                                    {"error": f"pipeline {name} already "
+                                              f"{prev.status}"}, 409)
+                            prog = mgr.programs[body["program"]]
+                            p = Pipeline(name, prog)
+                            mgr.pipelines[name] = p
                         try:
                             p.compile_and_start()
                         except Exception as e:
                             p.error = f"{type(e).__name__}: {e}"
                             p.status = "failed"
-                            p.stop()  # release any partially started parts
-                            mgr.pipelines[name] = p
+                            p.stop()  # release partially started parts
                             return self._json({"error": p.error}, 400)
-                        mgr.pipelines[name] = p
                         self._json(p.describe())
                     elif len(parts) == 4 and parts[1] == "pipelines" and \
                             parts[3] == "shutdown":
@@ -179,10 +286,95 @@ class PipelineManager:
                 except Exception as e:  # surface as API error, keep serving
                     self._json({"error": f"{type(e).__name__}: {e}"}, 400)
 
+            def do_DELETE(self):
+                parts = self.path.rstrip("/").split("/")
+                try:
+                    if len(parts) == 3 and parts[1] == "programs":
+                        out, code = mgr.delete_program(parts[2])
+                        self._json(out, code)
+                    elif len(parts) == 3 and parts[1] == "pipelines":
+                        out, code = mgr.delete_pipeline(parts[2])
+                        self._json(out, code)
+                    else:
+                        self._json({"error": "no route"}, 404)
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    # -- program lifecycle ---------------------------------------------------
+    @staticmethod
+    def _describe_program(prog: dict) -> dict:
+        return {"name": prog["name"], "version": prog["version"],
+                "status": prog["status"], "error": prog.get("error"),
+                "description": prog.get("description", "")}
+
+    @staticmethod
+    def _code_of(body: dict) -> dict:
+        return {"tables": body.get("tables"), "sql": body.get("sql")}
+
+    def upsert_program(self, name: str, body: dict) -> dict:
+        """Create, or update-with-version-bump when the CODE changed
+        (db/mod.rs:436-468: description-only edits keep the version)."""
+        with self.lock:
+            prev = self.programs.get(name)
+            if prev is None:
+                prog = dict(body, name=name, version=1, status="none",
+                            error=None)
+                self.programs[name] = prog
+            elif self._code_of(prev) != self._code_of(body):
+                prog = dict(body, name=name, version=prev["version"] + 1,
+                            status="none", error=None)
+                self.programs[name] = prog
+            else:  # code identical: keep version + compile status
+                prev["description"] = body.get(
+                    "description", prev.get("description", ""))
+                prog = prev
+            self._persist()
+            return self._describe_program(prog)
+
+    def compile_program(self, name: str, version: Optional[int]):
+        with self.lock:
+            prog = self.programs.get(name)
+            if prog is None:
+                return {"error": "not found"}, 404
+            if version is not None and version != prog["version"]:
+                return {"error": f"outdated program version '{version}'"}, 409
+            if prog["status"] in ("pending", "compiling_sql", "success"):
+                return self._describe_program(prog), 200  # idempotent
+            prog["status"] = "pending"
+            prog["error"] = None
+            self.compiler.submit(name, prog["version"])
+            return self._describe_program(prog), 202
+
+    def delete_program(self, name: str):
+        with self.lock:
+            if name not in self.programs:
+                return {"error": "not found"}, 404
+            used_by = [p.name for p in self.pipelines.values()
+                       if p.program.get("name") == name
+                       and p.status in ("created", "compiling", "running")]
+            if used_by:
+                return {"error": f"program {name} is used by active "
+                                 f"pipelines: {used_by}"}, 409
+            del self.programs[name]
+            self._persist()
+            return {"deleted": name}, 200
+
+    def delete_pipeline(self, name: str):
+        with self.lock:
+            p = self.pipelines.get(name)
+            if p is None:
+                return {"error": "not found"}, 404
+            if p.status in ("created", "compiling", "running"):
+                return {"error": f"pipeline {name} is {p.status} — shut it "
+                                 "down first"}, 409
+            del self.pipelines[name]
+            return {"deleted": name}, 200
+
+    # -- persistence / serving -----------------------------------------------
     def _persist(self):
         if self.storage_path:
             with open(self.storage_path, "w") as f:
@@ -197,4 +389,5 @@ class PipelineManager:
         for p in self.pipelines.values():
             if p.status == "running":
                 p.stop()
+        self.compiler.stop()
         self.httpd.shutdown()

@@ -251,3 +251,66 @@ def test_monitor_tolerates_nested_circuits():
     circuit.step()
     assert not mon.errors
     assert out.to_dict() == {(0, 1): 1, (0, 2): 1, (1, 2): 1}
+
+
+def test_kafka_transport_roundtrip():
+    """The Kafka transports EXECUTED end to end (reference CI runs them
+    against a real broker, adapters/src/test/kafka.rs:23-31): an in-repo
+    mini broker (io/minikafka.py, selected by the mini:// address scheme)
+    drives the real transport wiring — consumer poll thread -> parser ->
+    controller, controller flush -> producer -> broker — round-tripping
+    insert/delete envelopes through a counting pipeline."""
+    from dbsp_tpu.io import KafkaInputTransport, KafkaOutputTransport
+    from dbsp_tpu.io.minikafka import MiniKafkaBroker, MiniProducer
+
+    broker = MiniKafkaBroker().start()
+    try:
+        # seed the input topic with insert + delete envelopes
+        feed = MiniProducer(bootstrap_servers=broker.address)
+        for k, v in [(1, 10), (1, 11), (2, 20)]:
+            feed.send("events", json.dumps({"insert": [k, v]}).encode())
+        feed.send("events", json.dumps({"delete": [1, 11]}).encode())
+        feed.flush()
+
+        handle, catalog = _build_count_pipeline()
+        ctl = Controller(handle, catalog,
+                         ControllerConfig(min_batch_records=1,
+                                          flush_interval_s=0.05))
+        ctl.add_input_endpoint(
+            "kin", "events",
+            KafkaInputTransport(broker.address, ["events"],
+                                poll_timeout=0.05), fmt="json")
+        ctl.add_output_endpoint(
+            "kout", "counts",
+            KafkaOutputTransport(broker.address, "counts"), fmt="json")
+        ctl.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ctl.stats()["inputs"]["kin"]["total_records"] >= 4 and \
+                    ctl.stats()["steps"] >= 1:
+                break
+            time.sleep(0.05)
+        time.sleep(0.3)  # let the flush tick emit to the output topic
+        ctl.stop()
+        assert ctl.stats()["inputs"]["kin"]["total_records"] >= 4
+
+        # integrate the emitted deltas from the output topic
+        from dbsp_tpu.io.minikafka import MiniConsumer
+
+        consumer = MiniConsumer("counts", bootstrap_servers=broker.address,
+                                group_id="check")
+        state = {}
+        for records in consumer.poll().values():
+            for r in records:
+                obj = json.loads(r.value)
+                if "insert" in obj:
+                    row = tuple(obj["insert"])
+                    state[row] = state.get(row, 0) + 1
+                else:
+                    row = tuple(obj["delete"])
+                    state[row] = state.get(row, 0) - 1
+        consumer.close()
+        final = {k: n for (k, n), w in state.items() if w > 0}
+        assert final == {1: 1, 2: 1}  # after the delete nets one of key 1's
+    finally:
+        broker.stop()

@@ -62,6 +62,69 @@ def test_manager_bad_program_is_api_error(manager):
         conn.start_pipeline("p2", "bad")
 
 
+def test_program_version_lifecycle(manager):
+    """Versions + compile-status state machine (reference:
+    pipeline_manager/src/db/mod.rs:436-468 version bump on code change;
+    compiler.rs:59-78 status transitions)."""
+    import time
+
+    conn = Connection(port=manager.port)
+    desc = conn.create_program("prog", TABLES, SQL)
+    assert (desc["version"], desc["status"]) == (1, "none")
+
+    # identical code re-POST: no version bump
+    assert conn.create_program("prog", TABLES, SQL)["version"] == 1
+
+    # compile v1 -> success (background compiler service)
+    conn.compile_program("prog", version=1)
+    deadline = time.time() + 60
+    while conn.program("prog")["status"] not in ("success", "sql_error"):
+        assert time.time() < deadline, "compile never finished"
+        time.sleep(0.1)
+    assert conn.program("prog")["status"] == "success"
+
+    # code change -> version bump + status reset
+    sql2 = {"by_auction": SQL["by_auction"], "all": "SELECT * FROM bids"}
+    desc = conn.update_program("prog", TABLES, sql2)
+    assert (desc["version"], desc["status"]) == (2, "none")
+
+    # compiling the OLD version is a conflict
+    with pytest.raises(RuntimeError, match="[Oo]utdated"):
+        conn.compile_program("prog", version=1)
+
+    # bad SQL surfaces as sql_error with the planner's message
+    conn.update_program("prog", TABLES, {"v": "SELECT nope FROM bids"})
+    conn.compile_program("prog")
+    deadline = time.time() + 60
+    while conn.program("prog")["status"] not in ("success", "sql_error"):
+        assert time.time() < deadline
+        time.sleep(0.1)
+    prog = conn.program("prog")
+    assert prog["status"] == "sql_error"
+    assert "unknown column" in prog["error"]
+
+
+def test_program_and_pipeline_delete_rules(manager):
+    """Delete conflicts (main.rs:846-869, :1406): a program in use by a
+    running pipeline and a running pipeline itself both refuse deletion."""
+    conn = Connection(port=manager.port)
+    conn.create_program("p", TABLES, SQL)
+    conn.start_pipeline("pipe", "p")
+
+    with pytest.raises(RuntimeError, match="used by active"):
+        conn.delete_program("p")
+    with pytest.raises(RuntimeError, match="running"):
+        conn.delete_pipeline("pipe")
+
+    conn.shutdown_pipeline("pipe")
+    conn.delete_pipeline("pipe")
+    assert conn.pipelines() == []
+    conn.delete_program("p")
+    assert conn.programs() == []
+    with pytest.raises(RuntimeError, match="not found"):
+        conn.program("p")
+
+
 def test_program_persistence(tmp_path):
     path = str(tmp_path / "programs.json")
     m = PipelineManager(storage_path=path)
