@@ -7,20 +7,26 @@ hand-rolled tokenizer + recursive-descent parser for the subset that covers
 incremental view maintenance over streams:
 
     SELECT [DISTINCT] expr [AS name], ...
-    FROM table [alias]
-    [[LEFT] JOIN table [alias] ON col = col
-       | JOIN table [alias] ON col BETWEEN expr AND expr]
+    FROM source [alias]
+    { [[LEFT] [INNER] JOIN source [alias] ON col = col
+       | JOIN source [alias] ON col BETWEEN expr AND expr] }...
     [WHERE predicate]
     [GROUP BY col, ...] [HAVING predicate]
     [ORDER BY col [ASC|DESC], ...] [LIMIT n]
+
+    query := select | query UNION [ALL] query | query EXCEPT query
+           | query INTERSECT query            (left-associative; parenthesize
+                                               to control grouping)
+    source := table | ( query ) alias         (FROM-subqueries)
 
 with integer/float literals, + - * / %, comparisons, BETWEEN, AND/OR/NOT,
 aggregates COUNT(*) / COUNT / SUM / MIN / MAX / AVG, and scalar subqueries
 ``(SELECT <aggregate> FROM ...)`` as comparison operands. The planner
 (``sql/planner.py``) lowers the AST onto circuit operators — ORDER BY +
 LIMIT onto top-K, LEFT JOIN onto join + antijoin, BETWEEN joins onto
-range joins — so every query is maintained incrementally like any
-hand-built circuit.
+range joins, join chains onto left-deep bilinear joins, set operations
+onto plus/neg + distinct/semijoin/antijoin — so every query is maintained
+incrementally like any hand-built circuit.
 """
 
 from __future__ import annotations
@@ -36,7 +42,8 @@ TOKEN_RE = re.compile(
 KEYWORDS = {"select", "distinct", "from", "join", "on", "where", "group",
             "by", "as", "and", "or", "not", "count", "sum", "min", "max",
             "avg", "having", "order", "limit", "asc", "desc", "left",
-            "outer", "between"}
+            "outer", "inner", "between", "union", "except", "intersect",
+            "all"}
 
 
 def tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -127,19 +134,50 @@ class RangeOn:
 
 
 @dataclasses.dataclass
+class SubSource:
+    """A FROM-subquery: ``FROM (query) alias``."""
+
+    select: "Query"
+    alias: str
+
+
+Source = Union[TableRef, SubSource]
+
+
+@dataclasses.dataclass
+class Join:
+    """One JOIN clause in a (left-deep) chain."""
+
+    table: Source
+    on: Optional[Tuple[Col, Col]]      # equi-join columns
+    range: Optional[RangeOn]           # or BETWEEN bounds
+    left: bool = False                 # LEFT [OUTER] JOIN
+
+
+@dataclasses.dataclass
 class Select:
     items: List[SelectItem]
     distinct: bool
-    table: TableRef
-    join: Optional[TableRef]
-    join_on: Optional[Tuple[Col, Col]]
+    table: Source
+    joins: List[Join]
     where: Optional[Expr]
     group_by: List[Col]
     having: Optional[Expr] = None
     order_by: List[OrderItem] = dataclasses.field(default_factory=list)
     limit: Optional[int] = None
-    join_left: bool = False          # LEFT [OUTER] JOIN
-    join_range: Optional[RangeOn] = None  # BETWEEN join
+
+
+@dataclasses.dataclass
+class SetOp:
+    """UNION [ALL] / EXCEPT / INTERSECT of two queries."""
+
+    op: str                # union | except | intersect
+    all: bool              # bag semantics (UNION ALL only)
+    left: "Query"
+    right: "Query"
+
+
+Query = Union[Select, SetOp]
 
 
 class Parser:
@@ -169,11 +207,44 @@ class Parser:
         return False
 
     # -- grammar ------------------------------------------------------------
-    def parse_select(self) -> Select:
-        s = self.select_body()
+    def parse_select(self):
+        s = self.query_body()
         if self.peek()[0] != "eof":
             raise SyntaxError(f"trailing tokens: {self.toks[self.i:]}")
         return s
+
+    def query_body(self):
+        """select (UNION [ALL] | EXCEPT | INTERSECT) select ... —
+        left-associative (parenthesize operands to control grouping)."""
+        node = self.query_operand()
+        while self.peek()[0] == "kw" and \
+                self.peek()[1] in ("union", "except", "intersect"):
+            op = self.next()[1]
+            all_ = self.accept("kw", "all")
+            if all_ and op != "union":
+                raise SyntaxError(f"{op.upper()} ALL is not supported")
+            node = SetOp(op, all_, node, self.query_operand())
+        return node
+
+    def query_operand(self):
+        if self.peek() == ("op", "("):
+            save = self.i
+            self.next()
+            if self.peek() == ("kw", "select"):
+                s = self.query_body()
+                self.expect("op", ")")
+                return s
+            self.i = save  # parenthesized expression, not a subquery
+        return self.select_body()
+
+    def table_source(self) -> Source:
+        """table [alias] | ( query ) alias"""
+        if self.accept("op", "("):
+            sel = self.query_body()
+            self.expect("op", ")")
+            alias = self.expect("id")[1]
+            return SubSource(sel, alias)
+        return self.table_ref()
 
     def select_body(self) -> Select:
         self.expect("kw", "select")
@@ -182,26 +253,30 @@ class Parser:
         while self.accept("op", ","):
             items.append(self.select_item())
         self.expect("kw", "from")
-        table = self.table_ref()
-        join = join_on = join_range = None
-        join_left = False
-        if self.peek() == ("kw", "left") or self.peek() == ("kw", "join"):
+        table = self.table_source()
+        joins: List[Join] = []
+        while self.peek() in (("kw", "left"), ("kw", "join"),
+                              ("kw", "inner")):
+            join_left = False
             if self.accept("kw", "left"):
                 self.accept("kw", "outer")
                 join_left = True
+            else:
+                self.accept("kw", "inner")
             self.expect("kw", "join")
-            join = self.table_ref()
+            jtable = self.table_source()
             self.expect("kw", "on")
             left = self.column()
             if self.accept("kw", "between"):
                 lo = self.additive()
                 self.expect("kw", "and")
                 hi = self.additive()
-                join_range = RangeOn(left, lo, hi)
+                joins.append(Join(jtable, None, RangeOn(left, lo, hi),
+                                  join_left))
             else:
                 self.expect("op", "=")
                 right = self.column()
-                join_on = (left, right)
+                joins.append(Join(jtable, (left, right), None, join_left))
         where = None
         if self.accept("kw", "where"):
             where = self.disjunction()
@@ -228,8 +303,8 @@ class Parser:
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("num")[1])
-        return Select(items, distinct, table, join, join_on, where, group_by,
-                      having, order_by, limit, join_left, join_range)
+        return Select(items, distinct, table, joins, where, group_by,
+                      having, order_by, limit)
 
     def select_item(self) -> SelectItem:
         if self.peek() == ("op", "*"):
@@ -314,7 +389,7 @@ class Parser:
         if t[0] == "op" and t[1] == "(":
             self.next()
             if self.peek() == ("kw", "select"):  # scalar subquery
-                sub = self.select_body()
+                sub = self.query_body()
                 self.expect("op", ")")
                 return Subquery(sub)
             e = self.disjunction()
@@ -337,5 +412,5 @@ class Parser:
         raise SyntaxError(f"unexpected token {t}")
 
 
-def parse(sql: str) -> Select:
+def parse(sql: str) -> Query:
     return Parser(tokenize(sql)).parse_select()

@@ -182,7 +182,72 @@ class SqlContext:
     def query(self, sql: str) -> Stream:
         return self._plan(P.parse(sql))
 
-    def _plan(self, ast: P.Select) -> Stream:
+    def _plan(self, ast) -> Stream:
+        if isinstance(ast, P.SetOp):
+            return self._plan_setop(ast)
+        return self._plan_select(ast)
+
+    # -- set operations ------------------------------------------------------
+    @staticmethod
+    def _flatten_rows(stream: Stream, names, tag: str) -> Stream:
+        """Normalize to an all-key row layout (set ops compare full rows and
+        semijoin/antijoin key on the stream's key columns)."""
+        schema = stream.schema
+        flat_dts = (*schema[0], *schema[1])
+        if not schema[1]:
+            out = stream
+        else:
+            out = stream.map_rows(
+                lambda k, v: ((*k, *v), ()), flat_dts, (),
+                name=f"sql-rows-{tag}")
+        out._sql_names = list(names)
+        return out
+
+    def _plan_setop(self, ast: P.SetOp) -> Stream:
+        a = self._plan(ast.left)
+        b = self._plan(ast.right)
+        a_names = getattr(a, "_sql_names", None) or \
+            [f"col{i}" for i in range(len(a.schema[0]) + len(a.schema[1]))]
+        na = len(a.schema[0]) + len(a.schema[1])
+        nb = len(b.schema[0]) + len(b.schema[1])
+        if na != nb:
+            raise SqlError(
+                f"{ast.op.upper()} operands have {na} vs {nb} columns")
+        a = self._flatten_rows(a, a_names, "l")
+        b = self._flatten_rows(b, a_names, "r")
+        if a.schema[0] != b.schema[0]:
+            # promote BOTH sides to the common wider dtypes (casting the
+            # right down to the left would wrap values >= 2^31 and create
+            # false EXCEPT/INTERSECT equalities)
+            dts = tuple(jnp.result_type(x, y)
+                        for x, y in zip(a.schema[0], b.schema[0]))
+
+            def cast(s, tag):
+                if s.schema[0] == dts:
+                    return s
+                out = s.map_rows(
+                    lambda k, v, _d=dts: (tuple(c.astype(d) for c, d
+                                                in zip(k, _d)), ()),
+                    dts, (), name=f"sql-setcast-{tag}")
+                out._sql_names = list(a_names)
+                return out
+
+            a, b = cast(a, "l"), cast(b, "r")
+        if ast.op == "union":
+            out = a.plus(b)
+            out.schema = a.schema
+            if not ast.all:
+                out = out.distinct()
+        elif ast.op == "intersect":
+            # set semantics: distinct rows present on BOTH sides (semijoin
+            # reduces its right side via keys_distinct internally)
+            out = a.distinct().semijoin(b)
+        else:  # except
+            out = a.distinct().antijoin(b)
+        out._sql_names = list(a_names)
+        return out
+
+    def _plan_select(self, ast: P.Select) -> Stream:
         stream, scope = self._plan_from(ast)
         if ast.where is not None:
             where = ast.where
@@ -207,7 +272,17 @@ class SqlContext:
             stream = self._plan_topk(ast, stream)
         return stream
 
-    def _table_scope(self, ref: P.TableRef) -> Tuple[Stream, _Scope]:
+    def _source_scope(self, ref: P.Source) -> Tuple[Stream, _Scope]:
+        if isinstance(ref, P.SubSource):
+            # FROM (query) alias: plan the subquery; its output columns are
+            # visible as alias.<name> (base name = last path component)
+            sub = self._plan(ref.select)
+            schema = sub.schema
+            dtypes = [*schema[0], *schema[1]]
+            names = getattr(sub, "_sql_names", None) or \
+                [f"col{i}" for i in range(len(dtypes))]
+            return sub, _Scope(
+                [f"{ref.alias}.{n.split('.')[-1]}" for n in names], dtypes)
         if ref.name not in self.tables:
             raise SqlError(f"unknown table {ref.name}")
         stream, cols = self.tables[ref.name]
@@ -216,16 +291,24 @@ class SqlContext:
         return stream, _Scope([f"{ref.alias}.{c}" for c in cols], dtypes)
 
     def _plan_from(self, ast: P.Select) -> Tuple[Stream, _Scope]:
-        left, ls = self._table_scope(ast.table)
-        if ast.join is None:
-            return left, ls
-        right, rs = self._table_scope(ast.join)
-        if ast.join_range is not None:
-            if ast.join_left:
-                raise SqlError("LEFT JOIN with BETWEEN bounds is not "
-                               "supported yet")
-            return self._plan_range_join(ast, left, ls, right, rs)
-        lcol, rcol = ast.join_on
+        """Left-deep join chain: fold each JOIN clause onto the accumulated
+        (stream, scope) — the reference's Calcite plans multi-way joins the
+        same left-deep way before the circuit sees them."""
+        left, ls = self._source_scope(ast.table)
+        for n, join in enumerate(ast.joins):
+            right, rs = self._source_scope(join.table)
+            if join.range is not None:
+                if join.left:
+                    raise SqlError("LEFT JOIN with BETWEEN bounds is not "
+                                   "supported yet")
+                left, ls = self._fold_range_join(join, left, ls, right, rs,
+                                                 n)
+            else:
+                left, ls = self._fold_equi_join(join, left, ls, right, rs, n)
+        return left, ls
+
+    def _fold_equi_join(self, join: P.Join, left, ls, right, rs, n: int):
+        lcol, rcol = join.on
         # resolve which side each ON column belongs to
         try:
             li = ls.index_of(lcol)
@@ -238,25 +321,27 @@ class SqlContext:
         # same key dtype and lex_probe never truncates probe keys
         key_dt = jnp.result_type(ls.dtypes[li], rs.dtypes[ri])
 
-        def rekey(idx, n):
+        def rekey(idx):
             def key_fn(k, v, _i=idx):
                 return ((*k, *v)[_i],)
 
-            def val_fn(k, v, _n=n):
+            def val_fn(k, v):
                 return tuple((*k, *v))
 
             return key_fn, val_fn
 
-        lk, lv = rekey(li, len(ls.names))
-        rk, rv = rekey(ri, len(rs.names))
+        lk, lv = rekey(li)
+        rk, rv = rekey(ri)
         lkeyed = left.index_by(lk, (key_dt,), val_fn=lv,
-                               val_dtypes=tuple(ls.dtypes), name="sql-lkey")
+                               val_dtypes=tuple(ls.dtypes),
+                               name=f"sql-lkey{n}")
         rkeyed = right.index_by(rk, (key_dt,), val_fn=rv,
-                                val_dtypes=tuple(rs.dtypes), name="sql-rkey")
+                                val_dtypes=tuple(rs.dtypes),
+                                name=f"sql-rkey{n}")
         joined = lkeyed.join_index(
             rkeyed, lambda k, lvs, rvs: (k, (*lvs, *rvs)),
-            (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-join")
-        if ast.join_left:
+            (key_dt,), (*ls.dtypes, *rs.dtypes), name=f"sql-join{n}")
+        if join.left:
             # LEFT JOIN: unmatched left rows survive, right columns padded
             # with NULL_INT (the dtype's min — documented NULL convention)
             nulls = tuple(NULL_INT(dt) for dt in rs.dtypes)
@@ -266,19 +351,20 @@ class SqlContext:
                                  for nv, dt in zip(_nulls, _dts)))
 
             missing = lkeyed.antijoin(rkeyed).map_rows(
-                pad, (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-leftpad")
+                pad, (key_dt,), (*ls.dtypes, *rs.dtypes),
+                name=f"sql-leftpad{n}")
             joined = joined.plus(missing)
             joined.schema = ((key_dt,), (*ls.dtypes, *rs.dtypes))
-        scope = _Scope(["__jk__", *ls.names, *rs.names],
+        scope = _Scope([f"__jk{n}__", *ls.names, *rs.names],
                        [key_dt, *ls.dtypes, *rs.dtypes])
         return joined, scope
 
-    def _plan_range_join(self, ast, left, ls, right, rs):
+    def _fold_range_join(self, join, left, ls, right, rs, n: int):
         """JOIN r ON r.x BETWEEN l.y + c1 AND l.y + c2 -> relative range
         join (operators/join_range.py)."""
         import dbsp_tpu.operators.join_range  # noqa: F401 (register)
 
-        rng = ast.join_range
+        rng = join.range
         try:
             ri = rs.index_of(rng.col)
         except SqlError:
@@ -305,16 +391,16 @@ class SqlContext:
         lkeyed = left.index_by(
             lambda k, v, _i=li: ((*k, *v)[_i],), (key_dt,),
             val_fn=lambda k, v: (*k, *v), val_dtypes=tuple(ls.dtypes),
-            name="sql-rglkey")
+            name=f"sql-rglkey{n}")
         rkeyed = right.index_by(
             lambda k, v, _i=ri: ((*k, *v)[_i],), (key_dt,),
             val_fn=lambda k, v: (*k, *v), val_dtypes=tuple(rs.dtypes),
-            name="sql-rgrkey")
+            name=f"sql-rgrkey{n}")
         joined = lkeyed.join_range(
             rkeyed, lo_c, hi_c,
             lambda lk, lv, rk, rv: (lk, (*lv, *rv)),
-            (key_dt,), (*ls.dtypes, *rs.dtypes), name="sql-rangejoin")
-        scope = _Scope(["__jk__", *ls.names, *rs.names],
+            (key_dt,), (*ls.dtypes, *rs.dtypes), name=f"sql-rangejoin{n}")
+        scope = _Scope([f"__jk{n}__", *ls.names, *rs.names],
                        [key_dt, *ls.dtypes, *rs.dtypes])
         return joined, scope
 

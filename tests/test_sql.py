@@ -38,7 +38,7 @@ USERS = [((10, 7), 1), ((11, 7), 1), ((12, 8), 1)]
 def test_parse_roundtrip():
     ast = parse("SELECT a.x, COUNT(*) AS n FROM t a JOIN s ON a.x = s.y "
                 "WHERE a.x > 3 AND s.z <> 1 GROUP BY a.x")
-    assert ast.join.name == "s" and ast.group_by[0].name == "x"
+    assert ast.joins[0].table.name == "s" and ast.group_by[0].name == "x"
     with pytest.raises(SyntaxError):
         parse("SELECT FROM t")
 
