@@ -119,10 +119,13 @@ def _supervise() -> int:
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 1080))
-    attempts = [("accel", probe_timeout), ("cpu", probe_timeout + 60)]
+    started = time.time()
     notes = []
-    for plat, up_timeout in attempts:
+
+    def attempt(plat: str, up_timeout: float, deadline: float):
+        """One child run; returns (json_line_or_None, parsed_or_None)."""
         env = dict(os.environ, BENCH_CHILD="1", BENCH_PLATFORM=plat)
+        env["BENCH_TIME_BUDGET_S"] = str(max(60, deadline - time.time()))
         t0 = time.time()
         p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                              env=env, stdout=subprocess.PIPE, text=True)
@@ -145,7 +148,7 @@ def _supervise() -> int:
                                  f"{up_timeout:.0f}s (wedged tunnel?)")
                     p.kill()
                     break
-                if now - t0 > budget + 120:
+                if now > deadline + 120:
                     # child's own SIGALRM budget should have fired; it is
                     # stuck in a C call — kill from outside
                     notes.append(f"{plat}: hard deadline, killed")
@@ -163,22 +166,60 @@ def _supervise() -> int:
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:
             p.kill()
+        parsed = None
         if json_line:
-            # a child that failed fast (backend init error -> error JSON
-            # with nothing measured) should not preempt the next backend
-            # attempt — that's exactly when the CPU fallback must run
             try:
                 parsed = json.loads(json_line)
-                failed_dry = (parsed.get("detail", {}).get("error")
-                              and not parsed.get("detail", {}).get("events"))
             except ValueError:
-                parsed, failed_dry = None, False
-            if failed_dry and plat != attempts[-1][0]:
-                notes.append(f"{plat}: {parsed['detail']['error'][:160]}")
-                continue
-            print(json_line)
+                pass
+        return json_line, parsed
+
+    def measured(parsed) -> bool:
+        d = (parsed or {}).get("detail", {})
+        return bool(d.get("events")) and not d.get("error")
+
+    # 1) accelerator first
+    partial_accel = None  # best error-but-measured accel line (last resort)
+    line, parsed = attempt("accel", probe_timeout, started + budget)
+    if line and measured(parsed):
+        print(line)
+        sys.stdout.flush()
+        return 0
+    if parsed and parsed.get("detail", {}).get("error"):
+        notes.append(f"accel: {parsed['detail']['error'][:160]}")
+        if parsed.get("detail", {}).get("events"):
+            partial_accel = line  # crashed mid-run but measured something
+
+    # 2) CPU fallback — capture the result but DON'T print yet: if budget
+    # remains afterwards, the tunnel gets more chances (it wedges and
+    # recovers on its own schedule; the round's only TPU window may be late
+    # in the run). The last accel result that actually measured wins.
+    cpu_line, cpu_parsed = attempt("cpu", probe_timeout + 60,
+                                   started + budget)
+    retries = int(os.environ.get("BENCH_ACCEL_RETRIES", 2))
+    for _ in range(retries):
+        left = started + budget - time.time()
+        if left < probe_timeout + 240:  # not enough for warmup + measure
+            break
+        notes.append(f"accel retry with {left:.0f}s left")
+        line, parsed = attempt("accel", probe_timeout, started + budget)
+        if line and measured(parsed):
+            d = parsed.setdefault("detail", {})
+            d["attempt_notes"] = notes[-4:]
+            if cpu_parsed is not None:
+                d["cpu_fallback_value"] = cpu_parsed.get("value")
+            print(json.dumps(parsed))
             sys.stdout.flush()
             return 0
+    if cpu_line:
+        print(cpu_line)
+        sys.stdout.flush()
+        return 0
+    if partial_accel:
+        # a crashed-mid-run accel measurement still beats a synthetic zero
+        print(partial_accel)
+        sys.stdout.flush()
+        return 0
     # no child produced a line — emit one here so the driver never sees
     # empty output
     qname = os.environ.get("BENCH_QUERY", "q4")

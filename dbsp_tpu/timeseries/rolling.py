@@ -43,8 +43,8 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, bucket_cap
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _range_gather_level(qp, qlo, qhi, qlive, level: Batch, out_cap: int):
+def _range_gather_level_impl(qp, qlo, qhi, qlive, level: Batch,
+                             out_cap: int):
     """Rows of one (p, time)-keyed level with key p==qp and time in
     [qlo, qhi]; returns (qrow ids, time col, val cols, weights, total)."""
     tk = level.keys[0]
@@ -62,38 +62,61 @@ def _range_gather_level(qp, qlo, qhi, qlive, level: Batch, out_cap: int):
     return qrow, t, vals, w, total
 
 
+_range_gather_level = jax.jit(_range_gather_level_impl,
+                              static_argnames=("out_cap",))
+
+
+def _range_gather_factory(out_cap: int):
+    return lambda qp, qlo, qhi, qlive, level: _range_gather_level_impl(
+        qp, qlo, qhi, qlive, level, out_cap)
+
+
 class RangeGather:
-    """Grow-on-demand driver for per-row [lo, hi] time-range gathers."""
+    """Grow-on-demand driver for per-row [lo, hi] time-range gathers.
+    Sharded levels gather per worker; the capacity check takes the worst
+    worker."""
 
     def __init__(self):
         self.caps: Dict[int, int] = {}
 
+    @staticmethod
+    def _launch(qp, qlo, qhi, qlive, level, cap):
+        if level.sharded:
+            from dbsp_tpu.parallel.lift import lifted
+
+            return lifted(_range_gather_factory, cap)(qp, qlo, qhi, qlive,
+                                                      level)
+        return _range_gather_level(qp, qlo, qhi, qlive, level, cap)
+
     def __call__(self, qp, qlo, qhi, qlive, levels, q_cap):
+        import numpy as np
+
         rows, times, vals, ws = [], [], [], []
         for level in levels:
             cap = self.caps.get(level.cap, max(64, q_cap))
-            qrow, t, v, w, total = _range_gather_level(
-                qp, qlo, qhi, qlive, level, cap)
-            tt = int(total)
+            qrow, t, v, w, total = self._launch(qp, qlo, qhi, qlive, level,
+                                                cap)
+            tt = int(np.max(jax.device_get(total)))
             if tt > cap:
                 cap = bucket_cap(tt)
                 self.caps[level.cap] = cap
-                qrow, t, v, w, total = _range_gather_level(
-                    qp, qlo, qhi, qlive, level, cap)
+                qrow, t, v, w, total = self._launch(qp, qlo, qhi, qlive,
+                                                    level, cap)
             rows.append(qrow)
             times.append(t)
             vals.append(v)
             ws.append(w)
         if not rows:
             return None
-        return (jnp.concatenate(rows), jnp.concatenate(times),
-                tuple(jnp.concatenate([v[i] for v in vals])
+        return (jnp.concatenate(rows, axis=-1),
+                jnp.concatenate(times, axis=-1),
+                tuple(jnp.concatenate([v[i] for v in vals], axis=-1)
                       for i in range(len(vals[0]))),
-                jnp.concatenate(ws))
+                jnp.concatenate(ws, axis=-1))
 
 
-@partial(jax.jit, static_argnames=("agg", "a_cap"))
-def _rolling_reduce(wrow, wt, wvals, ww, at, agg: Aggregator, a_cap: int):
+def _rolling_reduce_impl(wrow, wt, wvals, ww, at, agg: Aggregator,
+                         a_cap: int):
     """Net gathered window rows (keeping the time column so distinct input
     rows never merge), reduce per dirty slot, and require a live row at the
     slot's own timestamp for the output to exist."""
@@ -107,6 +130,73 @@ def _rolling_reduce(wrow, wt, wvals, ww, at, agg: Aggregator, a_cap: int):
     present = jax.ops.segment_max(
         jnp.where(self_live, 1, 0), seg, num_segments=a_cap + 1)
     return tuple(o[:a_cap] for o in outs), present[:a_cap] > 0
+
+
+_rolling_reduce_jit = jax.jit(_rolling_reduce_impl,
+                              static_argnames=("agg", "a_cap"))
+
+
+def _rolling_reduce_factory(agg: Aggregator, a_cap: int):
+    return lambda wrow, wt, wvals, ww, at: _rolling_reduce_impl(
+        wrow, wt, wvals, ww, at, agg, a_cap)
+
+
+def _rolling_reduce(wrow, wt, wvals, ww, at, agg, a_cap):
+    if ww.ndim > 1:  # sharded window parts
+        from dbsp_tpu.parallel.lift import lifted
+
+        return lifted(_rolling_reduce_factory, agg, a_cap)(
+            wrow, wt, wvals, ww, at)
+    return _rolling_reduce_jit(wrow, wt, wvals, ww, at, agg, a_cap)
+
+
+def _dirty_rows_impl(dp, dt, dlive, qrow, t, w):
+    """Dirty (p, t') slots: the delta's own rows plus the gathered affected
+    rows, consolidated to distinct slots (presence weights)."""
+    p_g = jnp.where(qrow >= 0, dp[jnp.clip(qrow, 0, dp.shape[0] - 1)],
+                    kernels.sentinel_for(dp.dtype))
+    p_all = jnp.concatenate([dp, p_g])
+    t_all = jnp.concatenate([dt, t])
+    keep = jnp.concatenate([dlive, (w != 0) & (qrow >= 0)])
+    cols, cw = kernels.consolidate_cols(
+        (p_all, t_all), jnp.where(keep, 1, 0).astype(jnp.int64))
+    return cols[0], cols[1], cw != 0
+
+
+_dirty_rows_jit = jax.jit(_dirty_rows_impl)
+
+
+def _dirty_rows_factory():
+    return _dirty_rows_impl
+
+
+def _dirty_rows(dp, dt, dlive, qrow, t, w):
+    if dlive.ndim > 1:
+        from dbsp_tpu.parallel.lift import lifted
+
+        return lifted(_dirty_rows_factory)(dp, dt, dlive, qrow, t, w)
+    return _dirty_rows_jit(dp, dt, dlive, qrow, t, w)
+
+
+def _dirty_delta_only_impl(dp, dt, dlive):
+    cols, cw = kernels.consolidate_cols(
+        (dp, dt), jnp.where(dlive, 1, 0).astype(jnp.int64))
+    return cols[0], cols[1], cw != 0
+
+
+_dirty_delta_only_jit = jax.jit(_dirty_delta_only_impl)
+
+
+def _dirty_delta_only_factory():
+    return _dirty_delta_only_impl
+
+
+def _dirty_delta_only(dp, dt, dlive):
+    if dlive.ndim > 1:
+        from dbsp_tpu.parallel.lift import lifted
+
+        return lifted(_dirty_delta_only_factory)(dp, dt, dlive)
+    return _dirty_delta_only_jit(dp, dt, dlive)
 
 
 class RollingAggregateOp(UnaryOperator):
@@ -157,7 +247,8 @@ class RollingAggregateOp(UnaryOperator):
     def eval(self, view: TraceView) -> Batch:
         delta = view.delta
         if int(delta.live_count()) == 0:
-            return Batch.empty(*self.out_schema)
+            return Batch.empty(*self.out_schema,
+                               lead=tuple(delta.weights.shape[:-1]))
         q_cap = delta.cap
         dp, dt = delta.keys[0], delta.keys[1]
         dlive = delta.weights != 0
@@ -170,22 +261,11 @@ class RollingAggregateOp(UnaryOperator):
         gathered = self._affected(
             dp, dt, dt + self.range_ms, dlive, key_only, q_cap)
         if gathered is None:
-            p_all = dp
-            t_all = dt
-            keep = dlive
+            ap, at, alive = _dirty_delta_only(dp, dt, dlive)
         else:
             qrow, t, _, w = gathered
-            p_g = jnp.where(
-                qrow >= 0, dp[jnp.clip(qrow, 0, dp.shape[0] - 1)],
-                kernels.sentinel_for(dp.dtype))
-            p_all = jnp.concatenate([dp, p_g])
-            t_all = jnp.concatenate([dt, t])
-            keep = jnp.concatenate([dlive, (w != 0) & (qrow >= 0)])
-        cols, cw = kernels.consolidate_cols(
-            (p_all, t_all), jnp.where(keep, 1, 0).astype(jnp.int64))
-        ap, at = cols[0], cols[1]
-        alive = cw != 0
-        a_cap = ap.shape[0]
+            ap, at, alive = _dirty_rows(dp, dt, dlive, qrow, t, w)
+        a_cap = ap.shape[-1]
 
         # 2. recompute each dirty window [t'-range, t'] — via the radix tree
         # (O(log range) gathered rows per window) when available, else a
@@ -193,7 +273,7 @@ class RollingAggregateOp(UnaryOperator):
         # input row at exactly (p, t') is live — a non-empty window alone is
         # not enough (the retraction of (p, t') must retract its output even
         # though neighbours still populate the window).
-        if self.tree is not None:
+        if self.tree is not None and not delta.sharded:
             self.tree.update(delta, view.spine.batches)
             new_vals, _range_present = self.tree.query(
                 ap, at - self.range_ms, at, alive, view.spine.batches, a_cap)
@@ -205,9 +285,9 @@ class RollingAggregateOp(UnaryOperator):
             win = self._windows(ap, at - self.range_ms, at, alive,
                                 view.spine.batches, a_cap)
             if win is None:
-                new_vals = tuple(jnp.zeros((a_cap,), d)
+                new_vals = tuple(jnp.zeros(alive.shape, d)
                                  for d in self.agg.out_dtypes)
-                new_present = jnp.zeros((a_cap,), jnp.bool_)
+                new_present = jnp.zeros(alive.shape, jnp.bool_)
             else:
                 new_vals, new_present = _rolling_reduce(
                     win[0], win[1], win[2], win[3], at, self.agg, a_cap)
@@ -215,9 +295,9 @@ class RollingAggregateOp(UnaryOperator):
         # 3. diff vs previous outputs for the dirty keys
         old = self._old((ap, at), alive, self.out_spine.batches, a_cap)
         if old is None:
-            old_vals = tuple(kernels.sentinel_fill((a_cap,), d)
+            old_vals = tuple(kernels.sentinel_fill(alive.shape, d)
                              for d in self.agg.out_dtypes)
-            old_present = jnp.zeros((a_cap,), jnp.bool_)
+            old_present = jnp.zeros(alive.shape, jnp.bool_)
         else:
             old_vals, old_present = _reduce_groups(
                 tuple(old), _TupleMax(len(self.agg.out_dtypes)), a_cap)
@@ -258,8 +338,19 @@ def partitioned_rolling_aggregate(self: Stream, agg: Aggregator,
     schema = getattr(self, "schema", None)
     assert schema is not None and len(schema[0]) == 2, (
         "partitioned_rolling_aggregate needs keys (partition, time)")
-    t = self.trace(shard=False)  # not yet shard-lifted
+    # sharded streams stay sharded: rows route by the partition column, so
+    # every partition's window lives wholly on one worker and per-worker
+    # rolling unions exactly (reference: rolling_aggregate.rs:235
+    # self-shards by partition the same way). The radix-tree fast path is
+    # host-driven per tick and not yet lifted — sharded runs use the
+    # window-recompute path (use_tree is ignored under a mesh).
+    from dbsp_tpu.circuit.runtime import Runtime
+
+    if Runtime.worker_count() > 1:
+        use_tree = False
+    t = self.trace()
     out = self.circuit.add_unary_operator(
         RollingAggregateOp(agg, range_ms, schema, name, use_tree=use_tree), t)
     out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+    out.key_sharded = getattr(t, "key_sharded", False)
     return out

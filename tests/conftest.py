@@ -51,3 +51,22 @@ def _bound_live_executables():
 
     _lifted_jit.cache_clear()
     gc.collect()
+
+
+# ---------------------------------------------------------------------------
+# Test tiers: `pytest -m fast` is the <2-minute pre-commit subset — every
+# operator's correctness oracle at small scale. Tests/modules marked `slow`
+# (compiled-path differentials, nexmark full suite, SLT corpus, parallel
+# 8-worker sweeps) are excluded from it; everything else is auto-marked
+# `fast`, so the two tiers partition the suite.
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running (excluded from the -m fast tier)")
+    config.addinivalue_line(
+        "markers", "fast: the <2-minute pre-commit correctness tier")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)

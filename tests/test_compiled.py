@@ -16,6 +16,8 @@ from dbsp_tpu.compiled import CompiledOverflow, compile_circuit
 from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator, build_inputs,
                               device_gen, queries)
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 CFG = GeneratorConfig(seed=1)
 EPT = 8          # epochs/tick -> 400 events/tick
 TICKS = 3

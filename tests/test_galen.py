@@ -10,6 +10,9 @@ import jax.numpy as jnp
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benches"))
 
 from dbsp_tpu.circuit import Runtime  # noqa: E402
+import pytest
+
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
 
 
 def galen_oracle(p, q, r, c, u, s):

@@ -20,6 +20,8 @@ from dbsp_tpu.monitor import TraceMonitor, TraceMonitorError
 from dbsp_tpu.operators import add_input_zset, Count
 from dbsp_tpu.profile import CPUProfiler
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 
 def test_csv_parser_weights_and_partials():
     p = CsvParser([jnp.int64, jnp.int32])

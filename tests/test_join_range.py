@@ -3,6 +3,7 @@
 import random
 
 import jax.numpy as jnp
+import pytest
 
 from dbsp_tpu.circuit import Runtime
 from dbsp_tpu.operators import add_input_zset
@@ -19,6 +20,7 @@ def _oracle_rel(a_rows, b_rows, lo_off, hi_off):
     return {k: w for k, w in out.items() if w != 0}
 
 
+@pytest.mark.slow
 def test_incremental_relative_range_join():
     rng = random.Random(3)
 

@@ -11,6 +11,9 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benches"))
 
 from dbsp_tpu.circuit import Runtime  # noqa: E402
+import pytest
+
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
 
 
 def test_bfs_matches_oracle():

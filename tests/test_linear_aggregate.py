@@ -81,6 +81,7 @@ AGG_SPECS = [
 ]
 
 
+@pytest.mark.slow
 def test_linear_matches_general_and_oracle():
     rng = random.Random(7)
     live = []

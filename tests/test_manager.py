@@ -10,6 +10,8 @@ import pytest
 from dbsp_tpu.client import Connection
 from dbsp_tpu.manager import PipelineManager
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 
 @pytest.fixture()
 def manager():

@@ -11,6 +11,8 @@ from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator, build_inputs,
                               queries)
 from dbsp_tpu.operators import add_input_zset
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 
 @pytest.fixture(scope="module")
 def gen():

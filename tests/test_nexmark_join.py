@@ -9,6 +9,8 @@ from dbsp_tpu.circuit import RootCircuit
 from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator, build_inputs,
                               queries)
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 
 @pytest.fixture(scope="module")
 def gen():

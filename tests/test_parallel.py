@@ -14,6 +14,8 @@ from dbsp_tpu.parallel.exchange import (exchange_local, gather_local,
                                         worker_of, worker_sharding)
 from dbsp_tpu.zset import Batch
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 
 @pytest.fixture(scope="module")
 def mesh():
@@ -211,10 +213,11 @@ def test_circuit_join_aggregate_distinct_8workers(mesh):
     assert all(want.values()), "vacuous comparison"
 
 
-def test_unlifted_ops_run_at_8workers_via_unshard(mesh):
-    """topk / rolling / window / upsert inputs are not shard-lifted yet;
-    they must still run correctly inside an 8-worker circuit (the unshard
-    fallback) with outputs identical to 1 worker."""
+def test_lifted_timeseries_topk_8workers(mesh):
+    """topk / rolling / window / watermark consume SHARDED traces (no
+    unshard round-trip — the reference's every-stateful-op-self-shards
+    contract): 8-worker outputs must equal 1 worker. The circuit is also
+    checked to contain no unshard node upstream of these operators."""
     from dbsp_tpu.circuit import Runtime
     from dbsp_tpu.operators import add_input_map, add_input_zset
     from dbsp_tpu.operators.aggregate import Sum
@@ -223,10 +226,19 @@ def test_unlifted_ops_run_at_8workers_via_unshard(mesh):
         def build(c):
             s, h = add_input_zset(c, (jnp.int64, jnp.int64), (jnp.int64,))
             m, hm = add_input_map(c, (jnp.int64,), (jnp.int64,))
+            wm = s.watermark_monotonic(lambda k, v: k[1], lateness=0)
+            bounds = wm.apply(
+                lambda w: None if w is None else (w - 100, 1 << 60),
+                name="win-bounds")
+            by_time = s.index_by(
+                lambda k, v: (k[1],), (jnp.int64,),
+                val_fn=lambda k, v: (k[0], v[0]),
+                val_dtypes=(jnp.int64, jnp.int64), name="by-time")
             return (h, hm), {
                 "topk": s.topk(2).output(),
                 "rolling": s.partitioned_rolling_aggregate(
                     Sum(0), 100).output(),
+                "window": by_time.window(bounds).output(),
                 "upsert": m.distinct().output(),
             }
 
@@ -251,9 +263,16 @@ def test_unlifted_ops_run_at_8workers_via_unshard(mesh):
                         d[r] = d.get(r, 0) + wt
                         if d[r] == 0:
                             del d[r]
-        return integrals
+        return integrals, handle.circuit
 
-    want = run(1)
-    got = run(8)
+    want, _ = run(1)
+    got, circuit8 = run(8)
     assert got == want
     assert all(want.values()), "vacuous comparison"
+    # the lifted-path property itself: NO unshard node anywhere (host
+    # output handles collapse sharded batches themselves, io_handles.py)
+    from dbsp_tpu.operators.shard_op import UnshardOp
+
+    unshards = [n for n in circuit8.nodes
+                if isinstance(n.operator, UnshardOp)]
+    assert not unshards, [n.operator.name for n in unshards]

@@ -19,6 +19,8 @@ from dbsp_tpu.timeseries.radix_tree import RadixTimeIndex
 from dbsp_tpu.trace.spine import Spine
 from dbsp_tpu.zset.batch import Batch
 
+pytestmark = pytest.mark.slow  # excluded from the -m fast pre-commit tier
+
 
 def _model_query(rows, p, lo, hi, kind):
     vals = [v for (pp, t, v), w in rows.items() if pp == p and lo <= t <= hi

@@ -38,6 +38,7 @@ def build_tc(c):
     return h, edges.recurse(f).integrate().output()
 
 
+@pytest.mark.slow
 def test_transitive_closure_chain():
     circuit, (h, out) = RootCircuit.build(build_tc)
     h.extend([(((i, i + 1)), 1) for i in range(5)])  # 0->1->2->3->4->5
@@ -46,6 +47,7 @@ def test_transitive_closure_chain():
     assert out.to_dict() == want
 
 
+@pytest.mark.slow
 def test_transitive_closure_random_and_updates():
     rng = random.Random(4)
     circuit, (h, out) = RootCircuit.build(build_tc)
@@ -64,6 +66,7 @@ def test_transitive_closure_random_and_updates():
     assert out.to_dict() == {p: 1 for p in closure_oracle(edges)}
 
 
+@pytest.mark.slow
 def test_cycle_terminates():
     circuit, (h, out) = RootCircuit.build(build_tc)
     h.extend([((0, 1), 1), ((1, 2), 1), ((2, 0), 1)])  # 3-cycle
@@ -78,6 +81,7 @@ def test_empty_input_fixedpoint_immediately():
     assert out.to_dict() == {}
 
 
+@pytest.mark.slow
 def test_incremental_epochs_random_oracle():
     """Many epochs of random inserts/deletes: the integrated recursion
     output must track the from-scratch closure after every epoch."""
@@ -98,6 +102,7 @@ def test_incremental_epochs_random_oracle():
             f"divergence with edges {sorted(edges)}"
 
 
+@pytest.mark.slow
 def test_update_work_proportional_to_delta():
     """The nested-timestamp cost contract (VERDICT #4): after a large first
     epoch, a one-edge update must process FAR fewer rows in the child than
@@ -186,6 +191,7 @@ def build_bfs(c):
     return (eh, sh), seed.recurse(f).integrate().output()
 
 
+@pytest.mark.slow
 def test_bfs_min_aggregate_incremental_epochs():
     """BFS-with-Min under recursive() on a CHANGING graph: adding a
     shortcut must retract longer distances; deleting it must restore them
@@ -217,6 +223,7 @@ def test_bfs_min_aggregate_incremental_epochs():
     assert out.to_dict() == bfs_oracle(edges, [0, 9])
 
 
+@pytest.mark.slow
 def test_bfs_min_random_epochs_oracle():
     rng = random.Random(7)
     circuit, ((eh, sh), out) = RootCircuit.build(build_bfs)
@@ -240,6 +247,7 @@ def test_bfs_min_random_epochs_oracle():
         assert out.to_dict() == bfs_oracle(edges, [0]), sorted(edges)
 
 
+@pytest.mark.slow
 def test_bfs_min_update_work_delta_proportional():
     """Epoch-2 cost contract for the nested aggregate: a one-edge update on
     a long chain must gather FAR fewer rows than the initial derivation."""
@@ -271,3 +279,31 @@ def test_bfs_min_update_work_delta_proportional():
                 if isinstance(node.operator, NestedAggregateOp))
     assert update_rows < aop2.epoch_eval_rows / 4, \
         (update_rows, aop2.epoch_eval_rows)
+
+
+# ---------------------------------------------------------------------------
+# Fast-tier oracles: the same correctness contracts at minimal scale
+# ---------------------------------------------------------------------------
+
+
+def test_transitive_closure_small_fast():
+    circuit, (h, out) = RootCircuit.build(build_tc)
+    h.extend([((0, 1), 1), ((1, 2), 1)])
+    circuit.step()
+    assert out.to_dict() == {(0, 1): 1, (0, 2): 1, (1, 2): 1}
+    h.push((1, 2), -1)  # retraction propagates through the fixedpoint
+    circuit.step()
+    assert out.to_dict() == {(0, 1): 1}
+
+
+def test_bfs_min_aggregate_small_fast():
+    """Nested-aggregate oracle at minimal scale: Min inside recursive(),
+    one shortcut insertion retracting a longer distance."""
+    circuit, ((eh, sh), out) = RootCircuit.build(build_bfs)
+    sh.push((0, 0), 1)
+    eh.extend([((0, 1), 1), ((1, 2), 1)])
+    circuit.step()
+    assert out.to_dict() == {(0, 0): 1, (1, 1): 1, (2, 2): 1}
+    eh.push((0, 2), 1)  # shortcut: node 2's distance drops 2 -> 1
+    circuit.step()
+    assert out.to_dict() == {(0, 0): 1, (1, 1): 1, (2, 1): 1}

@@ -49,6 +49,7 @@ def oracle_join(a, b):
     return out
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", range(3))
 def test_incremental_join_matches_full_reevaluation(seed):
     rng = random.Random(seed)

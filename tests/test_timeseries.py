@@ -130,6 +130,7 @@ def run_accumulated(build_query, gen, n_events, steps):
     return accum
 
 
+@pytest.mark.slow
 def test_q5(gen):
     got = run_accumulated(queries.q5, gen, 4000, 4)
     b = gen.generate(0, 4000)["bids"]
@@ -151,6 +152,7 @@ def test_q5(gen):
     assert want
 
 
+@pytest.mark.slow
 def test_q7(gen):
     got = run_accumulated(queries.q7, gen, 4000, 4)
     b = gen.generate(0, 4000)["bids"]
@@ -163,6 +165,7 @@ def test_q7(gen):
     assert want
 
 
+@pytest.mark.slow
 def test_q8(gen):
     got = run_accumulated(queries.q8, gen, 5000, 4)
     cols = gen.generate(0, 5000)
@@ -196,6 +199,7 @@ def oracle_rolling(state, agg, rng_ms):
     return out
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("agg_name", ["sum", "max", "count"])
 def test_partitioned_rolling_aggregate(agg_name):
     import random as _random
