@@ -142,10 +142,15 @@ class Connection:
     def delete_pipeline(self, name: str) -> None:
         _req(f"{self.base}/pipelines/{name}", method="DELETE")
 
-    def start_pipeline(self, name: str, program: str) -> PipelineHandle:
-        desc = _req(self.base + "/pipelines",
-                    data=json.dumps({"name": name,
-                                     "program": program}).encode(),
+    def start_pipeline(self, name: str, program: str,
+                       config: Optional[dict] = None) -> PipelineHandle:
+        """Deploy; ``config`` is a declarative pipeline config dict
+        (io/config.py — ControllerConfig fields + inputs/outputs endpoint
+        sections)."""
+        body = {"name": name, "program": program}
+        if config is not None:
+            body["config"] = config
+        desc = _req(self.base + "/pipelines", data=json.dumps(body).encode(),
                     method="POST")
         if desc.get("error"):
             raise RuntimeError(desc["error"])

@@ -2,10 +2,12 @@
 home / SQL-editor / pipeline-management pages, reduced to one dependency-free
 HTML page served by the pipeline manager at ``GET /``).
 
-Capabilities: list programs and pipelines, author a program (SQL views over
-declared tables), start/stop pipelines, push rows into a running pipeline's
-input collections, and peek output views — all over the existing REST
-surfaces (manager + per-pipeline circuit servers)."""
+Capabilities: list programs with their version + compile status, author a
+program (SQL views over declared tables), request compiles and watch the
+state machine, delete programs/pipelines (conflict errors surface inline),
+start/stop pipelines, push rows into a running pipeline's input
+collections, and peek output views — all over the existing REST surfaces
+(manager + per-pipeline circuit servers)."""
 
 CONSOLE_HTML = r"""<!doctype html>
 <html>
@@ -47,14 +49,15 @@ CONSOLE_HTML = r"""<!doctype html>
   <section>
     <h2>New program</h2>
     <label>name</label><input id="pname" value="demo"/>
-    <label>tables (JSON: {name: [columns...]})</label>
-    <textarea id="ptables">{"events": ["id", "category", "amount"]}</textarea>
+    <label>tables (JSON: {name: {columns, dtypes, key_columns}})</label>
+    <textarea id="ptables">{"events": {"columns": ["id", "category", "amount"], "dtypes": ["int64", "int64", "int64"], "key_columns": 1}}</textarea>
     <label>views (JSON: {view: "SELECT ..."})</label>
     <textarea id="psql">{"totals": "SELECT category, sum(amount) AS total FROM events GROUP BY category"}</textarea>
     <button onclick="createProgram()">Save program</button>
     <button onclick="startPipeline()">Start pipeline</button>
     <h2 style="margin-top:16px">Programs</h2>
-    <pre id="programs">-</pre>
+    <table id="programs"><tr><th>name</th><th>v</th><th>compile status</th>
+      <th></th></tr></table>
   </section>
   <section>
     <h2>Pipelines</h2>
@@ -75,22 +78,73 @@ CONSOLE_HTML = r"""<!doctype html>
 <script>
 const j = (u, opt) => fetch(u, opt).then(r => r.text()).then(t => {
   try { return JSON.parse(t); } catch (e) { return t; } });
+// build DOM nodes with textContent / addEventListener — server-controlled
+// strings (names, errors) must never be interpolated into HTML or JS
+function cell(tr, text, cls, title) {
+  const td = document.createElement('td');
+  if (cls) { const s = document.createElement('span'); s.className = cls;
+             s.textContent = text; if (title) s.title = title;
+             td.appendChild(s); }
+  else td.textContent = text;
+  tr.appendChild(td);
+  return td;
+}
+function btn(td, label, cls, fn) {
+  const b = document.createElement('button');
+  b.textContent = label; if (cls) b.className = cls;
+  b.addEventListener('click', fn);
+  td.appendChild(b);
+}
 async function refresh() {
-  document.getElementById('programs').textContent =
-      JSON.stringify(await j('/programs'), null, 1);
+  const names = await j('/programs');
+  const descs = await Promise.all(
+      (Array.isArray(names) ? names : []).map(n => j(`/programs/${encodeURIComponent(n)}`)));
+  const pt = document.getElementById('programs');
+  pt.innerHTML = '<tr><th>name</th><th>v</th><th>compile status</th>' +
+                 '<th></th></tr>';
+  for (const d of descs) {
+    const tr = document.createElement('tr');
+    cell(tr, d.name); cell(tr, d.version);
+    cell(tr, d.status,
+         d.status === 'sql_error' ? 'status-failed'
+         : d.status === 'success' ? 'status-running' : '',
+         d.error ?? '');
+    const td = cell(tr, '');
+    btn(td, 'compile', '', () => compileProgram(d.name, d.version));
+    btn(td, 'delete', 'warn', () => deleteProgram(d.name));
+    pt.appendChild(tr);
+  }
   const ps = await j('/pipelines');
   const tbl = document.getElementById('pipelines');
   tbl.innerHTML = '<tr><th>name</th><th>status</th><th>port</th>' +
                   '<th>steps</th><th></th></tr>';
   for (const p of ps) {
     const tr = document.createElement('tr');
-    tr.innerHTML = `<td>${p.name}</td>` +
-      `<td class="status-${p.status}">${p.status}${p.error ? ' — ' + p.error : ''}</td>` +
-      `<td>${p.port ?? ''}</td><td>${p.steps ?? ''}</td>` +
-      `<td><button class="warn" onclick="stopPipeline('${p.name}')">stop</button></td>`;
+    cell(tr, `${p.name} (v${p.program_version ?? '?'})`);
+    cell(tr, p.status + (p.error ? ' — ' + p.error : ''),
+         `status-${p.status}`);
+    cell(tr, p.port ?? ''); cell(tr, p.steps ?? '');
+    const td = cell(tr, '');
+    btn(td, 'stop', 'warn', () => stopPipeline(p.name));
+    btn(td, 'delete', 'warn', () => deletePipeline(p.name));
     tbl.appendChild(tr);
     if (p.port) document.getElementById('ioport').value = p.port;
   }
+}
+async function compileProgram(name, version) {
+  show(await j(`/programs/${encodeURIComponent(name)}/compile`,
+               post({ version })));
+  refresh();
+}
+async function deleteProgram(name) {
+  show(await j(`/programs/${encodeURIComponent(name)}`,
+               { method: 'DELETE' }));
+  refresh();
+}
+async function deletePipeline(name) {
+  show(await j(`/pipelines/${encodeURIComponent(name)}`,
+               { method: 'DELETE' }));
+  refresh();
 }
 async function createProgram() {
   const body = { name: val('pname'), tables: JSON.parse(val('ptables')),
@@ -104,7 +158,7 @@ async function startPipeline() {
   refresh();
 }
 async function stopPipeline(name) {
-  show(await j(`/pipelines/${name}/shutdown`, post({})));
+  show(await j(`/pipelines/${encodeURIComponent(name)}/shutdown`, post({})));
   refresh();
 }
 async function pushRows() {

@@ -1,4 +1,6 @@
 from dbsp_tpu.io.catalog import Catalog
+from dbsp_tpu.io.config import (ConfigError, attach_endpoints,
+                                build_controller, load_config)
 from dbsp_tpu.io.controller import Controller, ControllerConfig
 from dbsp_tpu.io.format import (CsvEncoder, CsvParser, JsonEncoder,
                                 JsonParser)
@@ -8,6 +10,7 @@ from dbsp_tpu.io.transport import (FileInputTransport, FileOutputTransport,
 
 __all__ = [
     "Catalog", "Controller", "ControllerConfig", "CircuitServer",
+    "ConfigError", "attach_endpoints", "build_controller", "load_config",
     "CsvParser", "CsvEncoder", "JsonParser", "JsonEncoder",
     "FileInputTransport", "FileOutputTransport",
     "KafkaInputTransport", "KafkaOutputTransport",

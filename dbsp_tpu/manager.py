@@ -72,11 +72,17 @@ def _build_fn(program: dict):
 
 
 class Pipeline:
-    """One deployed program: circuit + controller + embedded server."""
+    """One deployed program: circuit + controller + embedded server.
 
-    def __init__(self, name: str, program: dict):
+    ``config`` is an optional declarative pipeline config (io/config.py —
+    the reference's YAML ``PipelineConfig``, controller/config.rs:28-131):
+    its ControllerConfig fields tune batching/backpressure and its
+    inputs/outputs sections attach transports before the pipeline starts."""
+
+    def __init__(self, name: str, program: dict, config: Optional[dict] = None):
         self.name = name
         self.program = program
+        self.config = config
         self.status = "created"
         self.controller = None
         self.server = None
@@ -85,7 +91,7 @@ class Pipeline:
 
     def compile_and_start(self) -> None:
         from dbsp_tpu.circuit import Runtime
-        from dbsp_tpu.io import Catalog, CircuitServer, Controller
+        from dbsp_tpu.io import Catalog, CircuitServer, build_controller
         from dbsp_tpu.profile import CPUProfiler
 
         self.status = "compiling"
@@ -97,7 +103,8 @@ class Pipeline:
         for vname, out in outs.items():
             catalog.register_output(vname, out, ())
         profiler = CPUProfiler(handle.circuit)
-        self.controller = Controller(handle, catalog)
+        self.controller = build_controller(handle, catalog,
+                                           self.config or {})
         self.server = CircuitServer(self.controller, profiler=profiler)
         self.server.start()
         self.port = self.server.port
@@ -267,7 +274,8 @@ class PipelineManager:
                                     {"error": f"pipeline {name} already "
                                               f"{prev.status}"}, 409)
                             prog = mgr.programs[body["program"]]
-                            p = Pipeline(name, prog)
+                            p = Pipeline(name, prog,
+                                         config=body.get("config"))
                             mgr.pipelines[name] = p
                         try:
                             p.compile_and_start()

@@ -17,6 +17,8 @@ Semantics notes encoded here:
 """
 
 import itertools
+import json
+import os
 import random
 import sqlite3
 
@@ -314,14 +316,9 @@ def _to_sqlite(sql: str) -> str:
                   flags=re.IGNORECASE)
 
 
-def _run_cases(queries, batch: int = 250):
-    """Plan + step each chunk of queries on one circuit, compare every view
-    against sqlite. Chunking bounds the per-circuit graph and compiled-
-    executable population (see conftest's cache note)."""
-    import gc
-
-    import jax
-
+def _run_chunk(queries):
+    """Plan + step one chunk of queries on one circuit, compare every view
+    against sqlite. Returns [(query, got, want), ...] divergences."""
     rng = random.Random(99)
     data = _data(rng)
     conn = sqlite3.connect(":memory:")
@@ -331,31 +328,60 @@ def _run_cases(queries, batch: int = 250):
             f"INSERT INTO {t} VALUES ({', '.join('?' * len(cols))})",
             data[t])
 
+    def build(c):
+        ctx = SqlContext(c)
+        handles = {}
+        for t, cols in TABLES.items():
+            s, h = add_input_zset(c, (jnp.int64,),
+                                  (jnp.int64,) * (len(cols) - 1))
+            ctx.register_table(t, s, cols)
+            handles[t] = h
+        return handles, [ctx.query(q).output() for q in queries]
+
+    handle, (handles, outs) = Runtime.init_circuit(1, build)
+    for t, rows in data.items():
+        handles[t].extend([(r, 1) for r in rows])
+    handle.step()
+    failures = []
+    for q, out in zip(queries, outs):
+        got = out.to_dict()
+        want = _sqlite_expected(conn, _to_sqlite(q))
+        if got != want:
+            failures.append((q, got, want))
+    return failures
+
+
+def _run_cases(queries, batch: int = 120):
+    """Run chunks in SUBPROCESSES: beyond ~2k live compiled executables
+    XLA:CPU's compile-and-load segfaults (observed on this corpus; same
+    crash conftest bounds per-module), and in-process jax.clear_caches()
+    between chunks is not isolation enough. A fresh process per chunk is;
+    the persistent compile cache keeps re-JITs cheap."""
+    import subprocess
+    import sys
+    import tempfile
+
     failures = []
     for start in range(0, len(queries), batch):
         chunk = queries[start:start + batch]
-
-        def build(c, _chunk=chunk):
-            ctx = SqlContext(c)
-            handles = {}
-            for t, cols in TABLES.items():
-                s, h = add_input_zset(c, (jnp.int64,),
-                                      (jnp.int64,) * (len(cols) - 1))
-                ctx.register_table(t, s, cols)
-                handles[t] = h
-            return handles, [ctx.query(q).output() for q in _chunk]
-
-        handle, (handles, outs) = Runtime.init_circuit(1, build)
-        for t, rows in data.items():
-            handles[t].extend([(r, 1) for r in rows])
-        handle.step()
-        for q, out in zip(chunk, outs):
-            got = out.to_dict()
-            want = _sqlite_expected(conn, _to_sqlite(q))
-            if got != want:
-                failures.append((q, got, want))
-        jax.clear_caches()
-        gc.collect()
+        with tempfile.TemporaryDirectory() as td:
+            qf = os.path.join(td, "queries.json")
+            rf = os.path.join(td, "failures.json")
+            with open(qf, "w") as f:
+                json.dump(chunk, f)
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=root + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), qf, rf],
+                env=env, timeout=1800, capture_output=True, text=True)
+            if r.returncode != 0 or not os.path.exists(rf):
+                failures.append((f"chunk@{start} crashed rc={r.returncode}: "
+                                 f"{r.stderr[-400:]}", {}, {}))
+                continue
+            with open(rf) as f:
+                failures.extend(tuple(x) for x in json.load(f))
     return failures
 
 
@@ -377,3 +403,17 @@ def test_slt_full_corpus():
     assert not failures, (
         f"{len(failures)}/{len(queries)} queries diverge; first 3: "
         f"{failures[:3]}")
+
+
+if __name__ == "__main__":
+    # subprocess chunk runner (see _run_cases): argv = queries.json out.json
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # env alone is too late
+    with open(sys.argv[1]) as f:
+        _chunk = json.load(f)
+    _fails = _run_chunk(_chunk)
+    with open(sys.argv[2], "w") as f:
+        json.dump([[q, repr(g), repr(w)] for q, g, w in _fails], f)
