@@ -27,6 +27,7 @@ Lowering map:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -41,6 +42,51 @@ from dbsp_tpu.sql import parser as P
 AGG_CLASSES = {"count": Count, "sum": Sum, "min": Min, "max": Max,
                "avg": Average}
 
+
+@dataclasses.dataclass(frozen=True)
+class _SqlNullAgg:
+    """NULL-aware SQL aggregation for nullable (outer-joined) columns:
+    rows whose argument carries the NULL_INT marker are ignored, and a
+    group with no non-NULL rows aggregates to NULL (count: to 0) — SQL
+    semantics sqlite also implements. Only used when the query has a LEFT
+    JOIN (other queries keep the linear fast path)."""
+
+    fn: str = "sum"
+    out_dtypes = (jnp.int64,)
+    insert_combinable = False
+
+    @property
+    def name(self):
+        return f"sql-null-{self.fn}"
+
+    def reduce(self, val_cols, weights, seg, num_segments):
+        import jax
+
+        v = val_cols[0]
+        null = NULL_INT(v.dtype)
+        w = jnp.where(v == null, 0, weights)
+        wpos = jnp.maximum(w, 0)
+        cnt = jax.ops.segment_sum(wpos, seg, num_segments=num_segments)
+        if self.fn == "count":
+            return (cnt,)  # COUNT of all-NULL is 0, not NULL
+        if self.fn == "sum":
+            out = jax.ops.segment_sum(v * wpos, seg,
+                                      num_segments=num_segments)
+        elif self.fn == "min":
+            hi = jnp.iinfo(v.dtype).max
+            out = jax.ops.segment_min(jnp.where(w > 0, v, hi), seg,
+                                      num_segments=num_segments)
+        elif self.fn == "max":
+            lo = jnp.iinfo(v.dtype).min
+            out = jax.ops.segment_max(jnp.where(w > 0, v, lo), seg,
+                                      num_segments=num_segments)
+        else:  # avg — truncating division, matching Average
+            s = jax.ops.segment_sum(v * wpos, seg,
+                                    num_segments=num_segments)
+            c = jnp.maximum(cnt, 1)
+            out = jnp.where(s >= 0, s // c, -((-s) // c))
+        return (jnp.where(cnt > 0, out, jnp.asarray(null, out.dtype)),)
+
 # SQL NULL marker for outer-join padding: the dtype's MINIMUM (the maximum
 # is the engine's dead-row sentinel). Documented engine-wide convention —
 # the reference's nullable columns become (value | NULL_INT) here.
@@ -53,11 +99,16 @@ class SqlError(ValueError):
 
 
 class _Scope:
-    """Column-name resolution over a stream's (key+val) columns."""
+    """Column-name resolution over a stream's (key+val) columns.
 
-    def __init__(self, names: List[str], dtypes: List):
+    ``nullable`` holds the indices of columns that may carry the NULL_INT
+    marker (outer-join padding) — aggregate planning keys NULL-awareness
+    off it, and it propagates through joins, subqueries, and set ops."""
+
+    def __init__(self, names: List[str], dtypes: List, nullable=()):
         self.names = list(names)
         self.dtypes = list(dtypes)
+        self.nullable = frozenset(nullable)
 
     def index_of(self, col: P.Col) -> int:
         want = f"{col.table}.{col.name}" if col.table else col.name
@@ -91,6 +142,16 @@ def _collect_aggs(expr) -> List[P.Agg]:
         return _collect_aggs(expr.left) + _collect_aggs(expr.right)
     if isinstance(expr, P.NotOp):
         return _collect_aggs(expr.expr)
+    return []
+
+
+def _collect_cols(expr) -> List[P.Col]:
+    if isinstance(expr, P.Col):
+        return [expr]
+    if isinstance(expr, P.BinOp):
+        return _collect_cols(expr.left) + _collect_cols(expr.right)
+    if isinstance(expr, P.NotOp):
+        return _collect_cols(expr.expr)
     return []
 
 
@@ -200,6 +261,9 @@ class SqlContext:
             out = stream.map_rows(
                 lambda k, v: ((*k, *v), ()), flat_dts, (),
                 name=f"sql-rows-{tag}")
+            # key-then-val flattening preserves flat column order
+            out._sql_nullable_cols = set(
+                getattr(stream, "_sql_nullable_cols", ()))
         out._sql_names = list(names)
         return out
 
@@ -245,6 +309,9 @@ class SqlContext:
         else:  # except
             out = a.distinct().antijoin(b)
         out._sql_names = list(a_names)
+        out._sql_nullable_cols = (
+            set(getattr(a, "_sql_nullable_cols", ()))
+            | set(getattr(b, "_sql_nullable_cols", ())))
         return out
 
     def _plan_select(self, ast: P.Select) -> Stream:
@@ -282,7 +349,8 @@ class SqlContext:
             names = getattr(sub, "_sql_names", None) or \
                 [f"col{i}" for i in range(len(dtypes))]
             return sub, _Scope(
-                [f"{ref.alias}.{n.split('.')[-1]}" for n in names], dtypes)
+                [f"{ref.alias}.{n.split('.')[-1]}" for n in names], dtypes,
+                nullable=getattr(sub, "_sql_nullable_cols", ()))
         if ref.name not in self.tables:
             raise SqlError(f"unknown table {ref.name}")
         stream, cols = self.tables[ref.name]
@@ -355,8 +423,14 @@ class SqlContext:
                 name=f"sql-leftpad{n}")
             joined = joined.plus(missing)
             joined.schema = ((key_dt,), (*ls.dtypes, *rs.dtypes))
+        rbase = 1 + len(ls.names)
+        nullable = {1 + i for i in ls.nullable} | \
+            {rbase + i for i in rs.nullable}
+        if join.left:
+            # every right-side column may now carry the NULL pad
+            nullable |= {rbase + i for i in range(len(rs.names))}
         scope = _Scope([f"__jk{n}__", *ls.names, *rs.names],
-                       [key_dt, *ls.dtypes, *rs.dtypes])
+                       [key_dt, *ls.dtypes, *rs.dtypes], nullable=nullable)
         return joined, scope
 
     def _fold_range_join(self, join, left, ls, right, rs, n: int):
@@ -400,8 +474,11 @@ class SqlContext:
             rkeyed, lo_c, hi_c,
             lambda lk, lv, rk, rv: (lk, (*lv, *rv)),
             (key_dt,), (*ls.dtypes, *rs.dtypes), name=f"sql-rangejoin{n}")
+        rbase = 1 + len(ls.names)
         scope = _Scope([f"__jk{n}__", *ls.names, *rs.names],
-                       [key_dt, *ls.dtypes, *rs.dtypes])
+                       [key_dt, *ls.dtypes, *rs.dtypes],
+                       nullable={1 + i for i in ls.nullable}
+                       | {rbase + i for i in rs.nullable})
         return joined, scope
 
     # -- scalar subqueries ---------------------------------------------------
@@ -453,6 +530,7 @@ class SqlContext:
                        if not (n.startswith("__") and n.endswith("__"))]
             if len(visible) == len(scope.names):
                 stream._sql_names = list(scope.names)
+                stream._sql_nullable_cols = set(scope.nullable)
                 return stream
             out = stream.map_rows(
                 lambda k, v, _i=tuple(visible): (
@@ -460,6 +538,8 @@ class SqlContext:
                 tuple(scope.dtypes[i] for i in visible), (),
                 name="sql-star")
             out._sql_names = [scope.names[i] for i in visible]
+            out._sql_nullable_cols = {j for j, i in enumerate(visible)
+                                      if i in scope.nullable}
             return out
         fns, dts = [], []
         for item in ast.items:
@@ -475,6 +555,13 @@ class SqlContext:
 
         out = stream.map_rows(project, tuple(dts), (), name="sql-project")
         out._sql_names = _item_names(ast.items)
+        # an output column may be NULL if its expression references any
+        # nullable column (for bare columns this is exact; for arithmetic
+        # the value is transformed but downstream must still be wary)
+        out._sql_nullable_cols = {
+            j for j, item in enumerate(ast.items)
+            if any(scope.index_of(c) in scope.nullable
+                   for c in _collect_cols(item.expr))}
         return out
 
     def _plan_aggregate(self, ast: P.Select, stream: Stream, scope: _Scope
@@ -500,27 +587,55 @@ class SqlContext:
                 aggs.append((None, ha))
                 selected.append(ha)
 
+        def _null_refs(agg: P.Agg):
+            """Scope indices of NULLABLE columns the agg arg references."""
+            if agg.arg is None:
+                return []
+            return [i for i in (scope.index_of(c)
+                                for c in _collect_cols(agg.arg))
+                    if i in scope.nullable]
+
         def keyed_stream(agg: P.Agg) -> Stream:
             if agg.arg is None:
                 arg_fn, arg_dt = (lambda cols: jnp.ones_like(cols[0])), \
                     np.dtype(np.int64)
             else:
                 arg_fn, arg_dt = _compile_expr(agg.arg, scope)
+            nrefs = tuple(_null_refs(agg))
 
-            def mapper(k, v, _f=arg_fn):
+            def mapper(k, v, _f=arg_fn, _n=nrefs, _dt=arg_dt):
                 cols = (*k, *v)
                 keys = tuple(cols[i] for i in group_idx) or \
                     (jnp.zeros_like(cols[0]),)
-                return keys, (jnp.broadcast_to(_f(cols), cols[0].shape),)
+                out = jnp.broadcast_to(_f(cols), cols[0].shape)
+                if _n:
+                    # SQL NULL propagation: an expression over a NULL input
+                    # is NULL — re-mark rows whose referenced nullable cols
+                    # carry the pad BEFORE arithmetic transformed it
+                    isnull = jnp.zeros(cols[0].shape, jnp.bool_)
+                    for i in _n:
+                        isnull = isnull | (
+                            cols[i] == NULL_INT(scope.dtypes[i]))
+                    out = jnp.where(isnull,
+                                    jnp.asarray(NULL_INT(_dt),
+                                                jnp.dtype(_dt)), out)
+                return keys, (out,)
 
             return stream.map_rows(mapper, tuple(key_dts), (arg_dt,),
                                    name="sql-keyed")
 
+        # an aggregate is NULL-aware iff its argument references a column
+        # an outer join could have padded (SQL semantics: aggregates skip
+        # NULLs; all-NULL groups aggregate to NULL). Everything else keeps
+        # the linear fast path.
         results = []
         for pos, agg in aggs:
             ks = keyed_stream(agg)
-            cls = AGG_CLASSES[agg.fn]
-            inst = cls() if agg.fn == "count" else cls(0)
+            if _null_refs(agg):
+                inst = _SqlNullAgg(agg.fn)
+            else:
+                cls = AGG_CLASSES[agg.fn]
+                inst = cls() if agg.fn == "count" else cls(0)
             results.append(ks.aggregate(inst, name=f"sql-{agg.fn}"))
         combined = results[0]
         for extra in results[1:]:
@@ -580,6 +695,14 @@ class SqlContext:
         out = combined.map_rows(finalize, tuple(out_dts), (),
                                 name="sql-finalize")
         out._sql_names = _item_names(ast.items)
+        # NULL-aware aggregates can emit NULL (all-NULL groups); group
+        # columns inherit their source column's nullability
+        out._sql_nullable_cols = {
+            pos for pos, item in enumerate(ast.items)
+            if (pos in agg_positions and isinstance(item.expr, P.Agg)
+                and _null_refs(item.expr))
+            or (pos not in agg_positions
+                and scope.index_of(item.expr) in scope.nullable)}
         return out
 
     def _plan_topk(self, ast: P.Select, stream: Stream) -> Stream:

@@ -307,3 +307,59 @@ def test_bfs_min_aggregate_small_fast():
     eh.push((0, 2), 1)  # shortcut: node 2's distance drops 2 -> 1
     circuit.step()
     assert out.to_dict() == {(0, 0): 1, (1, 1): 1, (2, 1): 1}
+
+
+def build_nested_nested(c):
+    """Recursion INSIDE recursion (depth-2 nested clocks — the reference's
+    Product<NestedTimestamp, _> shape, time/product.rs): the outer
+    fixedpoint extends paths over the INNER fixedpoint's closure of the
+    edge deltas. The inner child resets per outer iteration (correct
+    iterate-style semantics; cross-outer-iteration incrementality of the
+    inner scope is future work)."""
+    edges, h = add_input_zset(c, [jnp.int64], [jnp.int64])
+
+    def f(child, R):
+        e = child.import_stream(edges)
+
+        def g(child2, S):
+            s_by_dst = S.index_by(
+                lambda k, v: (v[0],), (jnp.int64,),
+                val_fn=lambda k, v: (k[0],), val_dtypes=(jnp.int64,),
+                name="inner-by-dst")
+            e2 = child2.import_stream(e)
+            return s_by_dst.join_index(
+                e2, lambda k, sv, ev: ((sv[0],), (ev[0],)),
+                (jnp.int64,), (jnp.int64,), name="inner-extend")
+
+        inner = e.recurse(g)
+        r_by_dst = R.index_by(
+            lambda k, v: (v[0],), (jnp.int64,),
+            val_fn=lambda k, v: (k[0],), val_dtypes=(jnp.int64,),
+            name="outer-by-dst")
+        return r_by_dst.join_index(
+            inner, lambda k, rv, iv: ((rv[0],), (iv[0],)),
+            (jnp.int64,), (jnp.int64,), name="outer-extend")
+
+    return h, edges.recurse(f).integrate().output()
+
+
+@pytest.mark.slow
+def test_recursion_inside_recursion_epochs():
+    """Depth-2 nested clocks across CHANGING inputs: outer closure over the
+    inner closure equals the plain transitive closure at every epoch
+    (closure is idempotent — closure(closure(E)) == closure(E))."""
+    circuit, (h, out) = RootCircuit.build(build_nested_nested)
+    edges = {(0, 1), (1, 2), (2, 3)}
+    h.extend([(e, 1) for e in edges])
+    circuit.step()
+    assert out.to_dict() == {p: 1 for p in closure_oracle(edges)}
+
+    h.push((3, 4), 1)           # epoch 2: extend the chain
+    edges.add((3, 4))
+    circuit.step()
+    assert out.to_dict() == {p: 1 for p in closure_oracle(edges)}
+
+    h.push((1, 2), -1)          # epoch 3: cut the chain
+    edges.discard((1, 2))
+    circuit.step()
+    assert out.to_dict() == {p: 1 for p in closure_oracle(edges)}

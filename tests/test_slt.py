@@ -294,6 +294,17 @@ def _extended_cases():
     # 3-way predicate combinations over t1
     for p1, p2, p3 in itertools.combinations(PREDS1, 3):
         qs.append(f"SELECT a, c FROM t1 WHERE ({p1}) and (({p2}) or ({p3}))")
+    # NULL-aware aggregation over outer-join padding — direct, through
+    # expression arguments (NULL must propagate through arithmetic), and
+    # through FROM-subqueries (nullability crosses the subquery boundary)
+    for agg in ("sum", "avg", "min", "max", "count"):
+        qs.append(f"SELECT t1.a, {agg}(t2.y) AS v FROM t1 "
+                  "LEFT JOIN t2 ON t1.a = t2.x GROUP BY t1.a")
+        qs.append(f"SELECT t1.a, {agg}(t2.y + 1) AS v FROM t1 "
+                  "LEFT JOIN t2 ON t1.a = t2.x GROUP BY t1.a")
+        qs.append(f"SELECT s.k, {agg}(s.v) AS w FROM "
+                  "(SELECT t1.a AS k, t2.y AS v FROM t1 "
+                  "LEFT JOIN t2 ON t1.a = t2.x) s GROUP BY s.k")
     return qs
 
 
