@@ -185,6 +185,8 @@ def _supervise() -> int:
         print(line)
         sys.stdout.flush()
         return 0
+    if line and parsed is None:
+        notes.append(f"accel: unparseable line {line[:160]!r}")
     if parsed and parsed.get("detail", {}).get("error"):
         notes.append(f"accel: {parsed['detail']['error'][:160]}")
         if parsed.get("detail", {}).get("events"):
@@ -211,6 +213,13 @@ def _supervise() -> int:
             print(json.dumps(parsed))
             sys.stdout.flush()
             return 0
+        if line and parsed is None:
+            # child produced output that fails to parse: surface the raw
+            # line in the notes instead of dropping it silently
+            notes.append(f"accel: unparseable line {line[:160]!r}")
+        if parsed and parsed.get("detail", {}).get("events") \
+                and parsed.get("detail", {}).get("error"):
+            partial_accel = line  # retry crashed mid-run but measured
     if cpu_line:
         print(cpu_line)
         sys.stdout.flush()
@@ -251,7 +260,17 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
                                   queries)
 
     total, batch, _, warm_ticks = _knobs(platform)
-    validate_every = int(os.environ.get("BENCH_VALIDATE_EVERY", 8))
+    # CPU: a validation is one cheap host fetch, and frequent validations
+    # keep trace level-0 small (it only drains at validation points —
+    # maintenance). That trade only pays on state-heavy queries (q4's
+    # per-tick l0 merge scales with l0 capacity); small-state queries run
+    # sub-2ms ticks where even a ~1ms validation is measurable overhead,
+    # so they keep a long cadence. Over the tunnel each fetch costs ~90ms:
+    # long cadence everywhere.
+    big_state = qname in ("q4", "q5", "q6", "q7", "q9")
+    validate_every = int(os.environ.get(
+        "BENCH_VALIDATE_EVERY",
+        2 if platform == "cpu" and big_state else 8))
     query = getattr(queries, qname)
     # device generation needs whole 50-event epochs; warmup needs >= 1 tick
     # for capacity discovery + presize
@@ -307,7 +326,7 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     ch.run_ticks(0, warm_ticks, validate_every=1,
                  on_validated=warm_progress, project_ratio=4.0)
     # residual projection from the last warm tick's validated requirements
-    ch.presize(run_len / warm_ticks)
+    ch.presize(run_len / warm_ticks, interval=validate_every)
     # one post-presize tick so the measured run starts on a compiled program
     ch.run_ticks(warm_ticks, 1, validate_every=1, project_ratio=4.0)
     detail["warmup_s"] = round(_time.perf_counter() - t0, 3)
@@ -329,9 +348,12 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         _debug(f"[{qname}] measured through tick {next_tick - 1} "
                f"({detail['elapsed_s']}s, {detail['events']} events)")
 
+    # snapshots copy the full state (donated buffers) — amortize to one
+    # copy per ~16 ticks; replay-on-overflow widens to that window
+    snap_every = max(1, 16 // validate_every)
     ch.run_ticks(m0, ticks, validate_every=validate_every,
                  on_validated=progress, block_each=True, scan=scan,
-                 project_ratio=4.0)
+                 project_ratio=4.0, snapshot_every=snap_every)
     ch.block()
     elapsed = _time.perf_counter() - t0
     measured = ticks * batch

@@ -69,7 +69,9 @@ from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 # through the requirement/grow machinery; these only seed the ladder.
 # Read at CTrace construction time (not import) so harnesses that know the
 # planned run length can pick K before compiling — see levels_for_run().
-TRACE_LEVELS = int(os.environ.get("DBSP_TPU_TRACE_LEVELS", "4"))
+# Clamped to >= 1: K=0 would make every levels fan-out (join_levels /
+# gather_levels) trace over an empty sequence and fail obscurely.
+TRACE_LEVELS = max(1, int(os.environ.get("DBSP_TPU_TRACE_LEVELS", "4")))
 LEVEL0_CAP = int(os.environ.get("DBSP_TPU_TRACE_L0", "1024"))
 # growth 4 measured 42% faster steady-state than 8 on Nexmark q4/CPU at the
 # default protocol (11.5k vs 8.1k ev/s; p99 1.6s vs 2.0s; growth 3 within
@@ -97,10 +99,26 @@ def levels_for_run(ticks: int) -> int:
 
 
 class _Leveled:
-    """Mixin managing a leveled static trace state: a tuple of K consolidated
-    batches (level 0 smallest, last = tail). Capacity keys are "l0".."l{K-2}"
-    plus the subclass's ``TAIL_KEY`` (which keeps its legacy name so
-    MONOTONE_CAPS / presize semantics carry over unchanged)."""
+    """Mixin managing a leveled static trace state: ``(levels, base_live)``
+    where ``levels`` is a tuple of K consolidated batches (level 0 smallest,
+    last = tail) and ``base_live`` is a device scalar carrying the frozen
+    live-row count of levels 1..K-1. Capacity keys are "l0".."l{K-2}" plus
+    the subclass's ``TAIL_KEY`` (which keeps its legacy name so
+    MONOTONE_CAPS / presize semantics carry over unchanged).
+
+    Spill scheduling is HOST-DRIVEN: the per-tick program only merges the
+    delta into level 0 (one native two-pointer merge on CPU) and touches
+    nothing else — levels 1..K-1 flow through the step function unmodified,
+    so XLA aliases them instead of copying. Draining level k into level k+1
+    happens BETWEEN validated intervals in ``CompiledHandle.maintain()``
+    (an earlier in-program ``lax.cond`` cascade copied every level's full
+    capacity on every non-spill tick: measured ~10ms/tick per trace at q4
+    state sizes — the reference runs its spine merges on background fuel
+    for the same reason, spine_fueled.rs:1-81). Because only level 0
+    changes inside an interval, ``base_live`` stays exact between
+    maintenance points and the whole-trace size requirement (what presize's
+    monotone projection keys off) costs one O(cap_l0) reduction per tick.
+    """
 
     TAIL_KEY = "trace"
 
@@ -113,54 +131,39 @@ class _Leveled:
             self.caps.setdefault(key, bucket_cap(cap))
             cap *= LEVEL_GROWTH
 
-    def _levels_init(self, schema, lead, migrated: Optional[Batch]
-                     ) -> Tuple[Batch, ...]:
+    def _levels_init(self, schema, lead, migrated: Optional[Batch]):
         lv = [Batch.empty(*schema, cap=self.caps[k], lead=lead)
               for k in self.level_keys]
+        base = 0
         if migrated is not None:
             # warm start: the host spine's consolidated state becomes the tail
             lv[-1] = migrated.with_cap(self.caps[self.TAIL_KEY])
-        return tuple(lv)
+            base = int(migrated.max_worker_live())
+        return (tuple(lv), jnp.full(lead, base, jnp.int64))
 
-    def _levels_append(self, ctx, levels: Tuple[Batch, ...], delta: Batch
-                       ) -> Tuple[Batch, ...]:
-        """Merge a delta into level 0, then cascade half-full spills upward.
+    def _levels_append(self, ctx, state, delta: Batch):
+        """Merge a delta into level 0 (the only in-program state write).
 
-        Every level registers its requirement every tick (receiving level:
-        live(self)+live(below) — a conservative preview, so capacity grows
-        BEFORE the spill that would overflow it); the spill itself runs
-        under ``lax.cond`` so non-spill ticks pay only the live-count sums.
+        Registers two requirements: level 0's live count (drained each
+        maintenance interval, so its running max is the per-interval
+        inflow) and the whole-trace size (base_live + level-0 live) under
+        ``TAIL_KEY`` — the monotone capacity presize projects linearly.
         """
-        from jax import lax
-
+        levels, base = state
         new = list(levels)
         m0 = new[0].merge_with(delta)
-        ctx.require(self, self.level_keys[0], m0.live_count())
+        live0 = m0.live_count()
+        ctx.require(self, self.level_keys[0], live0)
+        if self.TAIL_KEY != self.level_keys[0]:
+            ctx.require(self, self.TAIL_KEY, base + live0)
         new[0] = m0.with_cap(self.caps[self.level_keys[0]])
-        # the tail must eventually absorb every level, so its requirement is
-        # the TOTAL live count — the whole-trace size metric (GC plateau
-        # checks and presize's monotone projection both key off it)
-        total = sum(b.live_count() for b in new)
-        for k in range(len(new) - 1):
-            lk, lk1 = new[k], new[k + 1]
-            lk_live = lk.live_count()
-            receiver = self.level_keys[k + 1]
-            ctx.require(self, receiver,
-                        total if receiver == self.TAIL_KEY
-                        else lk1.live_count() + lk_live)
-            spill = lk_live * 2 >= lk.cap
-            new[k], new[k + 1] = lax.cond(
-                spill,
-                lambda ab: (ab[0].masked(False),
-                            ab[1].merge_with(ab[0]).with_cap(ab[1].cap)),
-                lambda ab: ab,
-                (lk, lk1))
-        return tuple(new)
+        return (tuple(new), base)
 
-    def _levels_repad(self, levels: Tuple[Batch, ...]) -> Tuple[Batch, ...]:
-        return tuple(
+    def _levels_repad(self, state):
+        levels, base = state
+        return (tuple(
             b.with_cap(self.caps[k]) if b.cap != self.caps[k] else b
-            for b, k in zip(levels, self.level_keys))
+            for b, k in zip(levels, self.level_keys)), base)
 
 
 def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
@@ -189,6 +192,7 @@ def join_levels(delta: Batch, levels: Sequence[Batch], nk: int, fn,
     validation grows the cap and replays."""
     from dbsp_tpu.operators.join import _join_level_impl
 
+    assert levels, "join_levels: trace has no levels (TRACE_LEVELS >= 1)"
     j = jnp.arange(out_cap, dtype=jnp.int32)
     bufs, wbuf = None, None
     offset = jnp.asarray(0, jnp.int32)
@@ -220,6 +224,7 @@ def gather_levels(qkeys, qlive, levels: Sequence[Batch], out_cap: int):
     must net them (``_reduce_groups_impl(..., net=True)``)."""
     from dbsp_tpu.operators.aggregate import _gather_level_impl
 
+    assert levels, "gather_levels: trace has no levels (TRACE_LEVELS >= 1)"
     q_cap = qlive.shape[-1]
     j = jnp.arange(out_cap, dtype=jnp.int32)
     qbuf = jnp.full((out_cap,), jnp.int32(q_cap))
@@ -423,7 +428,7 @@ class CTrace(CNode, _Leveled):
     def eval(self, ctx, state, inputs):
         delta = inputs[0]
         post = self._levels_append(ctx, state, delta)
-        return post, CView(delta=delta, pre=state, post=post)
+        return post, CView(delta=delta, pre=state[0], post=post[0])
 
 
 class CJoin(CNode):

@@ -43,6 +43,7 @@ around a compiled core).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -148,12 +149,28 @@ def _cnode_for(node) -> CNode:
         "equivalent yet — run this circuit on the host-driven path")
 
 
+@jax.jit
+def _copy_tree(tree):
+    """Deep-copy a state pytree in ONE dispatch (eager per-leaf jnp.copy
+    costs a dispatch per column, ~100 leaves per circuit)."""
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+@partial(jax.jit, static_argnums=(2,), donate_argnums=(0, 1))
+def _drain_pair(receiver: Batch, source: Batch, cap: int):
+    """One maintenance drain as a single jitted dispatch (eager Batch ops
+    cost ~10 dispatches each; this runs every few validation intervals on
+    every leveled trace, so dispatch overhead was measurable)."""
+    return receiver.merge_with(source).with_cap(cap), source.masked(False)
+
+
 class CompiledHandle:
     """Drives a compiled circuit: step / validate / grow / snapshot-replay."""
 
     def __init__(self, circuit, gen_fn: Optional[Callable] = None,
                  runtime=None):
         self.circuit = circuit
+        self.runtime = runtime  # needed for sharded host-side maintenance
         self.mesh = getattr(runtime, "mesh", None)
         self.workers = getattr(runtime, "workers", 1)
         self.order = static_schedule(circuit)
@@ -216,20 +233,29 @@ class CompiledHandle:
         for idx, bound in ctx.gc_bounds.items():
             key = str(idx)
             if key in new_states:  # a leveled trace: truncate every level
-                new_states[key] = tuple(
+                levels, base = new_states[key]
+                # base_live goes stale-high until the next maintenance
+                # recomputes it — conservative for capacity requirements
+                new_states[key] = (tuple(
                     cnodes.truncate_below(lvl, bound)
-                    for lvl in new_states[key])
+                    for lvl in levels), base)
         req = (jnp.stack(ctx.reqs) if ctx.reqs
                else jnp.zeros((0,), jnp.int64))
         self._checks = ctx.req_index  # same order every trace
         return new_states, ctx.outputs, req
 
     def _make_step(self):
+        # states are DONATED: levels past 0 (and any untouched state) flow
+        # through the program unmodified, and donation lets XLA alias them
+        # input->output instead of copying — without it every tick paid a
+        # full copy of all trace state (~tens of MB at q4 scale, measured
+        # as the dominant steady-state cost). The flip side: snapshots
+        # must be real copies (see snapshot()).
         if self.mesh is None:
             def step_fn(states, tick, feeds):
                 return self._run_nodes(states, tick, feeds)
 
-            return jax.jit(step_fn)
+            return jax.jit(step_fn, donate_argnums=(0,))
 
         # SPMD: ONE shard_map around the whole eval sequence. Inside, every
         # batch is its [cap_local] worker slice, operators run their plain
@@ -259,7 +285,7 @@ class CompiledHandle:
                 out_specs=(W, W, W))(states, tick, feeds)
             return ns, outs, jnp.max(reqw, axis=0)
 
-        return jax.jit(step_fn)
+        return jax.jit(step_fn, donate_argnums=(0,))
 
     def _make_scan(self, n: int):
         """A jitted program running ``n`` ticks of the eval sequence inside
@@ -304,7 +330,7 @@ class CompiledHandle:
             return ns, outs, req
 
         if self.mesh is None:
-            return jax.jit(_scan_body)
+            return jax.jit(_scan_body, donate_argnums=(0,))
 
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -328,7 +354,7 @@ class CompiledHandle:
                 out_specs=(W, W, W))(states, t0)
             return ns, outs, jnp.max(reqw, axis=0)
 
-        return jax.jit(scan_fn)
+        return jax.jit(scan_fn, donate_argnums=(0,))
 
     def step_scanned(self, t0: int, n: int, block: bool = False) -> None:
         """Run ticks [t0, t0+n) as one scanned dispatch (see _make_scan).
@@ -392,12 +418,152 @@ class CompiledHandle:
         if items:
             raise CompiledOverflow(items)
 
-    def presize(self, ratio: float, safety: float = 1.3) -> None:
+    def _req_value(self, cn: CNode, key: str) -> Optional[int]:
+        """The last validated requirement for (cn, key), if any."""
+        if getattr(self, "last_req", None) is None:
+            return None
+        for (c, k), r in zip(self._checks, self.last_req):
+            if c is cn and k == key:
+                return int(r)
+        return None
+
+    def maintain(self) -> bool:
+        """Host-side spine maintenance: drain half-full trace levels into
+        the next level, between validated intervals (the compiled-mode
+        analog of the reference's background spine merger,
+        spine_fueled.rs:1-81 — there fuel amortizes merges across steps;
+        here the step program never touches levels past 0 at all, and this
+        method runs the actual merges outside the hot program, one native
+        two-pointer merge each).
+
+        State stays VALID throughout (rows only move between levels whose
+        union is the trace), so no replay is needed — but a receiving
+        level's capacity may grow, which invalidates the compiled programs
+        (next step re-traces). Returns True when that happened.
+
+        Drain policy (the LSM discipline): a level is due when half-full;
+        draining into a receiver that would itself become due cascades the
+        receiver onward FIRST, so chains terminate at the tail — the only
+        level whose capacity this method normally grows. Growing middle
+        levels instead would quietly absorb every cascade: the tail would
+        never compact and the middle of the ladder would balloon toward
+        the tail's size."""
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        changed = False
+        prev_rt = Runtime._swap(self.runtime) if self.mesh is not None \
+            else None
+        try:
+            for cn in self.cnodes:
+                if not isinstance(cn, cnodes._Leveled):
+                    continue
+                key = str(cn.node.index)
+                st = self.states.get(key)
+                if st is None:
+                    continue
+                levels, base = st
+                K = len(levels)
+                if K == 1:
+                    continue
+                levels = list(levels)
+                # Host-cached live counts: fetching them from the device
+                # would dispatch one eager O(cap) reduction per level per
+                # trace per interval (measured as a double-digit share of
+                # steady-state time at q4 scale). Level 0 is the only
+                # level the step program writes, and its validated
+                # REQUIREMENT is exactly its live count at validation —
+                # already fetched. Deeper levels only change in this
+                # method, which maintains the cache (drain sums are upper
+                # bounds — netting may shrink the real count; an over-
+                # estimate only triggers an early drain, never an error).
+                cache = getattr(cn, "_live_cache", None)
+                if cache is None or len(cache) != K:
+                    cache = [int(b.max_worker_live()) for b in levels]
+                lives = cache
+                req = self._req_value(cn, cn.level_keys[0])
+                if req is not None:
+                    lives[0] = req
+                # dispatch-free fast path: with cached lives the drain-due
+                # check is host arithmetic — most intervals touch nothing
+                if not any(lives[k] and lives[k] * 2 >= levels[k].cap
+                           for k in range(K - 1)):
+                    cn._live_cache = lives
+                    continue
+
+                def drain(k):
+                    nonlocal changed
+                    if k + 1 < K - 1 and \
+                            (lives[k] + lives[k + 1]) * 2 > levels[k + 1].cap:
+                        drain(k + 1)  # make room downstream first
+                    need = lives[k] + lives[k + 1]
+                    rk1 = cn.level_keys[k + 1]
+                    if need > cn.caps[rk1]:
+                        # tail growth (or an inverted ladder after l0 grew
+                        # past an initial middle level): non-tail receivers
+                        # get headroom to absorb further drains
+                        cn.caps[rk1] = bucket_cap(
+                            need if k + 1 == K - 1 else need * 2)
+                        changed = True
+                    levels[k + 1], levels[k] = _drain_pair(
+                        levels[k + 1], levels[k], cn.caps[rk1])
+                    lives[k + 1] = need  # upper bound (netting may shrink)
+                    lives[k] = 0
+
+                for k in range(K - 2, -1, -1):
+                    if lives[k] and lives[k] * 2 >= levels[k].cap:
+                        drain(k)
+                cn._live_cache = lives
+                base_val = sum(lives[1:])
+                self.states[key] = (tuple(levels),
+                                    jnp.full_like(base, base_val))
+        finally:
+            if self.mesh is not None:
+                Runtime._swap(prev_rt)
+        if changed:
+            self._step_jit = None
+            self._scan_jits = {}
+        return changed
+
+    def _enforce_ladders(self) -> bool:
+        """Re-establish geometric level capacities between l0 and the tail.
+
+        Requirement-driven growth sizes l0 (per-interval inflow) and the
+        tail (whole-trace projection) but says nothing about the middle
+        levels; without this they collapse toward l0's size and every
+        drain cascades straight into the tail (observed: an all-32768
+        ladder under a 1M tail merging the tail every ~4 ticks)."""
+        changed = False
+        for cn in self.cnodes:
+            if not isinstance(cn, cnodes._Leveled):
+                continue
+            keys = cn.level_keys
+            if len(keys) < 3:
+                continue
+            lo, hi = cn.caps[keys[0]], cn.caps[keys[-1]]
+            if hi <= lo:
+                continue
+            g = (hi / lo) ** (1.0 / (len(keys) - 1))
+            for k in range(1, len(keys) - 1):
+                target = bucket_cap(int(lo * g ** k))
+                if target > cn.caps[keys[k]]:
+                    cn.caps[keys[k]] = target
+                    changed = True
+        return changed
+
+    def presize(self, ratio: float, safety: float = 1.3,
+                interval: int = 1) -> None:
         """Scale capacities for a run ~``ratio``x longer than what produced
         the last validated requirements: monotone capacities (traces, group
         gathers — they integrate the stream) are projected linearly; stable
         ones (join fan-outs — per-delta) just get doubled headroom. One
-        re-trace now instead of a grow/replay ladder mid-measurement."""
+        re-trace now instead of a grow/replay ladder mid-measurement.
+
+        ``interval`` is the validation cadence of the RUN being presized
+        for: a leveled trace's level 0 only drains at validation points
+        (maintain), so it must hold ``interval`` ticks of inflow — warmup
+        validates every tick, making its observed l0 requirement a
+        per-tick figure that would otherwise overflow (and grow/replay)
+        on the first measured interval."""
         if getattr(self, "last_req", None) is None:
             return
         changed = False
@@ -405,11 +571,18 @@ class CompiledHandle:
             r = int(r)
             if r <= 0:
                 continue
-            target = int(r * ratio * safety) if key in cn.MONOTONE_CAPS \
-                else 2 * r
+            is_l0 = isinstance(cn, cnodes._Leveled) and \
+                len(cn.level_keys) > 1 and key == cn.level_keys[0]
+            if is_l0:
+                target = int(r * max(1, interval) * safety)
+            elif key in cn.MONOTONE_CAPS:
+                target = int(r * ratio * safety)
+            else:
+                target = 2 * r
             if bucket_cap(target) > cn.caps[key]:
                 cn.caps[key] = bucket_cap(target)
                 changed = True
+        changed |= self._enforce_ladders()
         if changed:
             snap = self.snapshot()
             self._step_jit = None
@@ -439,33 +612,49 @@ class CompiledHandle:
             # later, smaller item shrink the grown cap
             cn.caps[key] = max(cn.caps[key],
                                bucket_cap(int(required * factor)))
+        self._enforce_ladders()
         self._step_jit = None
         self._scan_jits = {}
         self._req = None
 
     def snapshot(self) -> Dict[str, Any]:
-        """A restorable reference-copy of the current (validated) states."""
-        return dict(self.states)
+        """A restorable DEEP copy of the current (validated) states.
+
+        Step programs donate their state buffers (input->output aliasing
+        is what keeps untouched trace levels copy-free per tick), so a
+        reference snapshot would be invalidated by the very next step —
+        the copy here is the price of in-place stepping, paid per
+        snapshot interval instead of per tick."""
+        return _copy_tree(dict(self.states))
 
     def restore(self, snap: Dict[str, Any]) -> None:
-        """Restore a snapshot, re-padding trace states to the current
-        capacities (no-op when capacities haven't changed)."""
-        states = dict(snap)
+        """Restore a snapshot (copying again — the snapshot must survive
+        the restored states being donated), re-padding trace states to the
+        current capacities (no-op when capacities haven't changed)."""
+        states = _copy_tree(dict(snap))
         for cn in self.cnodes:
             key = str(cn.node.index)
             if key in states:
                 states[key] = cn.repad_state(states[key])
+            # cached live counts may UNDER-estimate the rewound state
+            # (drains moved rows since the snapshot) — maintain() must
+            # refetch exact counts or its drain could slice live rows
+            cn._live_cache = None
         self.states = states
 
     # -- checkpointed run -----------------------------------------------------
     def run_ticks(self, t0: int, n: int, validate_every: int = 16,
                   on_validated: Optional[Callable] = None,
                   block_each: bool = False, scan: bool = False,
-                  project_ratio: float = 1.0) -> None:
+                  project_ratio: float = 1.0,
+                  snapshot_every: int = 1) -> None:
         """Run ticks [t0, t0+n) under a ``gen_fn`` with periodic validation
         and snapshot/replay on overflow (exact: inputs are functions of the
         tick index). ``on_validated(next_tick)`` fires after each validated
-        interval. ``block_each`` waits per tick so ``step_times_ns`` records
+        interval — with ``snapshot_every > 1`` an overflow replays every
+        interval since the last snapshot, RE-firing the callback for
+        already-reported ticks; callbacks must be idempotent per tick
+        (record "progress through tick N", don't accumulate). ``block_each`` waits per tick so ``step_times_ns`` records
         true per-tick latency instead of dispatch time (a bare device sync is
         ~0.1ms even over the tunnel; only data fetches are expensive).
 
@@ -474,8 +663,9 @@ class CompiledHandle:
         / chunk length. ``project_ratio`` is handed to :meth:`grow` so an
         overflow mid-run jumps monotone capacities to end-of-run size."""
         assert self._gen_fn is not None, "run_ticks needs a gen_fn"
-        snap = self.snapshot()
+        snap, snap_t = self.snapshot(), t0
         t = t0
+        iv = 0
         while t < t0 + n:
             upto = min(t + validate_every, t0 + n)
             if scan:
@@ -488,9 +678,17 @@ class CompiledHandle:
             except CompiledOverflow as e:
                 self.grow(e, project_ratio=project_ratio)
                 self.restore(snap)
-                continue  # replay the interval at the new capacities
-            snap = self.snapshot()
+                t = snap_t
+                continue  # replay from the snapshot at the new capacities
+            self.maintain()  # state stays valid; may re-trace next step
+            iv += 1
             t = upto
+            if iv % max(1, snapshot_every) == 0:
+                # snapshots are O(state) copies (states are donated) —
+                # coarser cadence amortizes them; the replay window on a
+                # rare overflow widens accordingly, which determinism makes
+                # exact either way
+                snap, snap_t = self.snapshot(), t
             if on_validated is not None:
                 on_validated(t)
 

@@ -122,6 +122,11 @@ def consolidate_cols(cols: Sequence[jnp.ndarray], weights: jnp.ndarray
     net weight is zero, and packs survivors to the front. Output capacity ==
     input capacity; tail rows are dead (weight 0, sentinel keys).
     """
+    if cols and weights.ndim == 1 and merge_strategy() == "native":
+        from dbsp_tpu.zset import native_merge
+
+        if native_merge.supports(c.dtype for c in cols):
+            return native_merge.consolidate_cols_native(cols, weights)
     cap = weights.shape[0]
     cols, (weights,) = sort_rows(cols, (weights,))
     dup = rows_equal_prev(cols, n=cap)
@@ -143,13 +148,21 @@ def merge_strategy() -> str:
     ``rank`` (cross-rank binary-search merge) does O(log n) *dependent*
     gather passes — cheap on TPU where a bitonic ``lax.sort`` costs
     O(n log^2 n) full passes of HBM traffic, but measurably SLOWER than the
-    XLA:CPU native sort (one fused C++ quicksort). So: rank-merge on
-    accelerators, sort-based consolidation on CPU. (Measured on Nexmark q4:
-    rank-merge on CPU regressed spine merges ~8x.)
+    XLA:CPU native sort (one fused C++ quicksort). So on accelerators:
+    rank-merge. On CPU: a ``jax.pure_callback`` into the native two-pointer
+    merge (native/zset_merge.cpp) — already-sorted runs need no sort, and
+    XLA:CPU's comparator-based multi-operand sort measured ~50x slower than
+    the C++ walk at spine-tail shapes (1.2s vs ~25ms for 1.5M rows x 7
+    cols). ``sort`` remains the fallback when the native library can't
+    build or a column dtype (float) isn't int64-widenable.
     """
     import jax
 
-    return "sort" if jax.default_backend() == "cpu" else "rank"
+    if jax.default_backend() != "cpu":
+        return "rank"
+    from dbsp_tpu.zset import native_merge
+
+    return "native" if native_merge.available() else "sort"
 
 
 def merge_sorted_cols(cols_a: Sequence[jnp.ndarray], w_a: jnp.ndarray,
@@ -173,7 +186,16 @@ def merge_sorted_cols(cols_a: Sequence[jnp.ndarray], w_a: jnp.ndarray,
     """
     if not cols_a:  # zero-column (unit-row) sets: nothing to order
         return consolidate_cols((), jnp.concatenate([w_a, w_b]))
-    if merge_strategy() == "sort":
+    strategy = merge_strategy()
+    if strategy == "native":
+        from dbsp_tpu.zset import native_merge
+
+        if w_a.ndim == 1 and \
+                native_merge.supports(c.dtype for c in cols_a):
+            return native_merge.merge_consolidated_cols(cols_a, w_a,
+                                                        cols_b, w_b)
+        strategy = "sort"
+    if strategy == "sort":
         cols = tuple(jnp.concatenate([a, b.astype(a.dtype)])
                      for a, b in zip(cols_a, cols_b))
         return consolidate_cols(cols, jnp.concatenate([w_a, w_b]))
@@ -272,6 +294,14 @@ def lex_probe(table_cols: Tuple[jnp.ndarray, ...],
     at log2(n) probe indices per query. Unrolled loop — n is static under jit.
     """
     assert table_cols, "lex_probe requires at least one key column"
+    if table_cols[0].ndim == 1 and query_cols[0].ndim == 1 and \
+            merge_strategy() == "native":
+        from dbsp_tpu.zset import native_merge
+
+        if native_merge.supports(c.dtype for c in (*table_cols,
+                                                   *query_cols)):
+            return native_merge.lex_probe_native(table_cols, query_cols,
+                                                 side)
     n = table_cols[0].shape[0]
     m = query_cols[0].shape[0]
     lo = jnp.zeros((m,), jnp.int32)

@@ -1,0 +1,312 @@
+// Two-pointer merge of two CONSOLIDATED Z-set runs (sorted lexicographic,
+// live rows packed at the front, dead tail at weight 0) into one consolidated
+// run of capacity na+nb.
+//
+// This is the CPU-backend replacement for the XLA sort-based merge in
+// dbsp_tpu/zset/kernels.py::merge_sorted_cols: XLA:CPU's multi-operand
+// lax.sort is comparator-based (measured ~1.2s for a 1.5M-row 7-column
+// merge), while a sequential two-pointer walk over already-sorted runs is
+// O(n) memcpy-bound (~tens of ms at the same shape). The TPU backend keeps
+// the pure-XLA rank-merge path — this library is never loaded there.
+//
+// Exposed two ways:
+//   * zset_merge — plain C ABI (ctypes; tests and host-side callers),
+//   * ZsetMergeFfi — an XLA FFI handler (jax.ffi.ffi_call) so compiled
+//     circuit programs hit the C++ directly from inside XLA with zero
+//     Python round-trip. (A jax.pure_callback route was tried first and
+//     deadlocks XLA:CPU's executor when converting >=8MB operands on the
+//     callback thread.)
+//
+// Semantics mirror the XLA path exactly (reference analog: the pairwise
+// batch merger, crates/dbsp/src/trace/ord/merge_batcher.rs):
+//   * rows equal on all columns get their weights summed,
+//   * rows whose net weight is zero are dropped,
+//   * survivors pack to the front, dead tail carries per-column sentinels.
+//
+// All columns arrive widened to int64 (sign-extension preserves order for
+// every integer/bool dtype); the caller re-narrows and supplies each
+// column's original-dtype sentinel value (as int64).
+
+#include <cstdint>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace {
+
+void merge_impl(int64_t ncols, int64_t na, int64_t nb,
+                const int64_t** acols, const int64_t* aw,
+                const int64_t** bcols, const int64_t* bw,
+                const int64_t* sentinels,
+                int64_t** ocols, int64_t* ow) {
+  // live prefixes (consolidated invariant: live rows packed at the front)
+  int64_t la = 0, lb = 0;
+  while (la < na && aw[la] != 0) la++;
+  while (lb < nb && bw[lb] != 0) lb++;
+
+  int64_t i = 0, j = 0, o = 0;
+  const int64_t cap = na + nb;
+  while (i < la && j < lb) {
+    int cmp = 0;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const int64_t av = acols[c][i], bv = bcols[c][j];
+      if (av != bv) { cmp = av < bv ? -1 : 1; break; }
+    }
+    if (cmp < 0) {
+      for (int64_t c = 0; c < ncols; ++c) ocols[c][o] = acols[c][i];
+      ow[o++] = aw[i++];
+    } else if (cmp > 0) {
+      for (int64_t c = 0; c < ncols; ++c) ocols[c][o] = bcols[c][j];
+      ow[o++] = bw[j++];
+    } else {
+      const int64_t w = aw[i] + bw[j];
+      if (w != 0) {
+        for (int64_t c = 0; c < ncols; ++c) ocols[c][o] = acols[c][i];
+        ow[o++] = w;
+      }
+      ++i; ++j;
+    }
+  }
+  for (; i < la; ++i) {
+    for (int64_t c = 0; c < ncols; ++c) ocols[c][o] = acols[c][i];
+    ow[o++] = aw[i];
+  }
+  for (; j < lb; ++j) {
+    for (int64_t c = 0; c < ncols; ++c) ocols[c][o] = bcols[c][j];
+    ow[o++] = bw[j];
+  }
+  for (int64_t c = 0; c < ncols; ++c) {
+    const int64_t s = sentinels[c];
+    int64_t* col = ocols[c];
+    for (int64_t k = o; k < cap; ++k) col[k] = s;
+  }
+  for (int64_t k = o; k < cap; ++k) ow[k] = 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void zset_merge(int64_t ncols, int64_t na, int64_t nb,
+                const int64_t** acols, const int64_t* aw,
+                const int64_t** bcols, const int64_t* bw,
+                const int64_t* sentinels,
+                int64_t** ocols, int64_t* ow) {
+  merge_impl(ncols, na, nb, acols, aw, bcols, bw, sentinels, ocols, ow);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// XLA FFI handler
+// ---------------------------------------------------------------------------
+
+namespace ffi = xla::ffi;
+
+// Argument layout: [a_col_0..a_col_{n-1}, a_w, b_col_0..b_col_{n-1}, b_w,
+// sentinels]; results: [o_col_0..o_col_{n-1}, o_w]. ncols is inferred from
+// the result count, so one registered target serves every schema.
+static ffi::Error ZsetMergeImpl(ffi::RemainingArgs args,
+                                ffi::RemainingRets rets) {
+  const int64_t ncols = static_cast<int64_t>(rets.size()) - 1;
+  if (ncols < 1 ||
+      args.size() != static_cast<size_t>(2 * ncols + 3)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_merge: argument/result count mismatch");
+  }
+  std::vector<const int64_t*> acols(ncols), bcols(ncols);
+  std::vector<int64_t*> ocols(ncols);
+  int64_t na = 0, nb = 0;
+  for (int64_t c = 0; c < ncols; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    auto b = args.get<ffi::Buffer<ffi::DataType::S64>>(ncols + 1 + c);
+    auto o = rets.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!a.has_value() || !b.has_value() || !o.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_merge: S64 buffer expected");
+    }
+    acols[c] = a->typed_data();
+    bcols[c] = b->typed_data();
+    ocols[c] = o.value()->typed_data();
+  }
+  auto aw = args.get<ffi::Buffer<ffi::DataType::S64>>(ncols);
+  auto bw = args.get<ffi::Buffer<ffi::DataType::S64>>(2 * ncols + 1);
+  auto sent = args.get<ffi::Buffer<ffi::DataType::S64>>(2 * ncols + 2);
+  auto ow = rets.get<ffi::Buffer<ffi::DataType::S64>>(ncols);
+  if (!aw.has_value() || !bw.has_value() || !sent.has_value() ||
+      !ow.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_merge: S64 buffer expected");
+  }
+  na = static_cast<int64_t>(aw->element_count());
+  nb = static_cast<int64_t>(bw->element_count());
+  merge_impl(ncols, na, nb, acols.data(), aw->typed_data(),
+             bcols.data(), bw->typed_data(), sent->typed_data(),
+             ocols.data(), ow.value()->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetMergeFfi, ZsetMergeImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
+// ---------------------------------------------------------------------------
+// Lexicographic searchsorted (the probe kernel)
+// ---------------------------------------------------------------------------
+//
+// Replaces the XLA unrolled binary search in kernels.lex_probe on CPU: that
+// loop pays ceil(log2 n) rounds of ncols clamped gathers over the whole
+// query vector (measured ~175ms per 16k-query probe of a 1M-row trace);
+// a plain C++ per-query binary search is ~1ms at the same shape.
+//
+// Argument layout: [t_col_0..t_col_{k-1}, q_col_0..q_col_{k-1}, side]
+// (side: S64[1], 0 = left/strict, 1 = right). Result: [pos S32[m]].
+
+static ffi::Error ZsetProbeImpl(ffi::RemainingArgs args,
+                                ffi::RemainingRets rets) {
+  const int64_t k = (static_cast<int64_t>(args.size()) - 1) / 2;
+  if (k < 1 || args.size() != static_cast<size_t>(2 * k + 1) ||
+      rets.size() != 1) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_probe: argument/result count mismatch");
+  }
+  std::vector<const int64_t*> tcols(k), qcols(k);
+  int64_t n = 0, m = 0;
+  for (int64_t c = 0; c < k; ++c) {
+    auto t = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    auto q = args.get<ffi::Buffer<ffi::DataType::S64>>(k + c);
+    if (!t.has_value() || !q.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_probe: S64 buffer expected");
+    }
+    tcols[c] = t->typed_data();
+    qcols[c] = q->typed_data();
+    n = static_cast<int64_t>(t->element_count());
+    m = static_cast<int64_t>(q->element_count());
+  }
+  auto side = args.get<ffi::Buffer<ffi::DataType::S64>>(2 * k);
+  auto pos = rets.get<ffi::Buffer<ffi::DataType::S32>>(0);
+  if (!side.has_value() || !pos.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_probe: bad side/result buffer");
+  }
+  const bool right = side->typed_data()[0] != 0;
+  int32_t* out = pos.value()->typed_data();
+  for (int64_t i = 0; i < m; ++i) {
+    // go_right(mid): table[mid] < q (left) or <= q (right)
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+      const int64_t mid = (lo + hi) >> 1;
+      int cmp = 0;  // table[mid] vs q_i
+      for (int64_t c = 0; c < k; ++c) {
+        const int64_t tv = tcols[c][mid], qv = qcols[c][i];
+        if (tv != qv) { cmp = tv < qv ? -1 : 1; break; }
+      }
+      const bool go_right = right ? cmp <= 0 : cmp < 0;
+      if (go_right) lo = mid + 1; else hi = mid;
+    }
+    out[i] = static_cast<int32_t>(lo);
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetProbeFfi, ZsetProbeImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
+// ---------------------------------------------------------------------------
+// Consolidation of an UNSORTED run (argsort + net + pack)
+// ---------------------------------------------------------------------------
+//
+// Replaces kernels.consolidate_cols' multi-operand lax.sort on CPU (the
+// comparator-based sort is the per-tick cost of every map/filter/index/join
+// output in a compiled circuit; std::sort over an index array is ~5-10x
+// cheaper at those shapes).
+//
+// Argument layout: [col_0..col_{k-1}, weights, sentinels]; results:
+// [o_col_0..o_col_{k-1}, o_weights]. Semantics identical to the XLA path:
+// sort rows lexicographically, sum weights of equal rows, drop zero-weight
+// rows, pack survivors, sentinel tail.
+
+#include <algorithm>
+#include <numeric>
+
+static ffi::Error ZsetConsolidateImpl(ffi::RemainingArgs args,
+                                      ffi::RemainingRets rets) {
+  const int64_t k = static_cast<int64_t>(rets.size()) - 1;
+  if (k < 1 || args.size() != static_cast<size_t>(k + 2)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_consolidate: argument/result count mismatch");
+  }
+  std::vector<const int64_t*> cols(k);
+  std::vector<int64_t*> ocols(k);
+  int64_t n = 0;
+  for (int64_t c = 0; c < k; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    auto o = rets.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!a.has_value() || !o.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_consolidate: S64 buffer expected");
+    }
+    cols[c] = a->typed_data();
+    ocols[c] = o.value()->typed_data();
+    n = static_cast<int64_t>(a->element_count());
+  }
+  auto w = args.get<ffi::Buffer<ffi::DataType::S64>>(k);
+  auto sent = args.get<ffi::Buffer<ffi::DataType::S64>>(k + 1);
+  auto ow = rets.get<ffi::Buffer<ffi::DataType::S64>>(k);
+  if (!w.has_value() || !sent.has_value() || !ow.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_consolidate: bad weights/sentinel buffer");
+  }
+  const int64_t* wv = w->typed_data();
+  int64_t* owv = ow.value()->typed_data();
+
+  // order live rows only (dead rows would sort by sentinel anyway)
+  std::vector<int64_t> idx;
+  idx.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    if (wv[i] != 0) idx.push_back(i);
+  }
+  std::sort(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
+    for (int64_t c = 0; c < k; ++c) {
+      const int64_t av = cols[c][a], bv = cols[c][b];
+      if (av != bv) return av < bv;
+    }
+    return false;
+  });
+  int64_t o = 0;
+  const int64_t live = static_cast<int64_t>(idx.size());
+  for (int64_t s = 0; s < live;) {
+    int64_t e = s + 1;
+    while (e < live) {
+      bool eq = true;
+      for (int64_t c = 0; c < k; ++c) {
+        if (cols[c][idx[s]] != cols[c][idx[e]]) { eq = false; break; }
+      }
+      if (!eq) break;
+      ++e;
+    }
+    int64_t sum = 0;
+    for (int64_t j = s; j < e; ++j) sum += wv[idx[j]];
+    if (sum != 0) {
+      for (int64_t c = 0; c < k; ++c) ocols[c][o] = cols[c][idx[s]];
+      owv[o++] = sum;
+    }
+    s = e;
+  }
+  const int64_t* sv = sent->typed_data();
+  for (int64_t c = 0; c < k; ++c) {
+    int64_t* col = ocols[c];
+    for (int64_t j = o; j < n; ++j) col[j] = sv[c];
+  }
+  for (int64_t j = o; j < n; ++j) owv[j] = 0;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetConsolidateFfi, ZsetConsolidateImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());

@@ -363,10 +363,11 @@ def test_compiled_sharded_scan_mode():
 
 
 def test_compiled_leveled_trace_spills_match_host(monkeypatch):
-    """The in-program spine under stress: tiny level capacities force the
-    half-full spill cascade (lax.cond merges) to fire at every level many
-    times, across every leveled consumer (join/aggregate/linear/distinct via
-    q4) — output must still match the host path tick for tick.
+    """The leveled spine under stress: tiny level capacities force the
+    host-driven maintenance drains (CompiledHandle.maintain) to fire at
+    every level many times, across every leveled consumer (join/aggregate/
+    linear/distinct via q4) — output must still match the host path tick
+    for tick.
 
     Reference contract: the fueled spine's amortized merging never changes
     observable trace contents (trace/spine_fueled.rs:1-81)."""
@@ -374,15 +375,38 @@ def test_compiled_leveled_trace_spills_match_host(monkeypatch):
 
     monkeypatch.setattr(_cn, "LEVEL0_CAP", 16)
     monkeypatch.setattr(_cn, "LEVEL_GROWTH", 2)
+    # K=2 (l0 + tail): every maintenance drain lands in the TAIL, so six
+    # ticks provably exercise the drain-to-tail path (deep ladders only
+    # reach the tail after ~g^K intervals — out of scope for a 6-tick run)
+    monkeypatch.setattr(_cn, "TRACE_LEVELS", 2)
     ticks = 6
     host = _host_run(_q4_build, ticks=ticks)
     comp, ch = _compiled_run(_q4_build, ticks=ticks)
     assert comp == host
-    # the stress point actually ran: some trace tail received a spill
+    # the stress point actually ran: some trace tail received a drain
     def tail_live(cn):
-        lv = ch.states.get(str(cn.node.index))
-        if isinstance(cn, _cn.CAggregate):
-            lv = lv[0]
-        return int(lv[-1].live_count())
+        levels, _base = ch.states.get(str(cn.node.index))
+        return int(levels[-1].live_count())
     leveled = [cn for cn in ch.cnodes if isinstance(cn, _cn._Leveled)]
     assert leveled and any(tail_live(cn) > 0 for cn in leveled)
+
+
+def test_compiled_deep_ladder_matches_host(monkeypatch):
+    """Same stress with the full 4-level ladder: drains cascade through
+    middle levels (not necessarily reaching the tail in a short run) and
+    outputs still match the host path tick for tick."""
+    from dbsp_tpu.compiled import cnodes as _cn
+
+    monkeypatch.setattr(_cn, "LEVEL0_CAP", 16)
+    monkeypatch.setattr(_cn, "LEVEL_GROWTH", 2)
+    monkeypatch.setattr(_cn, "TRACE_LEVELS", 4)
+    ticks = 6
+    host = _host_run(_q4_build, ticks=ticks)
+    comp, ch = _compiled_run(_q4_build, ticks=ticks)
+    assert comp == host
+    # drains happened somewhere past level 0
+    def deeper_live(cn):
+        levels, _base = ch.states.get(str(cn.node.index))
+        return sum(int(b.live_count()) for b in levels[1:])
+    leveled = [cn for cn in ch.cnodes if isinstance(cn, _cn._Leveled)]
+    assert leveled and any(deeper_live(cn) > 0 for cn in leveled)
