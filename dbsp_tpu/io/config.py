@@ -67,9 +67,15 @@ def load_config(cfg) -> dict:
     if not isinstance(cfg, str):
         raise ConfigError(f"unsupported config object {type(cfg).__name__}")
     text = cfg
-    if os.path.exists(cfg) or cfg.endswith((".yaml", ".yml", ".json")):
-        with open(cfg) as f:
-            text = f.read()
+    if cfg.endswith((".yaml", ".yml", ".json")) or os.path.exists(cfg):
+        # an extension-named (or existing) string is a PATH: a missing file
+        # is a config error with the path in it, not inline text fed to the
+        # YAML parser
+        try:
+            with open(cfg) as f:
+                text = f.read()
+        except OSError as e:
+            raise ConfigError(f"cannot read config file {cfg!r}: {e}") from e
     try:
         import yaml  # YAML is a JSON superset: one parser covers both
 

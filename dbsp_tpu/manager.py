@@ -235,7 +235,8 @@ class PipelineManager:
                         self._json([p.describe()
                                     for p in mgr.pipelines.values()])
                 elif len(parts) == 3 and parts[1] == "pipelines":
-                    p = mgr.pipelines.get(parts[2])
+                    with mgr.lock:
+                        p = mgr.pipelines.get(parts[2])
                     if p is None:
                         self._json({"error": "not found"}, 404)
                     else:
@@ -251,9 +252,11 @@ class PipelineManager:
                         self._json(mgr.upsert_program(body["name"], body))
                     elif len(parts) == 3 and parts[1] == "programs":
                         body = self._body()
-                        if parts[2] not in mgr.programs:
+                        out = mgr.upsert_program(parts[2], body,
+                                                 update_only=True)
+                        if out is None:
                             return self._json({"error": "not found"}, 404)
-                        self._json(mgr.upsert_program(parts[2], body))
+                        self._json(out)
                     elif len(parts) == 4 and parts[1] == "programs" \
                             and parts[3] == "compile":
                         body = self._body()
@@ -323,12 +326,20 @@ class PipelineManager:
     def _code_of(body: dict) -> dict:
         return {"tables": body.get("tables"), "sql": body.get("sql")}
 
-    def upsert_program(self, name: str, body: dict) -> dict:
+    def upsert_program(self, name: str, body: dict,
+                       update_only: bool = False) -> Optional[dict]:
         """Create, or update-with-version-bump when the CODE changed
-        (db/mod.rs:436-468: description-only edits keep the version)."""
+        (db/mod.rs:436-468: description-only edits keep the version).
+
+        ``update_only`` makes a missing program return None instead of
+        creating it — the existence check belongs under this lock (a bare
+        route-level check raced concurrent DELETEs, silently turning an
+        update into a create)."""
         with self.lock:
             prev = self.programs.get(name)
             if prev is None:
+                if update_only:
+                    return None
                 prog = dict(body, name=name, version=1, status="none",
                             error=None)
                 self.programs[name] = prog

@@ -19,8 +19,11 @@ incremental view maintenance over streams:
                                                to control grouping)
     source := table | ( query ) alias         (FROM-subqueries)
 
-with integer/float literals, + - * / %, comparisons, BETWEEN, AND/OR/NOT,
-aggregates COUNT(*) / COUNT / SUM / MIN / MAX / AVG, and scalar subqueries
+with integer/float/string/'NULL' literals, + - * / %, comparisons, BETWEEN,
+AND/OR/NOT, ``IS [NOT] NULL``, ``[NOT] IN (literal-list | SELECT ...)``,
+``[NOT] EXISTS (SELECT ...)`` (correlated equality predicates decorrelate
+onto semijoin keys), ``[NOT] LIKE 'pat'`` over strings, aggregates
+COUNT(*) / COUNT / SUM / MIN / MAX / AVG, and scalar subqueries
 ``(SELECT <aggregate> FROM ...)`` as comparison operands. The planner
 (``sql/planner.py``) lowers the AST onto circuit operators — ORDER BY +
 LIMIT onto top-K, LEFT JOIN onto join + antijoin, BETWEEN joins onto
@@ -36,14 +39,15 @@ import re
 from typing import List, Optional, Tuple, Union
 
 TOKEN_RE = re.compile(
-    r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<id>[A-Za-z_][A-Za-z_0-9]*)"
+    r"\s*(?:(?P<str>'(?:[^']|'')*')|(?P<num>\d+\.\d+|\d+)"
+    r"|(?P<id>[A-Za-z_][A-Za-z_0-9]*)"
     r"|(?P<op><=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.))")
 
 KEYWORDS = {"select", "distinct", "from", "join", "on", "where", "group",
             "by", "as", "and", "or", "not", "count", "sum", "min", "max",
             "avg", "having", "order", "limit", "asc", "desc", "left",
             "outer", "inner", "between", "union", "except", "intersect",
-            "all"}
+            "all", "null", "is", "in", "exists", "like"}
 
 
 def tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -55,7 +59,10 @@ def tokenize(sql: str) -> List[Tuple[str, str]]:
                 raise SyntaxError(f"bad SQL at: {sql[pos:pos+20]!r}")
             break
         pos = m.end()
-        if m.group("num"):
+        if m.group("str"):
+            # SQL string literal: '' escapes a quote
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("num"):
             out.append(("num", m.group("num")))
         elif m.group("id"):
             word = m.group("id")
@@ -77,7 +84,7 @@ class Col:
 
 @dataclasses.dataclass
 class Lit:
-    value: Union[int, float]
+    value: Union[int, float, str, None]  # None == SQL NULL
 
 
 @dataclasses.dataclass
@@ -103,7 +110,53 @@ class Subquery:
     select: "Select"      # scalar subquery (single aggregate, no grouping)
 
 
-Expr = Union[Col, Lit, BinOp, NotOp, Agg, Subquery]
+@dataclasses.dataclass
+class IsNull:
+    """``expr IS [NOT] NULL``."""
+
+    expr: "Expr"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InList:
+    """``expr [NOT] IN (lit, lit, ...)``."""
+
+    expr: "Expr"
+    values: List["Lit"] = dataclasses.field(default_factory=list)
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InSubquery:
+    """``expr [NOT] IN (SELECT single_column ...)`` — lowered onto the
+    incremental semijoin/antijoin pair (operators/semijoin.py)."""
+
+    expr: "Expr"
+    select: "Query" = None
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class ExistsOp:
+    """``[NOT] EXISTS (SELECT ... [WHERE sub.c = outer.c ...])`` — the
+    correlated equality predicates are decorrelated onto semijoin keys."""
+
+    select: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class LikeOp:
+    """``expr [NOT] LIKE 'pattern'`` over string-typed expressions."""
+
+    expr: "Expr"
+    pattern: str = ""
+    negated: bool = False
+
+
+Expr = Union[Col, Lit, BinOp, NotOp, Agg, Subquery, IsNull, InList,
+             InSubquery, ExistsOp, LikeOp]
 
 
 @dataclasses.dataclass
@@ -345,6 +398,12 @@ class Parser:
     def negation(self) -> Expr:
         if self.accept("kw", "not"):
             return NotOp(self.negation())
+        if self.peek() == ("kw", "exists"):
+            self.next()
+            self.expect("op", "(")
+            sub = self.query_body()
+            self.expect("op", ")")
+            return ExistsOp(sub)
         return self.comparison()
 
     def comparison(self) -> Expr:
@@ -359,7 +418,50 @@ class Parser:
             self.expect("kw", "and")
             hi = self.additive()
             return BinOp("and", BinOp(">=", e, lo), BinOp("<=", e, hi))
+        if t == ("kw", "is"):  # e IS [NOT] NULL
+            self.next()
+            negated = self.accept("kw", "not")
+            self.expect("kw", "null")
+            return IsNull(e, negated)
+        negated = False
+        if t == ("kw", "not"):  # e NOT IN / e NOT LIKE
+            save = self.i
+            self.next()
+            if self.peek() not in (("kw", "in"), ("kw", "like")):
+                self.i = save
+                return e
+            negated = True
+            t = self.peek()
+        if t == ("kw", "in"):
+            self.next()
+            self.expect("op", "(")
+            if self.peek() == ("kw", "select"):
+                sub = self.query_body()
+                self.expect("op", ")")
+                return InSubquery(e, sub, negated)
+            vals = [self._literal()]
+            while self.accept("op", ","):
+                vals.append(self._literal())
+            self.expect("op", ")")
+            return InList(e, vals, negated)
+        if t == ("kw", "like"):
+            self.next()
+            pat = self.expect("str")[1]
+            return LikeOp(e, pat, negated)
         return e
+
+    def _literal(self) -> Lit:
+        t = self.next()
+        if t[0] == "num":
+            return Lit(float(t[1]) if "." in t[1] else int(t[1]))
+        if t[0] == "str":
+            return Lit(t[1])
+        if t == ("kw", "null"):
+            return Lit(None)
+        if t == ("op", "-"):
+            n = self.expect("num")[1]
+            return Lit(-(float(n) if "." in n else int(n)))
+        raise SyntaxError(f"expected literal, got {t}")
 
     def additive(self) -> Expr:
         e = self.multiplicative()
@@ -386,6 +488,12 @@ class Parser:
         if t[0] == "num":
             self.next()
             return Lit(float(t[1]) if "." in t[1] else int(t[1]))
+        if t[0] == "str":
+            self.next()
+            return Lit(t[1])
+        if t == ("kw", "null"):
+            self.next()
+            return Lit(None)
         if t[0] == "op" and t[1] == "(":
             self.next()
             if self.peek() == ("kw", "select"):  # scalar subquery

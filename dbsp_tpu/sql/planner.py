@@ -98,17 +98,63 @@ class SqlError(ValueError):
     pass
 
 
+class SqlStrings:
+    """Append-only string dictionary shared by every string column of one
+    SqlContext (the engine-wide VARCHAR design: variable-length text is
+    dictionary-encoded on the host, fixed-width int64 codes flow on device —
+    the same scheme Nexmark q21/q22 use in ``nexmark/strings.py``, promoted
+    to a planner type). Codes carry EQUALITY only (=, <>, IN, GROUP BY,
+    JOIN); ordering comparisons over strings are rejected at plan time
+    because code order is arrival order. LIKE snapshots the dictionary at
+    trace time into a code set (exact for data registered before planning;
+    a stream that first introduces a string AFTER a LIKE was planned needs
+    a replan — documented limitation)."""
+
+    def __init__(self):
+        self._codes: Dict[str, int] = {}
+        self._strs: List[str] = []
+
+    def encode(self, s: str) -> int:
+        code = self._codes.get(s)
+        if code is None:
+            code = len(self._strs)
+            self._codes[s] = code
+            self._strs.append(s)
+        return code
+
+    def decode(self, code: int) -> Optional[str]:
+        if code == NULL_INT(np.int64) or code < 0 or \
+                code >= len(self._strs):
+            return None
+        return self._strs[int(code)]
+
+    def like_codes(self, pattern: str) -> List[int]:
+        """Codes of all known strings matching a SQL LIKE pattern
+        (% = any run, _ = any one char)."""
+        import re
+
+        rx = re.compile("^" + "".join(
+            ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+            for ch in pattern) + "$")
+        return [i for i, s in enumerate(self._strs) if rx.match(s)]
+
+
 class _Scope:
     """Column-name resolution over a stream's (key+val) columns.
 
     ``nullable`` holds the indices of columns that may carry the NULL_INT
-    marker (outer-join padding) — aggregate planning keys NULL-awareness
-    off it, and it propagates through joins, subqueries, and set ops."""
+    marker (outer-join padding) — NULL-awareness in predicates,
+    projections, and aggregates keys off it. ``strs`` holds the indices of
+    dictionary-encoded string columns; ``strings`` is the owning
+    dictionary. All three propagate through joins, subqueries, set ops."""
 
-    def __init__(self, names: List[str], dtypes: List, nullable=()):
+    def __init__(self, names: List[str], dtypes: List, nullable=(),
+                 strs=(), strings: Optional[SqlStrings] = None):
         self.names = list(names)
         self.dtypes = list(dtypes)
         self.nullable = frozenset(nullable)
+        self.strs = frozenset(strs)
+        self.strings = strings
 
     def index_of(self, col: P.Col) -> int:
         want = f"{col.table}.{col.name}" if col.table else col.name
@@ -142,6 +188,8 @@ def _collect_aggs(expr) -> List[P.Agg]:
         return _collect_aggs(expr.left) + _collect_aggs(expr.right)
     if isinstance(expr, P.NotOp):
         return _collect_aggs(expr.expr)
+    if isinstance(expr, (P.IsNull, P.InList, P.LikeOp)):
+        return _collect_aggs(expr.expr)
     return []
 
 
@@ -151,6 +199,8 @@ def _collect_cols(expr) -> List[P.Col]:
     if isinstance(expr, P.BinOp):
         return _collect_cols(expr.left) + _collect_cols(expr.right)
     if isinstance(expr, P.NotOp):
+        return _collect_cols(expr.expr)
+    if isinstance(expr, (P.IsNull, P.InList, P.LikeOp)):
         return _collect_cols(expr.expr)
     return []
 
@@ -162,64 +212,233 @@ def _has_subquery(expr) -> bool:
         return _has_subquery(expr.left) or _has_subquery(expr.right)
     if isinstance(expr, P.NotOp):
         return _has_subquery(expr.expr)
+    if isinstance(expr, (P.IsNull, P.InList, P.LikeOp)):
+        return _has_subquery(expr.expr)
     return False
 
 
-def _compile_expr(expr, scope: _Scope):
-    """Expr -> fn(flat_cols_tuple) -> array; plus the result dtype."""
+def _split_conjuncts(where):
+    """Split a WHERE AND-tree into (plain predicate | None, membership
+    conjuncts). Membership = IN (SELECT) / EXISTS, possibly NOT-wrapped
+    (normalized onto the node's ``negated`` flag). Membership predicates
+    under OR are rejected — they lower onto joins, which can't be unioned
+    row-wise with a scalar predicate."""
+    plain: List = []
+    members: List = []
+
+    def walk(e):
+        if isinstance(e, P.BinOp) and e.op == "and":
+            walk(e.left)
+            walk(e.right)
+            return
+        if isinstance(e, P.NotOp) and isinstance(
+                e.expr, (P.InSubquery, P.ExistsOp)):
+            inner = e.expr
+            members.append(dataclasses.replace(
+                inner, negated=not inner.negated))
+            return
+        if isinstance(e, (P.InSubquery, P.ExistsOp)):
+            members.append(e)
+            return
+        if _contains_membership(e):
+            raise SqlError(
+                "IN (SELECT)/EXISTS must be AND-level conjuncts (OR over "
+                "set membership is not supported)")
+        plain.append(e)
+
+    walk(where)
+    pred = None
+    for e in plain:
+        pred = e if pred is None else P.BinOp("and", pred, e)
+    return pred, members
+
+
+def _contains_membership(e) -> bool:
+    if isinstance(e, (P.InSubquery, P.ExistsOp)):
+        return True
+    if isinstance(e, P.BinOp):
+        return _contains_membership(e.left) or _contains_membership(e.right)
+    if isinstance(e, P.NotOp):
+        return _contains_membership(e.expr)
+    return False
+
+
+@dataclasses.dataclass
+class _V:
+    """A three-valued expression result: raw ``val``, a boolean NULL mask
+    (None == statically never NULL — rows where the mask is True carry
+    garbage in ``val``), and whether the expression is string-typed."""
+
+    val: object
+    null: object = None          # None | bool array
+    is_str: bool = False
+
+    def nullm(self, shape):
+        return jnp.zeros(shape, jnp.bool_) if self.null is None else \
+            jnp.broadcast_to(self.null, shape)
+
+
+def _or_null(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+def _compile_pred(expr, scope: _Scope):
+    """Predicate compiler: fn(flat_cols) -> keep mask. SQL WHERE/HAVING
+    keep rows where the predicate is TRUE — NULL counts as not-kept
+    (three-valued logic collapses at the filter boundary)."""
+    samples = tuple(jnp.zeros((1,), d) for d in scope.dtypes)
+    probe = _eval3(expr, scope, samples)
+    if np.asarray(probe.val).dtype != np.bool_:
+        raise SqlError("predicate must be boolean")
 
     def fn(cols):
-        return _eval(expr, scope, cols)
+        v = _eval3(expr, scope, cols)
+        keep = jnp.broadcast_to(v.val, cols[0].shape)
+        if v.null is not None:
+            keep = keep & ~jnp.broadcast_to(v.null, cols[0].shape)
+        return keep
 
+    return fn
+
+
+def _compile_proj(expr, scope: _Scope):
+    """Projection compiler: fn(flat_cols) -> value column with NULL rows
+    re-marked as NULL_INT. Returns (fn, dtype, nullable, is_str)."""
     samples = tuple(jnp.zeros((1,), d) for d in scope.dtypes)
-    out_dtype = np.asarray(fn(samples)).dtype
-    return fn, out_dtype
+    probe = _eval3(expr, scope, samples)
+    dt = np.asarray(probe.val).dtype
+    if dt == np.bool_:
+        dt = np.dtype(np.int64)  # SQL exposes booleans as 0/1 integers
+    nullable = probe.null is not None
+
+    def fn(cols):
+        v = _eval3(expr, scope, cols)
+        out = jnp.broadcast_to(v.val, cols[0].shape).astype(dt)
+        if v.null is not None:
+            out = jnp.where(jnp.broadcast_to(v.null, cols[0].shape),
+                            jnp.asarray(NULL_INT(dt), dt), out)
+        return out
+
+    return fn, dt, nullable, probe.is_str
 
 
-def _eval(expr, scope: _Scope, cols):
+def _compile_expr(expr, scope: _Scope):
+    """Legacy two-valued entry (non-null scopes): fn + dtype."""
+    fn, dt, _, _ = _compile_proj(expr, scope)
+    return fn, dt
+
+
+def _eval3(expr, scope: _Scope, cols) -> _V:
+    """Three-valued SQL evaluation (sqlite semantics): any arithmetic or
+    comparison over NULL is NULL; AND/OR/NOT follow Kleene logic; IS NULL /
+    IN / LIKE / EXISTS produce their SQL results. Rows whose mask says NULL
+    carry garbage values — every consumer masks before acting."""
     if isinstance(expr, P.Lit):
-        return jnp.asarray(expr.value)
+        if expr.value is None:
+            return _V(jnp.asarray(0, jnp.int64), jnp.asarray(True))
+        if isinstance(expr.value, str):
+            if scope.strings is None:
+                raise SqlError("string literal but no string dictionary "
+                               "registered")
+            return _V(jnp.asarray(scope.strings.encode(expr.value),
+                                  jnp.int64), None, True)
+        return _V(jnp.asarray(expr.value))
     if isinstance(expr, P.Col):
-        return cols[scope.index_of(expr)]
+        i = scope.index_of(expr)
+        c = cols[i]
+        null = (c == NULL_INT(scope.dtypes[i])) if i in scope.nullable \
+            else None
+        return _V(c, null, i in scope.strs)
     if isinstance(expr, P.NotOp):
-        return ~_eval(expr.expr, scope, cols)
+        v = _eval3(expr.expr, scope, cols)
+        return _V(~v.val, v.null)
+    if isinstance(expr, P.IsNull):
+        v = _eval3(expr.expr, scope, cols)
+        isnull = v.null if v.null is not None else jnp.asarray(False)
+        return _V(~isnull if expr.negated else isnull, None)
+    if isinstance(expr, P.InList):
+        v = _eval3(expr.expr, scope, cols)
+        has_null_lit = any(lit.value is None for lit in expr.values)
+        lits = [lit for lit in expr.values if lit.value is not None]
+        if v.is_str and not all(isinstance(lit.value, str) for lit in lits):
+            raise SqlError("IN list over a string column needs string "
+                           "literals")
+        codes = [_eval3(lit, scope, cols).val for lit in lits]
+        hit = jnp.asarray(False)
+        for c in codes:
+            hit = hit | (v.val == c)
+        # x IN (..., NULL): no match collapses to NULL, not FALSE
+        null = v.null
+        if has_null_lit:
+            null = _or_null(null, ~hit)
+        return _V(~hit if expr.negated else hit, null)
+    if isinstance(expr, P.LikeOp):
+        v = _eval3(expr.expr, scope, cols)
+        if not v.is_str:
+            raise SqlError("LIKE requires a string expression")
+        codes = scope.strings.like_codes(expr.pattern)
+        hit = jnp.asarray(False)
+        for c in codes:
+            hit = hit | (v.val == c)
+        hit = jnp.broadcast_to(hit, jnp.shape(v.val))
+        return _V(~hit if expr.negated else hit, v.null)
     if isinstance(expr, P.BinOp):
-        a = _eval(expr.left, scope, cols)
-        b = _eval(expr.right, scope, cols)
+        a = _eval3(expr.left, scope, cols)
+        b = _eval3(expr.right, scope, cols)
         op = expr.op
-        if op == "+":
-            return a + b
-        if op == "-":
-            return a - b
-        if op == "*":
-            return a * b
+        if a.is_str != b.is_str:
+            raise SqlError(f"cannot compare string and number with {op}")
+        if a.is_str and op not in ("=", "<>", "!="):
+            raise SqlError(f"operator {op} is not defined over strings "
+                           "(dictionary codes carry equality only)")
+        null = _or_null(a.null, b.null)
+        av, bv = a.val, b.val
+        if op in ("+", "-", "*"):
+            val = av + bv if op == "+" else \
+                av - bv if op == "-" else av * bv
+            return _V(val, null)
         if op in ("/", "%"):
-            if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+            if jnp.issubdtype(jnp.result_type(av, bv), jnp.integer):
                 # SQL/reference semantics: division truncates toward zero
                 # (-7/2 == -3) and % is the matching remainder (-7%2 == -1),
                 # so a == (a/b)*b + a%b holds — unlike Python/JAX floored
-                # //+%; matches the Average aggregator's truncating reduce
-                q = a // b
-                r = a - q * b
-                q = jnp.where((r != 0) & ((a < 0) != (b < 0)), q + 1, q)
-                return q if op == "/" else a - q * b
-            return a / b if op == "/" else a % b
-        if op == "=":
-            return a == b
-        if op in ("<>", "!="):
-            return a != b
-        if op == "<":
-            return a < b
-        if op == "<=":
-            return a <= b
-        if op == ">":
-            return a > b
-        if op == ">=":
-            return a >= b
-        if op == "and":
-            return a & b
-        if op == "or":
-            return a | b
+                # //+%; matches the Average aggregator's truncating reduce.
+                # NULL-masked rows may carry zero divisors: divide by a
+                # safe stand-in there (the result is garbage behind the
+                # mask either way; this keeps the kernel trap-free).
+                shape = jnp.shape(av + bv)
+                divnull = _or_null(null, jnp.broadcast_to(bv == 0, shape))
+                safe = jnp.where(jnp.broadcast_to(bv == 0, shape),
+                                 jnp.ones_like(bv), bv)
+                q = av // safe
+                r = av - q * safe
+                q = jnp.where((r != 0) & ((av < 0) != (safe < 0)), q + 1, q)
+                val = q if op == "/" else av - q * safe
+                return _V(val, divnull)
+            return _V(av / bv if op == "/" else av % bv, null)
+        cmps = {"=": lambda: av == bv,
+                "<>": lambda: av != bv, "!=": lambda: av != bv,
+                "<": lambda: av < bv, "<=": lambda: av <= bv,
+                ">": lambda: av > bv, ">=": lambda: av >= bv}
+        if op in cmps:
+            return _V(cmps[op](), null)
+        if op in ("and", "or"):
+            shape = jnp.shape(a.val & b.val)
+            an = a.nullm(shape)
+            bn = b.nullm(shape)
+            av = jnp.broadcast_to(a.val, shape)
+            bv = jnp.broadcast_to(b.val, shape)
+            if op == "and":
+                # Kleene: FALSE dominates NULL
+                known_f = (~an & ~av) | (~bn & ~bv)
+                return _V(av & bv & ~an & ~bn, (an | bn) & ~known_f)
+            known_t = (~an & av) | (~bn & bv)
+            return _V((av & ~an) | (bv & ~bn),
+                      (an | bn) & ~known_t)
     raise SqlError(f"cannot evaluate {expr}")
 
 
@@ -228,16 +447,77 @@ class SqlContext:
 
     def __init__(self, circuit: Circuit):
         self.circuit = circuit
-        self.tables: Dict[str, Tuple[Stream, List[str]]] = {}
+        self.tables: Dict[str, Tuple[Stream, List[str], frozenset]] = {}
+        # one dictionary per context: every string column of every table
+        # shares it, so equality/joins across tables compare codes directly
+        self.strings = SqlStrings()
 
     def register_table(self, name: str, stream: Stream,
-                       columns: List[str]) -> None:
+                       columns: List[str],
+                       string_cols: Tuple[str, ...] = (),
+                       nullable_cols: Tuple[str, ...] = ()) -> None:
+        """``string_cols`` names the dictionary-encoded VARCHAR columns —
+        their device representation is int64 codes from ``self.strings``
+        (encode rows with :meth:`encode_row` before pushing them).
+        ``nullable_cols`` declares columns whose rows may carry SQL NULL
+        (the NULL_INT marker): predicates/projections/aggregates over them
+        run the three-valued path. Columns default to NOT NULL — the
+        planner then keeps the cheaper two-valued kernels and linear
+        aggregates (the inverse of SQL DDL's default, chosen so hot
+        streams don't pay for nullability they never use)."""
         schema = getattr(stream, "schema", None)
         assert schema is not None, "registered streams need schema metadata"
         ncols = len(schema[0]) + len(schema[1])
         assert len(columns) == ncols, (
             f"{name}: {len(columns)} column names for {ncols} columns")
-        self.tables[name] = (stream, list(columns))
+        for label, sel in (("string_cols", string_cols),
+                           ("nullable_cols", nullable_cols)):
+            unknown = set(sel) - set(columns)
+            assert not unknown, f"{name}: {label} {unknown} not in columns"
+        self.tables[name] = (stream, list(columns),
+                             frozenset(columns.index(c)
+                                       for c in string_cols),
+                             frozenset(columns.index(c)
+                                       for c in nullable_cols))
+
+    def encode_row(self, table: str, row) -> tuple:
+        """Encode a host row's string cells (str -> code, None -> NULL).
+        NULL markers are per-column-dtype (int32 NULL is int32's min)."""
+        stream, cols, strs, _ = self.tables[table]
+        schema = stream.schema
+        dts = [*schema[0], *schema[1]]
+        out = []
+        for i, cell in enumerate(row):
+            if i in strs:
+                out.append(NULL_INT(dts[i]) if cell is None
+                           else self.strings.encode(cell))
+            elif cell is None:
+                out.append(NULL_INT(dts[i]))
+            else:
+                out.append(cell)
+        return tuple(out)
+
+    def decode_output(self, stream: Stream, rows: Dict) -> Dict:
+        """Decode a result ``to_dict()``: string codes back to text, NULL
+        markers to None — the serving-boundary inverse of encode_row."""
+        strs = getattr(stream, "_sql_str_cols", set())
+        nullable = getattr(stream, "_sql_nullable_cols", set())
+        schema = getattr(stream, "schema", ((), ()))
+        flat_dts = [*schema[0], *schema[1]]
+        nulls = [NULL_INT(d) if i < len(flat_dts) else NULL_INT(np.int64)
+                 for i, d in enumerate(flat_dts)]
+        out: Dict = {}
+        for row, w in rows.items():
+            cells = []
+            for i, cell in enumerate(row):
+                if i in strs:
+                    cells.append(self.strings.decode(cell))
+                elif i in nullable and i < len(nulls) and cell == nulls[i]:
+                    cells.append(None)
+                else:
+                    cells.append(cell)
+            out[tuple(cells)] = w
+        return out
 
     # -- planning -----------------------------------------------------------
     def query(self, sql: str) -> Stream:
@@ -264,6 +544,7 @@ class SqlContext:
             # key-then-val flattening preserves flat column order
             out._sql_nullable_cols = set(
                 getattr(stream, "_sql_nullable_cols", ()))
+            out._sql_str_cols = set(getattr(stream, "_sql_str_cols", ()))
         out._sql_names = list(names)
         return out
 
@@ -312,6 +593,12 @@ class SqlContext:
         out._sql_nullable_cols = (
             set(getattr(a, "_sql_nullable_cols", ()))
             | set(getattr(b, "_sql_nullable_cols", ())))
+        sa = set(getattr(a, "_sql_str_cols", ()))
+        sb = set(getattr(b, "_sql_str_cols", ()))
+        if sa != sb:
+            raise SqlError(f"{ast.op.upper()}: string/number column "
+                           "positions differ between operands")
+        out._sql_str_cols = sa
         return out
 
     def _plan_select(self, ast: P.Select) -> Stream:
@@ -321,11 +608,16 @@ class SqlContext:
             if _has_subquery(where):
                 stream, scope, where = self._bind_subqueries(
                     stream, scope, where)
-            pred, dt = _compile_expr(where, scope)
-            if dt != np.bool_:
-                raise SqlError("WHERE must be boolean")
-            stream = stream.filter_rows(
-                lambda k, v, _p=pred: _p((*k, *v)), name="sql-where")
+            # split the AND-tree: IN (SELECT)/EXISTS conjuncts lower onto
+            # semijoin/antijoin (facade: the reference compiles these to
+            # the same delta-set operators); the rest stays one predicate
+            plain, memberships = _split_conjuncts(where)
+            for m in memberships:
+                stream = self._lower_membership(m, stream, scope)
+            if plain is not None:
+                pred = _compile_pred(plain, scope)
+                stream = stream.filter_rows(
+                    lambda k, v, _p=pred: _p((*k, *v)), name="sql-where")
         has_aggs = any(isinstance(i.expr, P.Agg) for i in ast.items)
         if has_aggs or ast.group_by:
             stream = self._plan_aggregate(ast, stream, scope)
@@ -350,13 +642,17 @@ class SqlContext:
                 [f"col{i}" for i in range(len(dtypes))]
             return sub, _Scope(
                 [f"{ref.alias}.{n.split('.')[-1]}" for n in names], dtypes,
-                nullable=getattr(sub, "_sql_nullable_cols", ()))
+                nullable=getattr(sub, "_sql_nullable_cols", ()),
+                strs=getattr(sub, "_sql_str_cols", ()),
+                strings=self.strings)
         if ref.name not in self.tables:
             raise SqlError(f"unknown table {ref.name}")
-        stream, cols = self.tables[ref.name]
+        stream, cols, strs, nullable = self.tables[ref.name]
         schema = stream.schema
         dtypes = [*schema[0], *schema[1]]
-        return stream, _Scope([f"{ref.alias}.{c}" for c in cols], dtypes)
+        return stream, _Scope([f"{ref.alias}.{c}" for c in cols], dtypes,
+                              nullable=nullable, strs=strs,
+                              strings=self.strings)
 
     def _plan_from(self, ast: P.Select) -> Tuple[Stream, _Scope]:
         """Left-deep join chain: fold each JOIN clause onto the accumulated
@@ -430,7 +726,10 @@ class SqlContext:
             # every right-side column may now carry the NULL pad
             nullable |= {rbase + i for i in range(len(rs.names))}
         scope = _Scope([f"__jk{n}__", *ls.names, *rs.names],
-                       [key_dt, *ls.dtypes, *rs.dtypes], nullable=nullable)
+                       [key_dt, *ls.dtypes, *rs.dtypes], nullable=nullable,
+                       strs={1 + i for i in ls.strs}
+                       | {rbase + i for i in rs.strs},
+                       strings=self.strings)
         return joined, scope
 
     def _fold_range_join(self, join, left, ls, right, rs, n: int):
@@ -478,7 +777,10 @@ class SqlContext:
         scope = _Scope([f"__jk{n}__", *ls.names, *rs.names],
                        [key_dt, *ls.dtypes, *rs.dtypes],
                        nullable={1 + i for i in ls.nullable}
-                       | {rbase + i for i in rs.nullable})
+                       | {rbase + i for i in rs.nullable},
+                       strs={1 + i for i in ls.strs}
+                       | {rbase + i for i in rs.strs},
+                       strings=self.strings)
         return joined, scope
 
     # -- scalar subqueries ---------------------------------------------------
@@ -495,6 +797,8 @@ class SqlContext:
                 return P.BinOp(e.op, rewrite(e.left), rewrite(e.right))
             if isinstance(e, P.NotOp):
                 return P.NotOp(rewrite(e.expr))
+            if isinstance(e, (P.IsNull, P.InList, P.LikeOp)):
+                return dataclasses.replace(e, expr=rewrite(e.expr))
             return e
 
         where2 = rewrite(where)
@@ -518,7 +822,186 @@ class SqlContext:
                 (jnp.int64,), (*flat_dts, *scols), name=f"sql-cross{i}")
             names = [f"__cross{i}__", *names, f"__sub{i}__"]
             flat_dts = [jnp.int64, *flat_dts, scols[0]]
-        return stream, _Scope(names, flat_dts), where2
+            # each cross-join shifts prior columns right by one (the unit
+            # key lands at 0) and appends the scalar at the end
+            scope = _Scope(
+                names, flat_dts,
+                nullable={1 + i for i in scope.nullable},
+                strs={1 + i for i in scope.strs}, strings=self.strings)
+        return stream, scope, where2
+
+    # -- set membership (IN (SELECT) / EXISTS) -------------------------------
+    def _restore_layout(self, keyed: Stream, scope: _Scope,
+                        tag: str) -> Stream:
+        """After a semijoin round-trip, put the stream back into scope's
+        flat column order (all columns as keys — downstream planning only
+        cares about flat order, not the key/val split)."""
+        out = keyed.map_rows(lambda k, v: (v, ()), tuple(scope.dtypes), (),
+                             name=f"sql-member-{tag}")
+        return out
+
+    def _lower_membership(self, m, stream: Stream, scope: _Scope) -> Stream:
+        """Lower one ``expr [NOT] IN (SELECT ...)`` or ``[NOT] EXISTS``
+        conjunct onto the incremental semijoin/antijoin pair
+        (operators/semijoin.py; the reference's Calcite plans compile these
+        predicates to the same delta-set operators). NULL discipline:
+        NULL_INT-keyed rows are removed from the subquery side (a NULL
+        never equals anything), and a NULL outer key on IN/EXISTS can
+        never match — which is exactly SQL's row-dropping outcome for a
+        NULL predicate at the WHERE boundary."""
+        tag = f"m{id(m) & 0xffff:x}"
+        if isinstance(m, P.InSubquery):
+            sub = self._plan(m.select)
+            sflat = [*sub.schema[0], *sub.schema[1]]
+            svis = getattr(sub, "_sql_names", None) or \
+                [f"col{i}" for i in range(len(sflat))]
+            vis = [i for i, n in enumerate(svis)
+                   if not (n.startswith("__") and n.endswith("__"))]
+            if len(vis) != 1:
+                raise SqlError("IN (SELECT ...) needs exactly one output "
+                               "column")
+            si = vis[0]
+            s_nullable = si in getattr(sub, "_sql_nullable_cols", ())
+            if m.negated and s_nullable:
+                raise SqlError(
+                    "NOT IN over a nullable subquery column is not "
+                    "supported (SQL makes the whole predicate NULL when "
+                    "the subquery contains a NULL)")
+            kfn, kdt, k_nullable, k_str = _compile_proj(m.expr, scope)
+            s_str = si in getattr(sub, "_sql_str_cols", ())
+            if k_str != s_str:
+                raise SqlError("IN (SELECT): string/number type mismatch")
+            key_dt = jnp.result_type(kdt, sflat[si])
+            sub_null = NULL_INT(sflat[si])
+
+            def subkey(k, v, _i=si):
+                return ((*k, *v)[_i].astype(key_dt),)
+
+            sub_keyed = sub.index_by(subkey, (key_dt,),
+                                     name=f"sql-inr-{tag}")
+            if s_nullable:
+                sub_keyed = sub_keyed.filter_rows(
+                    lambda k, v, _n=sub_null: k[0] != _n,
+                    name=f"sql-innn-{tag}")
+            main_keyed = stream.index_by(
+                lambda k, v, _f=kfn: (_f((*k, *v)).astype(key_dt),),
+                (key_dt,), val_fn=lambda k, v: (*k, *v),
+                val_dtypes=tuple(scope.dtypes), name=f"sql-inl-{tag}")
+            if m.negated and k_nullable:
+                # NULL NOT IN (non-null set) is NULL -> row dropped. The
+                # projection marked NULLs with the EXPRESSION dtype's
+                # marker before widening to key_dt (widening preserves
+                # the value), so that is what the filter must match.
+                main_keyed = main_keyed.filter_rows(
+                    lambda k, v, _n=NULL_INT(kdt): k[0] != _n,
+                    name=f"sql-inln-{tag}")
+            joined = main_keyed.antijoin(sub_keyed) if m.negated \
+                else main_keyed.semijoin(sub_keyed)
+            return self._restore_layout(joined, scope, tag)
+
+        assert isinstance(m, P.ExistsOp)
+        if not isinstance(m.select, P.Select):
+            raise SqlError("EXISTS needs a plain SELECT subquery")
+        sub_ast = m.select
+        if sub_ast.group_by or sub_ast.having is not None or \
+                sub_ast.limit is not None:
+            # these clauses change which rows exist (HAVING can empty a
+            # group, LIMIT 0 everything) — refusing beats silently
+            # planning FROM+WHERE only
+            raise SqlError("EXISTS subqueries with GROUP BY/HAVING/LIMIT "
+                           "are not supported")
+        sub_stream, sub_scope = self._plan_from(sub_ast)
+        # decorrelate: equality conjuncts linking one sub column and one
+        # outer column become semijoin keys; everything else stays a
+        # sub-local predicate (inner scope shadows outer on ambiguity)
+        corr: List[Tuple[int, int]] = []   # (outer idx, sub idx)
+        local: List = []
+        if sub_ast.where is not None:
+            plain, members = _split_conjuncts(sub_ast.where)
+            if members:
+                raise SqlError("nested EXISTS/IN inside EXISTS is not "
+                               "supported")
+            conj = []
+
+            def flat_and(e):
+                if isinstance(e, P.BinOp) and e.op == "and":
+                    flat_and(e.left)
+                    flat_and(e.right)
+                else:
+                    conj.append(e)
+
+            if plain is not None:
+                flat_and(plain)
+            for e in conj:
+                pair = None
+                if isinstance(e, P.BinOp) and e.op == "=" and \
+                        isinstance(e.left, P.Col) and \
+                        isinstance(e.right, P.Col):
+                    for a, b in ((e.left, e.right), (e.right, e.left)):
+                        try:
+                            sub_scope.index_of(a)
+                            continue  # resolves inside: not a correlation
+                        except SqlError:
+                            pass
+                        try:
+                            pair = (scope.index_of(a),
+                                    sub_scope.index_of(b))
+                            break
+                        except SqlError:
+                            pair = None
+                if pair is not None:
+                    corr.append(pair)
+                else:
+                    local.append(e)
+        if local:
+            pred = None
+            for e in local:
+                pred = e if pred is None else P.BinOp("and", pred, e)
+            pfn = _compile_pred(pred, sub_scope)
+            sub_stream = sub_stream.filter_rows(
+                lambda k, v, _p=pfn: _p((*k, *v)), name=f"sql-exw-{tag}")
+        if corr:
+            o_idx, s_idx = zip(*corr)
+            key_dts = tuple(jnp.result_type(scope.dtypes[o],
+                                            sub_scope.dtypes[s])
+                            for o, s in corr)
+        else:
+            o_idx, s_idx = (), ()
+            key_dts = (jnp.int64,)
+        o_null = tuple(NULL_INT(d) for d in key_dts)
+
+        def okey(k, v, _i=o_idx):
+            cols = (*k, *v)
+            if not _i:
+                return (jnp.zeros_like(cols[0]).astype(jnp.int64),)
+            return tuple(cols[i].astype(d) for i, d in zip(_i, key_dts))
+
+        def skey(k, v, _i=s_idx):
+            cols = (*k, *v)
+            if not _i:
+                return (jnp.zeros_like(cols[0]).astype(jnp.int64),)
+            return tuple(cols[i].astype(d) for i, d in zip(_i, key_dts))
+
+        sub_keyed = sub_stream.index_by(skey, key_dts,
+                                        name=f"sql-exr-{tag}")
+        drop_null_subkeys = tuple(j for j, s in enumerate(s_idx)
+                                  if s in sub_scope.nullable)
+        if drop_null_subkeys:
+            def no_null_key(k, v, _j=drop_null_subkeys, _n=o_null):
+                bad = None
+                for j in _j:
+                    b = k[j] == _n[j]
+                    bad = b if bad is None else (bad | b)
+                return ~bad
+
+            sub_keyed = sub_keyed.filter_rows(no_null_key,
+                                              name=f"sql-exnn-{tag}")
+        main_keyed = stream.index_by(
+            okey, key_dts, val_fn=lambda k, v: (*k, *v),
+            val_dtypes=tuple(scope.dtypes), name=f"sql-exl-{tag}")
+        joined = main_keyed.antijoin(sub_keyed) if m.negated \
+            else main_keyed.semijoin(sub_keyed)
+        return self._restore_layout(joined, scope, tag)
 
     def _plan_project(self, ast: P.Select, stream: Stream, scope: _Scope
                       ) -> Stream:
@@ -531,6 +1014,7 @@ class SqlContext:
             if len(visible) == len(scope.names):
                 stream._sql_names = list(scope.names)
                 stream._sql_nullable_cols = set(scope.nullable)
+                stream._sql_str_cols = set(scope.strs)
                 return stream
             out = stream.map_rows(
                 lambda k, v, _i=tuple(visible): (
@@ -540,12 +1024,18 @@ class SqlContext:
             out._sql_names = [scope.names[i] for i in visible]
             out._sql_nullable_cols = {j for j, i in enumerate(visible)
                                       if i in scope.nullable}
+            out._sql_str_cols = {j for j, i in enumerate(visible)
+                                 if i in scope.strs}
             return out
-        fns, dts = [], []
-        for item in ast.items:
-            fn, dt = _compile_expr(item.expr, scope)
+        fns, dts, nullable, strs = [], [], set(), set()
+        for j, item in enumerate(ast.items):
+            fn, dt, may_null, is_str = _compile_proj(item.expr, scope)
             fns.append(fn)
             dts.append(dt)
+            if may_null:
+                nullable.add(j)
+            if is_str:
+                strs.add(j)
 
         def project(k, v):
             cols = (*k, *v)
@@ -555,13 +1045,10 @@ class SqlContext:
 
         out = stream.map_rows(project, tuple(dts), (), name="sql-project")
         out._sql_names = _item_names(ast.items)
-        # an output column may be NULL if its expression references any
-        # nullable column (for bare columns this is exact; for arithmetic
-        # the value is transformed but downstream must still be wary)
-        out._sql_nullable_cols = {
-            j for j, item in enumerate(ast.items)
-            if any(scope.index_of(c) in scope.nullable
-                   for c in _collect_cols(item.expr))}
+        # exact NULL tracking: _compile_proj re-marks NULL rows with
+        # NULL_INT, so a column is nullable iff its expression can go NULL
+        out._sql_nullable_cols = nullable
+        out._sql_str_cols = strs
         return out
 
     def _plan_aggregate(self, ast: P.Select, stream: Stream, scope: _Scope
@@ -587,51 +1074,42 @@ class SqlContext:
                 aggs.append((None, ha))
                 selected.append(ha)
 
-        def _null_refs(agg: P.Agg):
-            """Scope indices of NULLABLE columns the agg arg references."""
+        def agg_arg(agg: P.Agg):
+            """(arg projection, dtype, nullable) for one aggregate."""
             if agg.arg is None:
-                return []
-            return [i for i in (scope.index_of(c)
-                                for c in _collect_cols(agg.arg))
-                    if i in scope.nullable]
+                return (lambda cols: jnp.ones_like(cols[0])), \
+                    np.dtype(np.int64), False
+            fn, dt, may_null, is_str = _compile_proj(agg.arg, scope)
+            if is_str and agg.fn != "count":
+                raise SqlError(
+                    f"{agg.fn.upper()} over a string column is not defined "
+                    "(dictionary codes carry equality only)")
+            return fn, dt, may_null
 
-        def keyed_stream(agg: P.Agg) -> Stream:
-            if agg.arg is None:
-                arg_fn, arg_dt = (lambda cols: jnp.ones_like(cols[0])), \
-                    np.dtype(np.int64)
-            else:
-                arg_fn, arg_dt = _compile_expr(agg.arg, scope)
-            nrefs = tuple(_null_refs(agg))
-
-            def mapper(k, v, _f=arg_fn, _n=nrefs, _dt=arg_dt):
+        def keyed_stream(agg: P.Agg, arg_fn, arg_dt) -> Stream:
+            def mapper(k, v, _f=arg_fn, _dt=arg_dt):
                 cols = (*k, *v)
                 keys = tuple(cols[i] for i in group_idx) or \
                     (jnp.zeros_like(cols[0]),)
+                # NULL propagation happens inside _compile_proj's fn: NULL
+                # rows already carry NULL_INT in the projected argument
                 out = jnp.broadcast_to(_f(cols), cols[0].shape)
-                if _n:
-                    # SQL NULL propagation: an expression over a NULL input
-                    # is NULL — re-mark rows whose referenced nullable cols
-                    # carry the pad BEFORE arithmetic transformed it
-                    isnull = jnp.zeros(cols[0].shape, jnp.bool_)
-                    for i in _n:
-                        isnull = isnull | (
-                            cols[i] == NULL_INT(scope.dtypes[i]))
-                    out = jnp.where(isnull,
-                                    jnp.asarray(NULL_INT(_dt),
-                                                jnp.dtype(_dt)), out)
                 return keys, (out,)
 
             return stream.map_rows(mapper, tuple(key_dts), (arg_dt,),
                                    name="sql-keyed")
 
-        # an aggregate is NULL-aware iff its argument references a column
-        # an outer join could have padded (SQL semantics: aggregates skip
-        # NULLs; all-NULL groups aggregate to NULL). Everything else keeps
-        # the linear fast path.
+        # an aggregate is NULL-aware iff its argument expression can go
+        # NULL (SQL semantics: aggregates skip NULLs; all-NULL groups
+        # aggregate to NULL, COUNT to 0). Everything else keeps the linear
+        # fast path.
         results = []
-        for pos, agg in aggs:
-            ks = keyed_stream(agg)
-            if _null_refs(agg):
+        null_aware: Dict[int, bool] = {}
+        for slot, (pos, agg) in enumerate(aggs):
+            arg_fn, arg_dt, may_null = agg_arg(agg)
+            ks = keyed_stream(agg, arg_fn, arg_dt)
+            null_aware[slot] = may_null
+            if may_null:
                 inst = _SqlNullAgg(agg.fn)
             else:
                 cls = AGG_CLASSES[agg.fn]
@@ -648,11 +1126,20 @@ class SqlContext:
         if ast.having is not None:
             # evaluate the HAVING predicate over (group keys, agg columns):
             # rewrite Agg nodes to their slot in combined's value columns
-            # and group columns to their key slot
+            # and group columns to their key slot. NULL-aware agg slots and
+            # nullable/string group columns keep their markings so the
+            # predicate runs the same three-valued logic as WHERE.
             hscope = _Scope(
                 [f"__g{i}__" for i in range(len(group_idx))] +
                 [f"__a{j}__" for j in range(len(aggs))],
-                [*key_dts, *([jnp.int64] * len(aggs))])
+                [*key_dts, *([jnp.int64] * len(aggs))],
+                nullable={i for i, gi in enumerate(group_idx)
+                          if gi in scope.nullable}
+                | {len(group_idx) + j for j in range(len(aggs))
+                   if null_aware[j]},
+                strs={i for i, gi in enumerate(group_idx)
+                      if gi in scope.strs},
+                strings=scope.strings)
 
             def hrewrite(e):
                 if isinstance(e, P.Agg):
@@ -664,11 +1151,11 @@ class SqlContext:
                     return P.BinOp(e.op, hrewrite(e.left), hrewrite(e.right))
                 if isinstance(e, P.NotOp):
                     return P.NotOp(hrewrite(e.expr))
+                if isinstance(e, (P.IsNull, P.InList, P.LikeOp)):
+                    return dataclasses.replace(e, expr=hrewrite(e.expr))
                 return e
 
-            pred, dt = _compile_expr(hrewrite(ast.having), hscope)
-            if dt != np.bool_:
-                raise SqlError("HAVING must be boolean")
+            pred = _compile_pred(hrewrite(ast.having), hscope)
             combined = combined.filter_rows(
                 lambda k, v, _p=pred: _p((*k, *v)), name="sql-having")
 
@@ -696,13 +1183,16 @@ class SqlContext:
                                 name="sql-finalize")
         out._sql_names = _item_names(ast.items)
         # NULL-aware aggregates can emit NULL (all-NULL groups); group
-        # columns inherit their source column's nullability
+        # columns inherit their source column's nullability/string-ness
         out._sql_nullable_cols = {
             pos for pos, item in enumerate(ast.items)
-            if (pos in agg_positions and isinstance(item.expr, P.Agg)
-                and _null_refs(item.expr))
+            if (pos in agg_positions and null_aware[agg_positions[pos]])
             or (pos not in agg_positions
                 and scope.index_of(item.expr) in scope.nullable)}
+        out._sql_str_cols = {
+            pos for pos, item in enumerate(ast.items)
+            if pos not in agg_positions
+            and scope.index_of(item.expr) in scope.strs}
         return out
 
     def _plan_topk(self, ast: P.Select, stream: Stream) -> Stream:
@@ -715,6 +1205,10 @@ class SqlContext:
             names = [f"col{i}" for i in range(len(flat_dts))]
         aux = _Scope(names, flat_dts)
         order_idx = [aux.index_of(o.col) for o in ast.order_by]
+        strs = getattr(stream, "_sql_str_cols", set())
+        if any(i in strs for i in order_idx):
+            raise SqlError("ORDER BY over string columns is not supported "
+                           "(dictionary codes are unordered)")
         descs = {o.desc for o in ast.order_by}
         if len(descs) > 1:
             raise SqlError("mixed ASC/DESC ORDER BY is not supported yet")
@@ -734,4 +1228,7 @@ class SqlContext:
             lambda k, v, _i=tuple(inv): (tuple(v[i] for i in _i), ()),
             tuple(flat_dts), (), name="sql-unorder")
         out._sql_names = names
+        out._sql_nullable_cols = set(
+            getattr(stream, "_sql_nullable_cols", ()))
+        out._sql_str_cols = set(getattr(stream, "_sql_str_cols", ()))
         return out
