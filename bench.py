@@ -348,9 +348,10 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         _debug(f"[{qname}] measured through tick {next_tick - 1} "
                f"({detail['elapsed_s']}s, {detail['events']} events)")
 
-    # snapshots copy the full state (donated buffers) — amortize to one
-    # copy per ~16 ticks; replay-on-overflow widens to that window
-    snap_every = max(1, 16 // validate_every)
+    # snapshots copy the full state (donated buffers) and the copy lands
+    # in the next tick's latency — take ~2 per measured run; a (rare,
+    # post-presize) overflow replays up to half the run, exactly
+    snap_every = max(1, ticks // validate_every // 2)
     ch.run_ticks(m0, ticks, validate_every=validate_every,
                  on_validated=progress, block_each=True, scan=scan,
                  project_ratio=4.0, snapshot_every=snap_every)

@@ -248,6 +248,20 @@ def gather_levels(qkeys, qlive, levels: Sequence[Batch], out_cap: int):
     return (qbuf, vbufs, wbuf), req
 
 
+def trim_queries(ctx, cn: "CNode", qkeys, qlive):
+    """Slice the (front-packed) unique-key buffer down to the "queries"
+    capacity, requirement-checked. The compiled analog of the host path's
+    ``_unique_keys`` re-bucketing (aggregate.py:211): every downstream
+    gather/reduce/diff in the aggregate family is sized by this buffer, so
+    leaving it at delta capacity drags delta-sized kernels through evals
+    that touch few groups (a 21-group GROUP BY under a 32k-cap delta)."""
+    if not cn.caps.get("queries"):
+        cn.caps["queries"] = 64
+    q_cap = cn.caps["queries"]
+    ctx.require(cn, "queries", jnp.sum(qlive))
+    return tuple(c[..., :q_cap] for c in qkeys), qlive[..., :q_cap]
+
+
 @dataclasses.dataclass
 class CView:
     """Compiled analog of ``operators.trace_op.TraceView``: the trace of a
@@ -541,6 +555,7 @@ class CAggregate(CNode):
         nk = len(self.op.key_dtypes)
         delta = view.delta
         qkeys, qlive = _unique_keys_impl(delta, nk)
+        qkeys, qlive = trim_queries(ctx, self, qkeys, qlive)
         q_cap = qlive.shape[-1]
         fast = getattr(agg, "insert_combinable", False)
         if not self.caps["gather"]:
@@ -628,8 +643,13 @@ class CLinearAggregate(CNode):
         nk = len(self.op.key_dtypes)
         delta = inputs[0]
         qkeys, qlive = _unique_keys_impl(delta, nk)
+        qkeys, qlive = trim_queries(ctx, self, qkeys, qlive)
         q_cap = qlive.shape[-1]
         acc_delta, cnt_delta = _weigh_deltas_impl(delta, agg, nk)
+        # per-unique-key segment sums, packed like qkeys: trim to match
+        # (ids past q_cap are caught by the "queries" requirement)
+        acc_delta = tuple(a[..., :q_cap] for a in acc_delta)
+        cnt_delta = cnt_delta[..., :q_cap]
 
         # the consolidated accumulator trace holds one live row per key, so
         # a q_cap expansion is exact — no requirement check needed
@@ -674,6 +694,7 @@ class CTopK(CNode):
         nk = len(self.op.schema[0])
         delta = view.delta
         qkeys, qlive = _unique_keys_impl(delta, nk)
+        qkeys, qlive = trim_queries(ctx, self, qkeys, qlive)
         q_cap = qlive.shape[-1]
         if not self.caps["gather"]:
             self.caps["gather"] = max(64, 2 * q_cap)

@@ -184,6 +184,11 @@ class CompiledHandle:
                 tgt = self.by_index.get(cn.node.inputs[0])
                 if isinstance(tgt, cnodes.CTrace):
                     tgt.MONOTONE_CAPS = frozenset()
+                    # in-program TraceBound truncation SHRINKS levels —
+                    # maintain() must refetch exact live counts (its
+                    # host cache only ever sees drains grow them) or the
+                    # base_live requirement integrates upward forever
+                    tgt._gc_refresh = True
         # map host InputHandle ops -> node indices (for feeds dicts)
         self._op_to_index = {id(n.operator): n.index for n in self.order}
         self._gen_fn = gen_fn
@@ -477,7 +482,8 @@ class CompiledHandle:
                 # bounds — netting may shrink the real count; an over-
                 # estimate only triggers an early drain, never an error).
                 cache = getattr(cn, "_live_cache", None)
-                if cache is None or len(cache) != K:
+                if cache is None or len(cache) != K or \
+                        getattr(cn, "_gc_refresh", False):
                     cache = [int(b.max_worker_live()) for b in levels]
                 lives = cache
                 req = self._req_value(cn, cn.level_keys[0])
