@@ -728,6 +728,54 @@ class CDistinct(CNode):
         return None, _distinct_delta_impl(view.delta, old_w)
 
 
+class CZ1Input(CNode):
+    """Input half of a strict z^-1 feedback (operators/z1.py; the node pair
+    builder.py:85-116 schedules as source + sink). Owns the delayed value
+    as a static-capacity batch: the arriving value (e.g. integrate's
+    ``acc = s + z1(acc)``) has a per-tick merge capacity, so it re-buckets
+    to the state cap with a requirement check — the host path's
+    ``shrink_to_fit`` sync, turned into the standard grow/replay contract."""
+
+    MONOTONE_CAPS = frozenset({"trace"})
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        migrated = op.state if isinstance(op.state, Batch) else None
+        live = 0 if migrated is None else int(migrated.max_worker_live())
+        self.caps["trace"] = bucket_cap(max(live * 2, 1024))
+        self._migrated = migrated
+
+    def init_state(self):
+        lead = getattr(self, "lead", ())
+        if self._migrated is not None and \
+                int(self._migrated.max_worker_live()) > 0:
+            return self._migrated.with_cap(self.caps["trace"])
+        zero = self.op.zero_factory()
+        assert isinstance(zero, Batch), (
+            "compiled z^-1 supports Batch-valued streams only")
+        return Batch.empty(zero.key_dtypes(), zero.val_dtypes(),
+                           cap=self.caps["trace"], lead=lead,
+                           weight_dtype=zero.weights.dtype)
+
+    def eval(self, ctx, state, inputs):
+        v = inputs[0]
+        merged = v if v.cap == self.caps["trace"] else \
+            v.with_cap(self.caps["trace"])
+        ctx.require(self, "trace", v.live_count())
+        return merged, None
+
+
+class CZ1Output(CNode):
+    """Output half: emits the value its partner stored LAST tick (state
+    flows through the states dict under the partner's index — ``ctx.states``
+    is the tick's INPUT state, so this is exactly out(t) = in(t-1))."""
+
+    def eval(self, ctx, state, inputs):
+        st = ctx.states.get(str(self.node.partner))
+        assert st is not None, "z1 feedback loop was never closed"
+        return None, st
+
+
 # ---------------------------------------------------------------------------
 # Time-series nodes (watermark / apply / window)
 # ---------------------------------------------------------------------------

@@ -144,6 +144,13 @@ def _cnode_for(node) -> CNode:
         return cnodes.CApply(node, op)
     if isinstance(op, WindowOp):
         return cnodes.CWindow(node, op)
+    from dbsp_tpu.operators.z1 import Z1, _PlusNamed
+
+    if isinstance(op, Z1):
+        return cnodes.CZ1Output(node, op) if node.kind == "strict_output" \
+            else cnodes.CZ1Input(node, op)
+    if isinstance(op, _PlusNamed):
+        return cnodes.CPlus(node, op)
     raise NotImplementedError(
         f"operator {op.name!r} ({type(op).__name__}) has no compiled "
         "equivalent yet — run this circuit on the host-driven path")
@@ -226,6 +233,7 @@ class CompiledHandle:
             feeds = {self._op_to_index[id(getattr(h, "_op", h))]: b
                      for h, b in raw.items()}
         ctx = _Ctx(feeds)
+        ctx.states = states  # strict-output halves read their partner's
         values: Dict[int, Any] = {}
         new_states = {}
         for cn in self.cnodes:

@@ -1,0 +1,84 @@
+"""Run a production (manager/controller) pipeline on the compiled path.
+
+The reference keeps a JIT facade precisely so SQL-originated pipelines run
+its compiled backend (``crates/dataflow-jit/src/facade.rs:48,105`` —
+``DbspCircuit::new`` builds the jitted dataflow, ``step`` feeds it); without
+it every deployed pipeline would fall back to the interpreted path. This is
+that facade for the XLA backend: :class:`CompiledCircuitDriver` duck-types
+the one method the IO controller calls (``step``) while running each tick
+through :class:`~dbsp_tpu.compiled.compiler.CompiledHandle` — one XLA
+program per tick instead of per-operator dispatches.
+
+Feed/overflow protocol: inputs arrive through the normal host
+``InputHandle`` buffers (the catalog's ``push_rows``); each ``step`` drains
+them via ``ZSetInput.eval`` (same canonicalization as the host path),
+snapshots the compiled states, runs the tick, and validates capacity
+requirements immediately. On overflow it grows, restores the snapshot, and
+replays the SAME tick from the retained feeds — serving pipelines validate
+every tick (the retained-feed window is one step), trading the benchmark
+path's amortized validation for bounded replay.
+
+Outputs flow back through the host ``OutputOperator.eval`` so every
+existing consumer (HTTP ``/read`` cursors, output transports, ``to_dict``
+tests) sees compiled and host pipelines identically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from dbsp_tpu.compiled.compiler import (CompiledHandle, CompiledOverflow,
+                                        compile_circuit)
+
+
+class CompiledCircuitDriver:
+    """Controller-facing driver over a compiled circuit (see module doc)."""
+
+    mode = "compiled"
+
+    def __init__(self, handle, compiled: Optional[CompiledHandle] = None):
+        from dbsp_tpu.operators.io_handles import OutputOperator, ZSetInput
+
+        self.host_handle = handle
+        self.circuit = handle.circuit
+        self.ch = compiled or compile_circuit(handle)
+        self._tick = 0
+        self._inputs = [cn.op for cn in self.ch.cnodes
+                        if isinstance(cn.op, ZSetInput)]
+        self._outputs = [(cn.node.index, cn.op) for cn in self.ch.cnodes
+                         if isinstance(cn.op, OutputOperator)]
+
+    @property
+    def step_latencies_ns(self):
+        return self.ch.step_times_ns
+
+    def step(self) -> None:
+        """One serving tick: drain input buffers -> compiled step ->
+        validate (grow + exact same-tick replay on overflow) -> deliver
+        outputs to the host output operators."""
+        feeds: Dict = {op: op.eval() for op in self._inputs}
+        snap = self.ch.snapshot()
+        while True:
+            self.ch.step(tick=self._tick, feeds=feeds)
+            try:
+                self.ch.validate()
+                break
+            except CompiledOverflow as e:
+                self.ch.grow(e)
+                self.ch.restore(snap)
+        self.ch.maintain()  # spine drains; dispatch-free when nothing due
+        self._tick += 1
+        for idx, out_op in self._outputs:
+            batch = self.ch.last_outputs.get(idx)
+            if batch is not None:
+                out_op.eval(batch)
+
+
+def try_compiled_driver(handle):
+    """Compile the circuit if every operator has a compiled equivalent;
+    None when it must stay on the host-driven path (the caller records
+    which mode the pipeline runs — facade.rs's feature gate)."""
+    try:
+        return CompiledCircuitDriver(handle)
+    except NotImplementedError:
+        return None

@@ -88,6 +88,7 @@ class Pipeline:
         self.server = None
         self.port: Optional[int] = None
         self.error: Optional[str] = None
+        self.mode: Optional[str] = None  # compiled | host (set at deploy)
 
     def compile_and_start(self) -> None:
         from dbsp_tpu.circuit import Runtime
@@ -103,7 +104,21 @@ class Pipeline:
         for vname, out in outs.items():
             catalog.register_output(vname, out, ())
         profiler = CPUProfiler(handle.circuit)
-        self.controller = build_controller(handle, catalog,
+        # Execution-mode selection (facade.rs:48,105: SQL pipelines run the
+        # JIT backend when the plan supports it): attempt the compiled
+        # driver — one XLA program per tick — and fall back to the
+        # host-driven scheduler for circuits using operators without a
+        # compiled equivalent. The chosen mode is part of describe().
+        driver = handle
+        self.mode = "host"
+        if os.environ.get("DBSP_TPU_MANAGER_COMPILED", "1") != "0":
+            from dbsp_tpu.compiled.driver import try_compiled_driver
+
+            compiled = try_compiled_driver(handle)
+            if compiled is not None:
+                driver = compiled
+                self.mode = "compiled"
+        self.controller = build_controller(driver, catalog,
                                            self.config or {})
         self.server = CircuitServer(self.controller, profiler=profiler)
         self.server.start()
@@ -121,7 +136,7 @@ class Pipeline:
 
     def describe(self) -> dict:
         return {"name": self.name, "status": self.status, "port": self.port,
-                "error": self.error,
+                "error": self.error, "mode": self.mode,
                 "program_version": self.program.get("version")}
 
 
