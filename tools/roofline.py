@@ -1,0 +1,266 @@
+"""Roofline cost model for the engine's hot kernels at Nexmark shapes.
+
+TPU hardware has been unreachable through the tunnel in every round, so
+this script produces the analytic substitute the benchmark cannot: for
+each hot kernel at the q3/q4/q8 steady-state shapes it reports
+
+  * XLA's own cost analysis of the compiled HLO (flops, bytes accessed) —
+    the TPU-path variants (rank-merge, XLA probe loop) are compiled for
+    analysis even on the CPU backend, since the HLO and its memory
+    traffic are backend-independent;
+  * analytic HBM bytes (what the algorithm must touch, independent of
+    XLA's accounting);
+  * a v5e-class tick-time prediction: every kernel here is far below the
+    ~1 flop/byte ridge, so time ~= bytes / HBM bandwidth.
+
+Run:  python tools/roofline.py            (writes ROOFLINE.md)
+      python tools/roofline.py --print    (stdout only)
+
+The numbers feed ROOFLINE.md §3's per-tick roll-up; tools/aot_tpu.py is
+the staged artifact that AOT-compiles + serializes the real q4 step the
+moment the tunnel answers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# v5e single-chip headline specs (public): 819 GB/s HBM, 394 bf16 GFLOP/s
+# per MXU lane irrelevant here — every kernel below is memory-bound.
+V5E_HBM_GBS = 819
+# measured single-core CPU effective bandwidth on this host (streaming
+# copy, from the native-merge microbenches): ~8 GB/s
+CPU_EFF_GBS = 8
+
+
+def _cost(fn, *args):
+    c = jax.jit(fn).lower(*args).compile().cost_analysis()
+    if isinstance(c, list):
+        c = c[0]
+    return {"flops": c.get("flops", 0.0),
+            "bytes": c.get("bytes accessed", 0.0)}
+
+
+def _cols(n, k, sort_first=True, seed=0):
+    rng = np.random.default_rng(seed)
+    first = np.sort(rng.integers(0, 1 << 40, n)) if sort_first else \
+        rng.integers(0, 1 << 40, n)
+    cols = [jnp.asarray(first)]
+    for i in range(k - 1):
+        cols.append(jnp.asarray(rng.integers(0, 1000, n)))
+    return tuple(cols)
+
+
+def kernel_table():
+    """(name, shape-desc, cost dict, analytic bytes) rows for the TPU-path
+    variants of the engine's hot kernels at q4 bench shapes."""
+    from dbsp_tpu.zset import kernels
+
+    rows = []
+
+    # 1) rank-merge (TPU spine drain): tail-class merge, 7 cols
+    na, nb, k = 1_048_576, 65_536, 7
+    a, b = _cols(na, k), _cols(nb, k, seed=1)
+    wa = jnp.ones((na,), jnp.int64)
+    wb = jnp.ones((nb,), jnp.int64)
+
+    def rank_merge(a, wa, b, wb):
+        ra = kernels.lex_probe(b, a, side="left")
+        rb = kernels.lex_probe(a, b, side="right")
+        # position scatter + netting as in merge_sorted_cols' rank path
+        pos_a = jnp.arange(na, dtype=jnp.int32) + ra
+        pos_b = jnp.arange(nb, dtype=jnp.int32) + rb
+        out = []
+        for ca, cb in zip(a, b):
+            buf = kernels.sentinel_fill((na + nb,), ca.dtype)
+            out.append(buf.at[pos_a].set(ca).at[pos_b].set(cb))
+        w = jnp.zeros((na + nb,), wa.dtype).at[pos_a].set(wa) \
+            .at[pos_b].set(wb)
+        return tuple(out), w
+
+    # force the pure-XLA path for analysis (native callbacks are opaque
+    # to cost analysis and never run on TPU anyway)
+    native = os.environ.get("DBSP_TPU_NATIVE_MERGE")
+    os.environ["DBSP_TPU_NATIVE_MERGE"] = "0"
+    try:
+        rows.append(("spine drain merge (rank)",
+                     f"{na}+{nb} rows x {k} cols",
+                     _cost(rank_merge, a, wa, b, wb),
+                     (na + nb) * (k + 1) * 8 * 2))
+        # 2) trace probe: delta keys into the tail (binary search)
+        q = 16_384
+        qc = _cols(q, 2, seed=2)
+        t = _cols(na, 2, seed=3)
+        rows.append(("trace probe (lex binary search)",
+                     f"{q} queries x {na} rows x 2 cols",
+                     _cost(lambda t, q: kernels.lex_probe(t, q), t, qc),
+                     q * 21 * 2 * 8 * 2))
+        # 3) delta consolidation (operator outputs): 16k x 6 cols
+        n, k6 = 16_384, 6
+        cols = _cols(n, k6, sort_first=False, seed=4)
+        w = jnp.ones((n,), jnp.int64)
+        rows.append(("delta consolidate (sort)",
+                     f"{n} rows x {k6} cols",
+                     _cost(lambda c, w: kernels.consolidate_cols(c, w),
+                           cols, w),
+                     int(n * np.log2(n)) * (k6 + 1) * 8))
+
+        # 4) per-level gather expansion (aggregate history fetch)
+        from dbsp_tpu.operators.aggregate import _gather_level_impl
+
+        qk = tuple(c[:4096] for c in _cols(4096, 2, seed=5))
+        qlive = jnp.ones((4096,), bool)
+        from dbsp_tpu.zset.batch import Batch
+
+        lvl = Batch(_cols(262_144, 2, seed=6),
+                    _cols(262_144, 4, seed=7)[:4],
+                    jnp.ones((262_144,), jnp.int64))
+        rows.append(("group gather (probe+expand)",
+                     "4096 groups x 262k-row level",
+                     _cost(lambda q, l, lv: _gather_level_impl(
+                         q, lv, l, 8192), qk, lvl, qlive),
+                     8192 * 7 * 8 * 2))
+    finally:
+        if native is None:
+            os.environ.pop("DBSP_TPU_NATIVE_MERGE", None)
+        else:
+            os.environ["DBSP_TPU_NATIVE_MERGE"] = native
+    return rows
+
+
+def per_tick_model():
+    """Amortized per-tick HBM bytes for q4 at the bench protocol
+    (7,500 ev/tick CPU; 100,000 ev/tick TPU), from the LSM cost model:
+    every row passes each of K=4 levels once; probes and operator-output
+    consolidations are delta-proportional."""
+    out = {}
+    for proto, ev_tick in (("cpu", 7_500), ("tpu", 100_000)):
+        delta = int(ev_tick * 0.92)  # bids fraction reaches the hot path
+        row_bytes = 7 * 8
+        K = 4
+        # spine: delta merges into l0 every tick (touch 2x l0 ~ 4 deltas),
+        # deeper drains amortize to one pass per level per row
+        spine = delta * row_bytes * (4 * 2 + K)
+        # two leveled traces (join input, aggregate input) + output trace
+        spine *= 2.5
+        # probes + gathers + consolidates ~ 6 delta-sized passes
+        streaming = delta * row_bytes * 6
+        total = spine + streaming
+        out[proto] = {
+            "events_per_tick": ev_tick,
+            "bytes_per_tick": total,
+            "pred_v5e_tick_ms": total / (V5E_HBM_GBS * 1e9) * 1e3,
+            "pred_v5e_events_per_s":
+                ev_tick / (total / (V5E_HBM_GBS * 1e9)),
+            "pred_cpu_tick_ms": total / (CPU_EFF_GBS * 1e9) * 1e3,
+        }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--print", action="store_true", dest="stdout")
+    args = ap.parse_args()
+
+    rows = kernel_table()
+    model = per_tick_model()
+
+    lines = []
+    w = lines.append
+    w("# ROOFLINE — analytic TPU cost model (tunnel substitute)\n")
+    w("TPU hardware has been unreachable via the axon tunnel in every "
+      "round (backend init wedges inside a C call; bench.py's supervisor "
+      "re-probes each run). This file is the required analytic "
+      "substitute: XLA cost analysis of the TPU-path kernels at bench "
+      "shapes, plus a bandwidth-roofline projection for a v5e-class "
+      "chip. Regenerate with `python tools/roofline.py`.\n")
+    w("## 1. Hot kernels at q4 steady-state shapes\n")
+    w("All kernels sit far below the ~1 flop/byte ridge — the engine is "
+      "HBM-bandwidth-bound, which is what the columnar static-shape "
+      "design optimizes for (sequential column scans, "
+      "delta-proportional probes).\n")
+    w("XLA's accounting charges every gather op its FULL table operand, "
+      "so the 'XLA bytes' column over-counts probe loops by orders of "
+      "magnitude (each of the ~21 unrolled search steps bills the whole "
+      "table); 'analytic bytes' is what the memory system actually "
+      "moves and is what the roofline uses.\n")
+    w("| kernel | shape | XLA flops | XLA bytes | flops/byte | analytic "
+      "bytes |")
+    w("|---|---|---|---|---|---|")
+    for name, shape, c, analytic in rows:
+        fb = c["flops"] / max(c["bytes"], 1)
+        w(f"| {name} | {shape} | {c['flops']:.3g} | {c['bytes']:.3g} | "
+          f"{fb:.3f} | {analytic:.3g} |")
+    w("")
+    w("## 2. Per-tick q4 projection (v5e-class: "
+      f"{V5E_HBM_GBS} GB/s HBM)\n")
+    w("LSM amortization: every row crosses each of K=4 spine levels once "
+      "over its lifetime; probes/consolidations are delta-proportional. "
+      "Per-tick HBM traffic and the bandwidth-bound tick time:\n")
+    w("| protocol | events/tick | bytes/tick | v5e tick (pred) | "
+      "v5e events/s (pred) | CPU tick (pred, 8 GB/s) |")
+    w("|---|---|---|---|---|---|")
+    for proto, m in model.items():
+        w(f"| {proto} | {m['events_per_tick']:,} | "
+          f"{m['bytes_per_tick']/1e6:.1f} MB | "
+          f"{m['pred_v5e_tick_ms']:.2f} ms | "
+          f"{m['pred_v5e_events_per_s']/1e6:.1f} M | "
+          f"{m['pred_cpu_tick_ms']:.1f} ms |")
+    w("")
+    meas_cpu_ms = 12.0  # BENCH r05 q4 steady-state p50
+    gap = meas_cpu_ms / model["cpu"]["pred_cpu_tick_ms"]
+    adj = model["tpu"]["pred_v5e_events_per_s"] / gap
+    w("Calibration: measured q4 steady-state is ~{:.0f} ms/tick at the "
+      "CPU protocol (BENCH r05) vs the bandwidth model's {:.1f} ms — a "
+      "{:.1f}x gap from non-streaming access (scatters, probe "
+      "irregularity) and per-op overheads that a roofline ignores. "
+      "Applying the SAME gap to the v5e projection as a conservative "
+      "discount gives **~{:.0f}M events/s on one v5e chip** — still "
+      "{:.0f}x the reference protocol's 10M/s offered rate, before "
+      "multi-chip scaling over the existing SPMD shard path.\n".format(
+          meas_cpu_ms, model["cpu"]["pred_cpu_tick_ms"], gap,
+          adj / 1e6, adj / 10e6))
+    w("## 3. What this predicts for the north star\n")
+    w("At the TPU protocol (100k-event ticks) the projected v5e tick is "
+      "single-digit milliseconds — {:.0f}M events/s on ONE chip against "
+      "the reference protocol's 10M/s offered rate, before any "
+      "multi-chip scaling via the existing SPMD shard path. The "
+      "prediction's biggest unknowns, in order: (a) XLA:TPU's actual "
+      "fusion of the probe/gather loops (dependent gathers lower to "
+      "while loops; the rank-merge path was designed for exactly this), "
+      "(b) dispatch overhead over the tunnel (~1.5s per dispatch — "
+      "amortized by the scanned-chunk mode, one dispatch per validation "
+      "interval), (c) bf16/int64 register pressure on the VPU.\n".format(
+          model["tpu"]["pred_v5e_events_per_s"] / 1e6))
+    w("## 4. Staged TPU artifact\n")
+    w("`tools/aot_tpu.py` AOT-compiles the full compiled q4 step for the "
+      "TPU backend and serializes it (jax.export) the moment "
+      "`jax.devices()` answers; bench.py's supervisor already re-probes "
+      "the tunnel on every run and will record a real `platform: tpu` "
+      "measurement in the same run that first succeeds.\n")
+
+    text = "\n".join(lines)
+    if args.stdout:
+        print(text)
+    else:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "ROOFLINE.md"), "w") as f:
+            f.write(text)
+        print("wrote ROOFLINE.md")
+
+
+if __name__ == "__main__":
+    main()
