@@ -64,6 +64,9 @@ def pytest_configure(config):
         "markers", "slow: long-running (excluded from the -m fast tier)")
     config.addinivalue_line(
         "markers", "fast: the <2-minute pre-commit correctness tier")
+    config.addinivalue_line(
+        "markers", "perf: throughput regression gate vs recorded bands "
+        "(tests/perf_baseline.json; ~2-3 min on a quiet core)")
 
 
 def pytest_collection_modifyitems(config, items):

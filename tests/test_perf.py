@@ -1,0 +1,97 @@
+"""Perf regression gate (`-m perf`): mini q3/q4/q8 runs against recorded
+throughput bands.
+
+Round 3 shipped a 10x q4 regression because no test measured anything;
+this tier makes that a red test. Bands are intentionally loose (factor
+PERF_BAND, default 2.5x) so single-core noise and contending processes
+don't flake the gate, while an order-of-magnitude regression cannot pass.
+
+The recorded values live in tests/perf_baseline.json and are updated
+DELIBERATELY with the change that moves them:
+
+    python tools/record_perf.py        # reruns the minis, rewrites json
+
+Run the gate:  python -m pytest -m perf -q   (~2-3 min on a quiet core)
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.perf, pytest.mark.slow]
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "perf_baseline.json")
+PERF_BAND = float(os.environ.get("PERF_BAND", 2.5))
+
+MINI = {"batch": 7_500, "warm": 3, "meas": 16}
+
+
+def measure_query(qname: str, batch: int = MINI["batch"],
+                  warm: int = MINI["warm"], meas: int = MINI["meas"]):
+    """Steady-state events/s + p50 tick ms for one query, compiled mode,
+    same protocol shape as bench.py at reduced length."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import cnodes, compile_circuit
+    from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
+                                  queries)
+
+    query = getattr(queries, qname)
+    batch = max(batch // 50, 1) * 50
+    ept = batch // 50
+    ticks = warm + 1 + meas
+    cnodes.TRACE_LEVELS = cnodes.levels_for_run(ticks)
+    cfg = GeneratorConfig(seed=1)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, query(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * ept, ept)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    ch.run_ticks(0, warm, validate_every=1, project_ratio=4.0)
+    ch.presize(ticks / warm, interval=2)
+    ch.run_ticks(warm, 1, validate_every=1, project_ratio=4.0)
+    ch.step_times_ns.clear()
+    t0 = time.perf_counter()
+    ch.run_ticks(warm + 1, meas, validate_every=2, block_each=True,
+                 project_ratio=4.0, snapshot_every=4)
+    ch.block()
+    elapsed = time.perf_counter() - t0
+    ts = sorted(ch.step_times_ns)
+    p50_ms = ts[len(ts) // 2] / 1e6
+    return {
+        "events_per_s": round(meas * batch / elapsed, 1),
+        "steady_events_per_s": round(batch / (p50_ms / 1e3), 1),
+        "p50_tick_ms": round(p50_ms, 2),
+    }
+
+
+def _baseline():
+    assert os.path.exists(BASELINE_PATH), (
+        "tests/perf_baseline.json missing — record it with "
+        "`python tools/record_perf.py`")
+    with open(BASELINE_PATH) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("qname", ["q3", "q4", "q8"])
+def test_throughput_within_band(qname):
+    base = _baseline()[qname]
+    got = measure_query(qname)
+    floor = base["steady_events_per_s"] / PERF_BAND
+    assert got["steady_events_per_s"] >= floor, (
+        f"{qname} regressed: {got['steady_events_per_s']:.0f} ev/s "
+        f"steady vs recorded {base['steady_events_per_s']:.0f} "
+        f"(band {PERF_BAND}x => floor {floor:.0f}); p50 "
+        f"{got['p50_tick_ms']}ms vs {base['p50_tick_ms']}ms. If this "
+        "change deliberately trades this throughput, re-record with "
+        "tools/record_perf.py and say so in the commit.")

@@ -1,0 +1,40 @@
+"""Re-record tests/perf_baseline.json (the perf gate's reference values).
+
+Run on a QUIET machine (nothing else on the core) with the change that
+deliberately moves throughput; commit the json alongside that change.
+
+    python tools/record_perf.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+
+def main() -> None:
+    from test_perf import BASELINE_PATH, measure_query
+
+    out = {}
+    for q in ("q3", "q4", "q8"):
+        out[q] = measure_query(q)
+        print(q, out[q], flush=True)
+    with open(BASELINE_PATH, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {BASELINE_PATH}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Trace-ladder sweep: steady-state q4 throughput per level count.
+
+The round-3 regression hid a 10x capacity-class mistake inside a commit
+message; this makes the sweep a one-command experiment. Run on a quiet
+core:
+
+    python tools/sweep_trace_levels.py [--query q4] [--levels 1 2 3 4 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--query", default="q4")
+    ap.add_argument("--levels", nargs="*", type=int, default=[1, 2, 3, 4, 5])
+    ap.add_argument("--meas", type=int, default=24)
+    args = ap.parse_args()
+
+    from dbsp_tpu.compiled import cnodes
+    from test_perf import measure_query
+
+    print(f"| K | {args.query} steady ev/s | p50 ms |")
+    print("|---|---|---|")
+    for k in args.levels:
+        cnodes.TRACE_LEVELS = k
+        # measure_query resets TRACE_LEVELS via levels_for_run — pin it
+        orig = cnodes.levels_for_run
+        cnodes.levels_for_run = lambda ticks, _k=k: _k
+        try:
+            m = measure_query(args.query, meas=args.meas)
+        finally:
+            cnodes.levels_for_run = orig
+        print(f"| {k} | {m['steady_events_per_s']:,.0f} | "
+              f"{m['p50_tick_ms']} |", flush=True)
+
+
+if __name__ == "__main__":
+    main()
