@@ -185,9 +185,18 @@ def _net_state(parts, q_cap: int):
 
 def _combine_diff_impl(qkeys, qlive, acc_delta, cnt_delta, old_accs, old_cnt,
                        old_rows, agg: LinearAggregator, nk: int):
-    """Combine old state + deltas; build the output diff and the state diff."""
+    """Combine old state + deltas; build the output diff and the state diff.
+
+    Two DISTINCT presence notions (conflating them dropped negative-count
+    accumulator state and later resurrected a phantom zero-sum group —
+    found by the property fuzzer, tests/test_proptest.py):
+      * a group is VISIBLE in the output iff its net count > 0;
+      * a STATE row must exist iff any accumulator component is nonzero —
+        a group retracted below zero still owes its (negative) sums.
+    """
     q_cap = qlive.shape[0]
-    old_present = qlive & (old_rows > 0)
+    old_has_row = qlive & (old_rows > 0)   # a state row existed
+    old_present = qlive & (old_cnt > 0)    # group visible in the output
     new_accs = tuple(o + d for o, d in zip(old_accs, acc_delta))
     new_cnt = old_cnt + cnt_delta
     new_present = qlive & (new_cnt > 0)
@@ -215,8 +224,12 @@ def _combine_diff_impl(qkeys, qlive, acc_delta, cnt_delta, old_accs, old_cnt,
     state_changed = cnt_delta != 0
     for d in acc_delta:
         state_changed = state_changed | (d != 0)
+    new_has_row = new_cnt != 0
+    for a in new_accs:
+        new_has_row = new_has_row | (a != 0)
     state = two_sided((*new_accs, new_cnt), (*old_accs, old_cnt),
-                      new_present & state_changed, old_present & state_changed)
+                      qlive & new_has_row & state_changed,
+                      old_has_row & state_changed)
     return out, state
 
 
