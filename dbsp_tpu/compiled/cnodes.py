@@ -728,6 +728,222 @@ class CDistinct(CNode):
         return None, _distinct_delta_impl(view.delta, old_w)
 
 
+def range_gather_levels(qp, qlo, qhi, qlive, levels: Sequence[Batch],
+                        out_cap: int):
+    """Per-row [lo, hi] time-range gather over K trace levels into ONE
+    shared buffer (the same offset-scatter scheme as :func:`gather_levels`
+    — the range twin of the equality gather, shared by rolling aggregates;
+    kernel: timeseries/rolling.py::_range_gather_level_impl). Returns
+    ((qrow, t, vals, w), unclamped total)."""
+    from dbsp_tpu.timeseries.rolling import _range_gather_level_impl
+
+    assert levels
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    qbuf = jnp.full((out_cap,), jnp.int32(-1))
+    tbuf = vbufs = wbuf = None
+    offset = jnp.asarray(0, jnp.int32)
+    req = jnp.asarray(0, jnp.int64)
+    for lvl in levels:
+        qrow, t, vals, w, total = _range_gather_level_impl(
+            qp, qlo, qhi, qlive, lvl, out_cap)
+        req = req + total.astype(jnp.int64)
+        t32 = jnp.minimum(total, out_cap).astype(jnp.int32)
+        idx = jnp.where(j < t32, j + offset, out_cap)
+        if tbuf is None:
+            tbuf = kernels.sentinel_fill((out_cap,), t.dtype)
+            vbufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
+                          for c in vals)
+            wbuf = jnp.zeros((out_cap,), w.dtype)
+        qbuf = qbuf.at[idx].set(qrow, mode="drop")
+        tbuf = tbuf.at[idx].set(t, mode="drop")
+        vbufs = tuple(b.at[idx].set(c, mode="drop")
+                      for b, c in zip(vbufs, vals))
+        wbuf = wbuf.at[idx].set(jnp.where(j < t32, w, 0), mode="drop")
+        offset = jnp.minimum(offset + t32, out_cap)
+    return (qbuf, tbuf, vbufs, wbuf), req
+
+
+class CRangeJoin(CNode):
+    """Incremental relative-range join over CViews (operators/join_range.py
+    semantics: ΔL ⋈r trace(R)_post + ΔR ⋈r trace(L)_pre), with each side's
+    K per-level expansions landing in one shared static buffer."""
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["left"] = 0
+        self.caps["right"] = 0
+
+    def _fan(self, ctx, cap_key, delta, levels, core):
+        from dbsp_tpu.operators.join_range import _range_join_level_impl
+
+        out_cap = self.caps[cap_key]
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        bufs = wbuf = None
+        offset = jnp.asarray(0, jnp.int32)
+        req = jnp.asarray(0, jnp.int64)
+        for lvl in levels:
+            out, total = _range_join_level_impl(
+                delta, lvl, core.lo_off, core.hi_off, core.fn, out_cap)
+            req = req + total.astype(jnp.int64)
+            t32 = jnp.minimum(total, out_cap).astype(jnp.int32)
+            idx = jnp.where(j < t32, j + offset, out_cap)
+            if bufs is None:
+                bufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
+                             for c in out.cols)
+                wbuf = jnp.zeros((out_cap,), out.weights.dtype)
+            bufs = tuple(b.at[idx].set(c, mode="drop")
+                         for b, c in zip(bufs, out.cols))
+            wbuf = wbuf.at[idx].set(jnp.where(j < t32, out.weights, 0),
+                                    mode="drop")
+            offset = jnp.minimum(offset + t32, out_cap)
+        ctx.require(self, cap_key, req)
+        nko = len(self.op.out_schema[0])
+        return Batch(bufs[:nko], bufs[nko:], wbuf)
+
+    def eval(self, ctx, state, inputs):
+        left, right = inputs
+        if not self.caps["left"]:
+            self.caps["left"] = max(64, left.delta.cap)
+            self.caps["right"] = max(64, right.delta.cap)
+        lout = self._fan(ctx, "left", left.delta, right.post,
+                         self.op._left)
+        rout = self._fan(ctx, "right", right.delta, left.pre,
+                         self.op._right)
+        return None, concat_batches([lout, rout]).consolidate()
+
+
+class CRolling(CNode):
+    """Partitioned rolling aggregate (timeseries/rolling.py) over a CView:
+    find dirty (p, t') slots, recompute each window [t'-range, t'] from the
+    input trace levels, diff against the previous outputs kept in a static
+    out trace. The window-recompute path only (the radix-tree fast path
+    keeps host-driven level state; rolling queries wanting it run the host
+    scheduler) — within one tick everything is the same shared-buffer fan
+    machinery as the equality aggregates."""
+
+    MONOTONE_CAPS = frozenset({"out_trace", "affected", "window"})
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        self.caps["affected"] = 0
+        self.caps["dirty"] = 0
+        self.caps["window"] = 0
+        self.caps["out_trace"] = 0
+
+    def init_state(self):
+        migrated = _migrate_spine(self.op.out_spine)
+        if not self.caps["out_trace"]:
+            live = 0 if migrated is None else int(migrated.max_worker_live())
+            self.caps["out_trace"] = bucket_cap(max(live * 2, 1024))
+        if migrated is not None:
+            return migrated.with_cap(self.caps["out_trace"])
+        return Batch.empty(*self.op.out_schema,
+                           cap=self.caps["out_trace"],
+                           lead=getattr(self, "lead", ()))
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.aggregate import (_TupleMax,
+                                                  _diff_outputs_impl,
+                                                  _gather_level_impl,
+                                                  _reduce_groups_impl)
+        from dbsp_tpu.timeseries.rolling import (_dirty_rows_impl,
+                                                 _rolling_reduce_impl)
+
+        view: CView = inputs[0]
+        delta = view.delta
+        rng = self.op.range_ms
+        dp, dt = delta.keys[0], delta.keys[1]
+        dlive = delta.weights != 0
+        if not self.caps["affected"]:
+            self.caps["affected"] = max(64, 2 * delta.cap)
+            self.caps["dirty"] = max(64, 2 * delta.cap)
+            self.caps["window"] = max(64, 4 * delta.cap)
+
+        # 1. dirty slots: trace rows in [ts, ts+range] per delta row (keys
+        # only) + the delta's own rows
+        key_only = [Batch(b.keys, (), b.weights) for b in view.post]
+        (qrow, t, _v, w), aff_req = range_gather_levels(
+            dp, dt, dt + rng, dlive, key_only, self.caps["affected"])
+        ctx.require(self, "affected", aff_req)
+        ap, at, alive = _dirty_rows_impl(dp, dt, dlive, qrow, t, w)
+        ctx.require(self, "dirty", jnp.sum(alive))
+        a_cap = self.caps["dirty"]
+
+        def fit(arr, fill):
+            n = arr.shape[-1]
+            if n >= a_cap:
+                return arr[..., :a_cap]
+            pad = jnp.full((*arr.shape[:-1], a_cap - n), fill, arr.dtype)
+            return jnp.concatenate([arr, pad], axis=-1)
+
+        ap = fit(ap, kernels.sentinel_for(ap.dtype))
+        at = fit(at, kernels.sentinel_for(at.dtype))
+        alive = fit(alive, False)
+
+        # 2. recompute each dirty window from the input trace
+        (wrow, wt, wvals, ww), win_req = range_gather_levels(
+            ap, at - rng, at, alive, view.post, self.caps["window"])
+        ctx.require(self, "window", win_req)
+        new_vals, new_present = _rolling_reduce_impl(
+            wrow, wt, wvals, ww, at, self.op.agg, a_cap)
+
+        # 3. diff vs previous outputs (one live row per (p, t'): exact)
+        oqrow, ovals, ow, _ = _gather_level_impl((ap, at), alive, state,
+                                                 a_cap)
+        old_vals, old_present = _reduce_groups_impl(
+            ((oqrow, ovals, ow),), _TupleMax(len(self.op.agg.out_dtypes)),
+            a_cap)
+        cols, w = _diff_outputs_impl((ap, at), alive, new_vals, new_present,
+                                     old_vals, old_present)
+        out = Batch(cols[:2], cols[2:], w)
+        state2, required = static_append(state, out)
+        ctx.require(self, "out_trace", required)
+        return state2, out
+
+
+class CUpsertIn(CNode):
+    """Upsert source (operators/upsert.py): the host feeds a COMMAND batch
+    (unique sorted keys; +1 rows carry new values, -1 rows are deletes);
+    the node diffs it against the maintained map state to emit exact
+    Z-set deltas — retract the touched keys' live rows, insert the new
+    values (upsert.rs:37's state diff, with the state as a static batch)."""
+
+    MONOTONE_CAPS = frozenset({"state"})
+
+    def __init__(self, node, op):
+        super().__init__(node, op)
+        migrated = _migrate_spine(op.spine)
+        live = 0 if migrated is None else int(migrated.max_worker_live())
+        self.caps["state"] = bucket_cap(max(live * 2, 1024))
+        self._migrated = migrated
+
+    def init_state(self):
+        if self._migrated is not None:
+            return self._migrated.with_cap(self.caps["state"])
+        return Batch.empty(self.op.key_dtypes, self.op.val_dtypes,
+                           cap=self.caps["state"],
+                           lead=getattr(self, "lead", ()))
+
+    def eval(self, ctx, state, inputs):
+        from dbsp_tpu.operators.aggregate import _gather_level_impl
+        from dbsp_tpu.operators.upsert import _retractions
+
+        cmds = ctx.feeds.get(self.node.index)
+        if cmds is None:
+            cmds = Batch.empty(self.op.key_dtypes, self.op.val_dtypes)
+        nk = len(self.op.key_dtypes)
+        qkeys = cmds.keys[:nk]
+        qlive = cmds.weights != 0
+        q_cap = qlive.shape[-1]
+        qrow, vals, w, _ = _gather_level_impl(qkeys, qlive, state, q_cap)
+        retract = _retractions(qrow, qkeys, vals, w)
+        inserts = cmds.masked(cmds.weights > 0)
+        out = concat_batches([retract, inserts]).consolidate()
+        state2, required = static_append(state, out)
+        ctx.require(self, "state", required)
+        return state2, out
+
+
 class CZ1Input(CNode):
     """Input half of a strict z^-1 feedback (operators/z1.py; the node pair
     builder.py:85-116 schedules as source + sink). Owns the delayed value

@@ -144,6 +144,16 @@ def _cnode_for(node) -> CNode:
         return cnodes.CApply(node, op)
     if isinstance(op, WindowOp):
         return cnodes.CWindow(node, op)
+    from dbsp_tpu.operators.join_range import RangeJoinOp
+    from dbsp_tpu.operators.upsert import UpsertInput
+    from dbsp_tpu.timeseries.rolling import RollingAggregateOp
+
+    if isinstance(op, RangeJoinOp):
+        return cnodes.CRangeJoin(node, op)
+    if isinstance(op, RollingAggregateOp):
+        return cnodes.CRolling(node, op)
+    if isinstance(op, UpsertInput):
+        return cnodes.CUpsertIn(node, op)
     from dbsp_tpu.operators.z1 import Z1, _PlusNamed
 
     if isinstance(op, Z1):

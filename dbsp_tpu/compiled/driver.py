@@ -38,13 +38,20 @@ class CompiledCircuitDriver:
 
     def __init__(self, handle, compiled: Optional[CompiledHandle] = None):
         from dbsp_tpu.operators.io_handles import OutputOperator, ZSetInput
+        from dbsp_tpu.operators.upsert import UpsertInput
 
         self.host_handle = handle
         self.circuit = handle.circuit
         self.ch = compiled or compile_circuit(handle)
         self._tick = 0
-        self._inputs = [cn.op for cn in self.ch.cnodes
-                        if isinstance(cn.op, ZSetInput)]
+        # (op, drain_fn): ZSetInput feeds its tick batch; UpsertInput feeds
+        # the raw command batch its compiled node diffs against state
+        self._inputs = []
+        for cn in self.ch.cnodes:
+            if isinstance(cn.op, ZSetInput):
+                self._inputs.append((cn.op, cn.op.eval))
+            elif isinstance(cn.op, UpsertInput):
+                self._inputs.append((cn.op, cn.op.take_commands))
         self._outputs = [(cn.node.index, cn.op) for cn in self.ch.cnodes
                          if isinstance(cn.op, OutputOperator)]
 
@@ -56,7 +63,7 @@ class CompiledCircuitDriver:
         """One serving tick: drain input buffers -> compiled step ->
         validate (grow + exact same-tick replay on overflow) -> deliver
         outputs to the host output operators."""
-        feeds: Dict = {op: op.eval() for op in self._inputs}
+        feeds: Dict = {op: drain() for op, drain in self._inputs}
         snap = self.ch.snapshot()
         while True:
             self.ch.step(tick=self._tick, feeds=feeds)

@@ -103,6 +103,20 @@ class UpsertInput(SourceOperator):
         return delta
 
 
+    def take_commands(self) -> Batch:
+        """Drain pending upserts as a COMMAND batch for the compiled path
+        (cnodes.CUpsertIn): unique sorted keys; weight +1 rows carry the
+        new values, -1 rows are deletes (values zero-filled)."""
+        items = sorted(self._pending.items())
+        self._pending.clear()
+        rows = []
+        for k, v in items:
+            if v is None:
+                rows.append(((*k, *([0] * len(self.val_dtypes))), -1))
+            else:
+                rows.append(((*k, *v), 1))
+        return Batch.from_tuples(rows, self.key_dtypes, self.val_dtypes)
+
     def state_dict(self):
         assert not self._pending, (
             "cannot checkpoint with undrained upserts pending — step() first")

@@ -410,3 +410,128 @@ def test_compiled_deep_ladder_matches_host(monkeypatch):
         return sum(int(b.live_count()) for b in levels[1:])
     leveled = [cn for cn in ch.cnodes if isinstance(cn, _cn._Leveled)]
     assert leveled and any(deeper_live(cn) > 0 for cn in leveled)
+
+
+# ---------------------------------------------------------------------------
+# Round-5 operator coverage: rolling, range join, upsert (VERDICT r4 #4)
+# ---------------------------------------------------------------------------
+
+
+def _rolling_build(c):
+    """Rolling 10s max bid price per auction (q17-class rolling shape)."""
+    from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.operators.aggregate import Max
+
+    streams, handles = build_inputs(c)
+    _p, _a, bids = streams
+    keyed = bids.index_by(
+        lambda k, v: (k[0], v[M.B_DATE]), (jnp.int64, jnp.int64),
+        val_fn=lambda k, v: (v[M.B_PRICE],), val_dtypes=(jnp.int64,),
+        name="roll-key")
+    out = keyed.partitioned_rolling_aggregate(Max(0), 10_000,
+                                              name="roll-max",
+                                              use_tree=False)
+    return handles, out.output()
+
+
+def test_compiled_rolling_matches_host():
+    """CRolling (window-recompute path) == host rolling, tick for tick,
+    including retraction-driven window updates."""
+    host = _host_run(_rolling_build, ticks=4)
+    comp, _ = _compiled_run(_rolling_build, ticks=4)
+    assert comp == host
+    assert any(host), "vacuous rolling comparison"
+
+
+def test_compiled_rolling_matches_host_tree_oracle():
+    """The host RADIX-TREE fast path and the compiled window-recompute
+    path answer identically (tree vs recompute differential)."""
+    def tree_build(c):
+        from dbsp_tpu.nexmark import model as M
+        from dbsp_tpu.operators.aggregate import Max
+
+        streams, handles = build_inputs(c)
+        _p, _a, bids = streams
+        keyed = bids.index_by(
+            lambda k, v: (k[0], v[M.B_DATE]), (jnp.int64, jnp.int64),
+            val_fn=lambda k, v: (v[M.B_PRICE],), val_dtypes=(jnp.int64,),
+            name="roll-key")
+        out = keyed.partitioned_rolling_aggregate(Max(0), 10_000,
+                                                  name="roll-max",
+                                                  use_tree=True)
+        return handles, out.output()
+
+    host_tree = _host_run(tree_build, ticks=4)
+    comp, _ = _compiled_run(_rolling_build, ticks=4)
+    assert comp == host_tree
+
+
+def test_compiled_sharded_rolling_8_equals_1():
+    single, _ = _sharded_run(_rolling_build, 1, ticks=3)
+    sharded, _ = _sharded_run(_rolling_build, 8, ticks=3)
+    assert sharded == single
+    assert any(single), "vacuous sharded rolling comparison"
+
+
+def _range_join_build(c):
+    """Relative range join: bids paired with auctions whose id is within
+    +-2 of the bid's auction id (exercises CRangeJoin both directions)."""
+    from dbsp_tpu.nexmark import model as M
+
+    streams, handles = build_inputs(c)
+    _p, auctions, bids = streams
+    b = bids.index_by(lambda k, v: (k[0],), (jnp.int64,),
+                      val_fn=lambda k, v: (v[M.B_PRICE],),
+                      val_dtypes=(jnp.int64,), name="rj-bids")
+    a = auctions.index_by(lambda k, v: (k[0],), (jnp.int64,),
+                          val_fn=lambda k, v: (v[M.A_CATEGORY],),
+                          val_dtypes=(jnp.int64,), name="rj-aucs")
+    out = b.join_range(
+        a, -2, 2,
+        lambda lk, lv, rk, rv: ((lk[0],), (rk[0], lv[0], rv[0])),
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="rj")
+    return handles, out.output()
+
+
+def test_compiled_range_join_matches_host():
+    host = _host_run(_range_join_build, ticks=4)
+    comp, _ = _compiled_run(_range_join_build, ticks=4)
+    assert comp == host
+    assert any(host), "vacuous range-join comparison"
+
+
+def test_compiled_upsert_matches_host():
+    """CUpsertIn: upsert/delete command sequences produce the same deltas
+    as the host upsert source, driven via CompiledCircuitDriver."""
+    from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+    from dbsp_tpu.operators.upsert import add_input_map
+
+    cmd_ticks = [
+        [(1, (10,)), (2, (20,))],
+        [(1, (11,)), (3, (30,))],          # overwrite 1
+        [(2, None)],                        # delete 2
+        [(2, (22,)), (3, (30,)), (1, None)],
+    ]
+
+    def run(compiled: bool):
+        def build(c):
+            s, h = add_input_map(c, (jnp.int64,), (jnp.int64,))
+            return h, s.integrate().output()
+
+        handle, (h, out) = Runtime.init_circuit(1, build)
+        driver = CompiledCircuitDriver(handle) if compiled else handle
+        seen = []
+        for tick in cmd_ticks:
+            for k, v in tick:
+                if v is None:
+                    h.delete((k,))
+                else:
+                    h.upsert((k,), v)
+            driver.step()
+            seen.append(out.to_dict())
+        return seen
+
+    host = run(False)
+    comp = run(True)
+    assert comp == host
+    assert host[-1] == {(2, 22): 1, (3, 30): 1}
