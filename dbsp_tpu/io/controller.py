@@ -110,6 +110,9 @@ class Controller:
         self.state = "initializing"  # reference PipelineState
         self.steps = 0
         self._stop = threading.Event()
+        self._pushed = 0              # host-pushed rows awaiting a step
+        self.total_pushed = 0         # lifetime counter (stats)
+        self._pushed_lock = threading.Lock()
         self._running = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._step_lock = threading.Lock()
@@ -134,7 +137,17 @@ class Controller:
     # push-style input (HTTP endpoints on the server use this)
     def push(self, collection: str, rows) -> int:
         col = self.catalog.input(collection)
-        return col.push_rows(rows)
+        n = col.push_rows(rows)
+        self.note_pushed(n)
+        return n
+
+    def note_pushed(self, n: int) -> None:
+        """Record host-pushed rows (HTTP endpoints / client API) so the
+        circuit loop's batching sees them alongside transport buffers —
+        without this, pushed rows waited for an explicit /step."""
+        with self._pushed_lock:
+            self._pushed += int(n)
+            self.total_pushed += int(n)
 
     # -- lifecycle (reference: start/pause/stop, controller/mod.rs:196-246) -
     def start(self) -> None:
@@ -189,6 +202,8 @@ class Controller:
                 if self._running.is_set():
                     buffered = sum(ep.buffered()
                                    for ep in self.inputs.values())
+                    with self._pushed_lock:
+                        buffered += self._pushed
                     now = time.monotonic()
                     if buffered >= self.config.min_batch_records or (
                             buffered > 0 and
@@ -206,6 +221,8 @@ class Controller:
             self._step_locked()
 
     def _step_locked(self) -> None:
+        with self._pushed_lock:
+            self._pushed = 0  # this step consumes all pushed rows
         for ep in self.inputs.values():
             rows = ep.drain()
             if rows:
@@ -238,6 +255,7 @@ class Controller:
         return {
             "state": self.state,
             "steps": self.steps,
+            "pushed_records": self.total_pushed,
             "inputs": {
                 name: {
                     "total_records": ep.total_records,

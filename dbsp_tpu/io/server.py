@@ -136,6 +136,10 @@ class CircuitServer:
                     except (ValueError, KeyError) as e:
                         return self._json({"error": f"parse error: {e}"}, 400)
                     col.push_rows(rows)
+                    # HTTP pushes must wake the circuit loop like transport
+                    # rows do — found by the console JS-path test: pushed
+                    # rows sat unstepped until an explicit /step
+                    c.note_pushed(len(rows))
                     self._json({"records": len(rows)})
                 else:
                     self._json({"error": f"no route {route}"}, 404)

@@ -103,7 +103,6 @@ class Pipeline:
             catalog.register_input(tname, h, tuple(dts))
         for vname, out in outs.items():
             catalog.register_output(vname, out, ())
-        profiler = CPUProfiler(handle.circuit)
         # Execution-mode selection (facade.rs:48,105: SQL pipelines run the
         # JIT backend when the plan supports it): attempt the compiled
         # driver — one XLA program per tick — and fall back to the
@@ -118,6 +117,12 @@ class Pipeline:
             if compiled is not None:
                 driver = compiled
                 self.mode = "compiled"
+        if self.mode == "compiled":
+            from dbsp_tpu.profile import CompiledProfiler
+
+            profiler = CompiledProfiler(driver)
+        else:
+            profiler = CPUProfiler(handle.circuit)
         self.controller = build_controller(driver, catalog,
                                            self.config or {})
         self.server = CircuitServer(self.controller, profiler=profiler)

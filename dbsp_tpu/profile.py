@@ -92,3 +92,36 @@ class CPUProfiler:
         emit(self.circuit, ())
         lines.append("}")
         return "\n".join(lines)
+
+
+class CompiledProfiler:
+    """Profile source for pipelines on the compiled path: the whole tick is
+    ONE XLA program, so the host profiler's per-operator eval timings do
+    not exist. Reports the same JSON shape with the compiled node list
+    (operator name, node id, static capacities) plus whole-tick latency
+    percentiles — the observable the compiled mode actually has (the
+    reference's JIT profile is similarly coarser than the interpreted
+    one)."""
+
+    def __init__(self, driver):
+        self.driver = driver
+
+    def profile(self):
+        return [{"name": cn.op.name, "node": cn.node.index,
+                 "kind": type(cn).__name__, "caps": dict(cn.caps)}
+                for cn in self.driver.ch.cnodes]
+
+    def _latency(self):
+        lat = sorted(self.driver.ch.step_times_ns)
+        if not lat:
+            return {}
+        return {"p50_ms": round(lat[len(lat) // 2] / 1e6, 3),
+                "p99_ms": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))] / 1e6, 3),
+                "ticks": len(lat)}
+
+    def dump_json(self) -> str:
+        return json.dumps({"steps": getattr(self.driver, "_tick", 0),
+                           "mode": "compiled",
+                           "tick_latency": self._latency(),
+                           "operators": self.profile()})

@@ -702,6 +702,20 @@ class SqlContext:
         rkeyed = right.index_by(rk, (key_dt,), val_fn=rv,
                                 val_dtypes=tuple(rs.dtypes),
                                 name=f"sql-rkey{n}")
+        # SQL: NULL = NULL is NULL — a NULL join key matches NOTHING.
+        # Code equality would pair NULL markers, so null-keyed rows leave
+        # the join inputs here; a LEFT JOIN still surfaces the left side's
+        # null-keyed rows through the antijoin pad below (they match no
+        # right row — exactly SQL's outcome).
+        lkeyed_all = lkeyed  # pre-filter view: LEFT JOIN pads need the
+        if li in ls.nullable:  # null-keyed left rows too
+            lkeyed = lkeyed.filter_rows(
+                lambda k, v, _n=NULL_INT(ls.dtypes[li]): k[0] != _n,
+                name=f"sql-lnn{n}")
+        if ri in rs.nullable:
+            rkeyed = rkeyed.filter_rows(
+                lambda k, v, _n=NULL_INT(rs.dtypes[ri]): k[0] != _n,
+                name=f"sql-rnn{n}")
         joined = lkeyed.join_index(
             rkeyed, lambda k, lvs, rvs: (k, (*lvs, *rvs)),
             (key_dt,), (*ls.dtypes, *rs.dtypes), name=f"sql-join{n}")
@@ -714,7 +728,7 @@ class SqlContext:
                 return k, (*v, *(jnp.full(v[0].shape, nv, jnp.dtype(dt))
                                  for nv, dt in zip(_nulls, _dts)))
 
-            missing = lkeyed.antijoin(rkeyed).map_rows(
+            missing = lkeyed_all.antijoin(rkeyed).map_rows(
                 pad, (key_dt,), (*ls.dtypes, *rs.dtypes),
                 name=f"sql-leftpad{n}")
             joined = joined.plus(missing)

@@ -36,7 +36,13 @@ TABLES = {
     "t1": ["a", "b", "c"],
     "t2": ["x", "y"],
     "t3": ["p", "q"],
+    # string + nullable columns: d INT NOT NULL, s VARCHAR NULL, m INT NULL
+    "t4": ["d", "s", "m"],
 }
+STRING_COLS = {"t4": ("s",)}
+NULLABLE_COLS = {"t4": ("s", "m")}
+WORDS = ["apple", "apricot", "banana", "berry", "cherry", "date", "fig",
+         "grape", None]
 
 
 def _data(rng):
@@ -46,7 +52,9 @@ def _data(rng):
     rows3 = [(rng.randrange(0, 30), rng.randrange(50, 99)) for _ in range(10)]
     # unique 'c' values for ORDER BY determinism at the LIMIT boundary
     rows1 = [(a, b, 100 * i + c) for i, (a, b, c) in enumerate(rows1)]
-    return {"t1": rows1, "t2": rows2, "t3": rows3}
+    rows4 = [(rng.randrange(10), rng.choice(WORDS),
+              rng.choice([None, *range(-5, 15)])) for _ in range(30)]
+    return {"t1": rows1, "t2": rows2, "t3": rows3, "t4": rows4}
 
 
 def _cases():
@@ -308,12 +316,232 @@ def _extended_cases():
     return qs
 
 
+def _null_str_cases():
+    """String / NULL / set-membership corpus (round-5 planner features):
+    three-valued predicates and projections over declared-nullable
+    columns, dictionary-string equality + IN + LIKE, LEFT-JOIN pads under
+    predicates, and IN (SELECT)/EXISTS conjuncts — pairwise-crossed for
+    volume, sqlite as the oracle throughout."""
+    qs = []
+    NPRED = ["m > 3", "m IS NULL", "m IS NOT NULL", "m + 1 > 2",
+             "not m > 2", "m IS NOT NULL and m < 8", "m > 0 or d > 5",
+             "m between 0 and 6"]
+    SPRED = ["s = 'apple'", "s <> 'banana'", "s IN ('apple', 'berry')",
+             "s NOT IN ('apple', 'berry')", "s LIKE 'a%'",
+             "s LIKE '%rr%'", "s NOT LIKE 'b%'", "s IS NULL",
+             "s IS NOT NULL"]
+    PROJ = ["d", "d, m", "d, m + 1", "d, s", "s, m"]
+    # nullable/string predicates x projections (+ DISTINCT variants)
+    for p in NPRED + SPRED:
+        for proj in PROJ:
+            qs.append(f"SELECT {proj} FROM t4 WHERE {p}")
+        qs.append(f"SELECT DISTINCT d FROM t4 WHERE {p}")
+    # Kleene combinations: nullable x string predicate pairs
+    for p1 in NPRED[:6]:
+        for p2 in SPRED[:6]:
+            for comb in ("and", "or"):
+                qs.append(f"SELECT d, m FROM t4 WHERE ({p1}) {comb} ({p2})")
+    # string GROUP BY + NULL-aware aggregates over nullable args
+    for agg in ("count(*)", "count(m)", "sum(m)", "min(m)", "max(m)",
+                "avg(m)"):
+        qs.append(f"SELECT s, {agg} AS v FROM t4 GROUP BY s")
+        qs.append(f"SELECT d, {agg} AS v FROM t4 GROUP BY d")
+        for p in NPRED[:4] + SPRED[:4]:
+            qs.append(f"SELECT d, {agg} AS v FROM t4 WHERE {p} GROUP BY d")
+    # HAVING over nullable aggregates
+    for hv in ("count(m) > 1", "sum(m) > 4", "min(m) < 2",
+               "count(*) > 2 and max(m) > 3"):
+        qs.append(f"SELECT d, count(*) AS n FROM t4 GROUP BY d "
+                  f"HAVING {hv}")
+    # LEFT JOIN pads under predicates/projections (t1 x t4 on a = d)
+    for p in ("t4.m IS NULL", "t4.m > 2", "t4.s = 'apple'",
+              "t4.s IS NULL", "t4.m + 1 > 3", "t4.d IS NOT NULL",
+              "not t4.m > 4"):
+        qs.append("SELECT t1.a, t4.m FROM t1 LEFT JOIN t4 "
+                  f"ON t1.a = t4.d WHERE {p}")
+        qs.append("SELECT t1.a, t4.m + 1 FROM t1 LEFT JOIN t4 "
+                  f"ON t1.a = t4.d WHERE {p}")
+    for agg in ("count(t4.m)", "sum(t4.m)", "max(t4.m)", "avg(t4.m)"):
+        qs.append(f"SELECT t1.a, {agg} AS v FROM t1 LEFT JOIN t4 "
+                  "ON t1.a = t4.d GROUP BY t1.a")
+    # joins on string columns (equality on dictionary codes)
+    qs.append("SELECT u.d, v.d FROM t4 u JOIN t4 v ON u.s = v.s "
+              "WHERE u.d < v.d")
+    qs.append("SELECT u.d, v.m FROM t4 u JOIN t4 v ON u.s = v.s "
+              "WHERE u.m IS NULL")
+    # IN (SELECT) / EXISTS / NOT EXISTS x outer predicates x sub predicates
+    for p1 in PREDS1:
+        for p2 in PREDS2[:4]:
+            qs.append(f"SELECT a FROM t1 WHERE {p1} AND a IN "
+                      f"(SELECT x FROM t2 WHERE {p2})")
+            qs.append(f"SELECT a, b FROM t1 WHERE {p1} AND a NOT IN "
+                      f"(SELECT x FROM t2 WHERE {p2})")
+            qs.append(f"SELECT a FROM t1 WHERE {p1} AND EXISTS "
+                      f"(SELECT x FROM t2 WHERE t2.x = t1.a AND {p2})")
+            qs.append(f"SELECT a FROM t1 WHERE {p1} AND NOT EXISTS "
+                      f"(SELECT x FROM t2 WHERE t2.x = t1.a AND {p2})")
+    # membership over t4/t3 and uncorrelated EXISTS
+    for p in NPRED[:5]:
+        qs.append(f"SELECT d FROM t4 WHERE {p} AND d IN "
+                  "(SELECT a FROM t1 WHERE a < 6)")
+        qs.append(f"SELECT d, m FROM t4 WHERE ({p}) AND EXISTS "
+                  "(SELECT p FROM t3 WHERE q > 60)")
+        qs.append(f"SELECT d FROM t4 WHERE {p} AND m IN "
+                  "(SELECT y FROM t2 WHERE y IS NOT NULL)")
+    # IN-list over ints x predicates (incl. NULL literal member)
+    for p in PREDS1[:5]:
+        qs.append(f"SELECT a FROM t1 WHERE {p} AND a IN (1, 3, 5, 7)")
+        qs.append(f"SELECT a FROM t1 WHERE {p} AND a NOT IN (2, 4)")
+        qs.append(f"SELECT a, b FROM t1 WHERE {p} AND b IN (0, NULL, 5)")
+    # membership x join kind
+    for (jk, (frm, cols)) in JOIN_FROMS.items():
+        for sub in ("t1.a IN (SELECT x FROM t2)",
+                    "EXISTS (SELECT p FROM t3 WHERE t3.p = t1.a)",
+                    "t1.a NOT IN (SELECT p FROM t3)"):
+            if "t1.a" in " ".join(cols) or jk == "t1only":
+                qs.append(f"SELECT {cols[0]} FROM {frm} WHERE {sub}")
+    # strings through FROM-subqueries and set ops
+    for p in SPRED[:5]:
+        qs.append("SELECT u.s, u.m FROM (SELECT s, m FROM t4 "
+                  f"WHERE {p}) u WHERE u.m IS NOT NULL")
+        qs.append(f"SELECT s FROM t4 WHERE {p} UNION "
+                  "SELECT s FROM t4 WHERE s LIKE 'c%'")
+        qs.append(f"SELECT s FROM t4 WHERE {p} EXCEPT "
+                  "SELECT s FROM t4 WHERE m IS NULL")
+    # volume: 3-way Kleene over nullable preds
+    for p1, p2, p3 in itertools.combinations(NPRED[:6], 3):
+        qs.append(f"SELECT d FROM t4 WHERE ({p1}) and (({p2}) or ({p3}))")
+        qs.append(f"SELECT d, m FROM t4 WHERE (({p1}) or ({p2})) "
+                  f"and not ({p3})")
+    # volume: string pred x nullable pred x projection
+    for p1 in SPRED:
+        for p2 in NPRED:
+            qs.append(f"SELECT d, s, m FROM t4 WHERE ({p1}) and ({p2})")
+            qs.append(f"SELECT DISTINCT s FROM t4 WHERE ({p1}) or ({p2})")
+    # volume: membership x scalar subquery x predicate
+    for p in PREDS1:
+        for cmp_ in ("<", ">="):
+            qs.append(f"SELECT a FROM t1 WHERE {p} AND a IN "
+                      "(SELECT x FROM t2) AND b "
+                      f"{cmp_} (SELECT min(b) FROM t1)")
+    # --- volume sweeps (the 5k-corpus pairwise crosses) -------------------
+    ALLP = SPRED + NPRED
+    # every string/nullable predicate pair x AND/OR x three projections
+    for p1, p2 in itertools.combinations(ALLP, 2):
+        for comb in ("and", "or"):
+            qs.append(f"SELECT d FROM t4 WHERE ({p1}) {comb} ({p2})")
+            qs.append(f"SELECT d, m FROM t4 WHERE ({p1}) {comb} ({p2})")
+            qs.append(f"SELECT DISTINCT s FROM t4 "
+                      f"WHERE ({p1}) {comb} ({p2})")
+    # 3-way Kleene over a mixed sample
+    for p1, p2, p3 in itertools.combinations(ALLP[::2], 3):
+        qs.append(f"SELECT d FROM t4 WHERE ({p1}) and (({p2}) or ({p3}))")
+        qs.append(f"SELECT d, s FROM t4 WHERE (({p1}) or ({p2})) "
+                  f"and not ({p3})")
+    # nullable arithmetic projections x predicates
+    for e in ("m + 1", "m * 2", "m - d", "m + d", "0 - m", "m / 2",
+              "m % 3", "m * m"):
+        for p in ALLP:
+            qs.append(f"SELECT d, {e} AS e FROM t4 WHERE {p}")
+    # IN-lists x predicates x projections
+    for lst in ("(1, 2, 3)", "(0, 5, 9)", "(2, NULL)", "(7)",
+                "(1, 3, 5, 7, 9)", "(-1, 0, 1)"):
+        for p in ALLP[:10]:
+            qs.append(f"SELECT d FROM t4 WHERE {p} AND m IN {lst}")
+            qs.append(f"SELECT d, m FROM t4 WHERE {p} AND d IN {lst}")
+    # LIKE pattern sweep x nullable predicates
+    for pat in ("a%", "%e", "%an%", "_pple", "%a%", "c%", "%y"):
+        for p in NPRED:
+            qs.append(f"SELECT d, s FROM t4 WHERE s LIKE '{pat}' "
+                      f"AND {p}")
+            qs.append(f"SELECT d FROM t4 WHERE s NOT LIKE '{pat}' "
+                      f"OR {p}")
+    # membership x join kind x aggregate
+    for (jk, (frm, cols)) in JOIN_FROMS.items():
+        for agg in AGGS:
+            for sub in ("t1.a IN (SELECT x FROM t2)",
+                        "EXISTS (SELECT p FROM t3 WHERE t3.p = t1.a)",
+                        "t1.a NOT IN (SELECT p FROM t3)"):
+                qs.append(f"SELECT {cols[0]}, {agg} AS v FROM {frm} "
+                          f"WHERE {sub} GROUP BY {cols[0]}")
+    # scalar subqueries against t4 x nullable predicates
+    for p in NPRED[:6]:
+        for cmp_ in ("<", ">", "<=", ">="):
+            qs.append(f"SELECT d, m FROM t4 WHERE {p} "
+                      f"AND d {cmp_} (SELECT avg(a) FROM t1)")
+    # t4 self-join on string key x predicate pairs
+    for p1 in SPRED[:6]:
+        for p2 in NPRED[:6]:
+            qs.append("SELECT u.d, v.d FROM t4 u JOIN t4 v "
+                      f"ON u.s = v.s WHERE ({p1.replace('s ', 'u.s ')})"
+                      f" and ({p2.replace('m ', 'v.m ').replace('d ', 'v.d ')})")
+    # string GROUP BY x HAVING x aggregate
+    for agg in ("count(*)", "count(m)", "sum(m)", "max(m)"):
+        for hv in ("count(*) > 1", "count(m) > 1", "sum(m) > 3",
+                   "min(m) < 4", "max(m) >= 5", "not count(*) = 1"):
+            qs.append(f"SELECT s, {agg} AS v FROM t4 GROUP BY s "
+                      f"HAVING {hv}")
+    # ORDER BY/LIMIT over t4's unique-ish d with predicates
+    for p in ALLP[:12]:
+        qs.append(f"SELECT d, m FROM t4 WHERE {p} ORDER BY d LIMIT 5")
+    # membership nesting through FROM-subqueries
+    for p in PREDS1[:5]:
+        qs.append("SELECT u.a FROM (SELECT a, b FROM t1 WHERE a IN "
+                  f"(SELECT x FROM t2)) u WHERE {p.replace('a ', 'u.a ').replace('b ', 'u.b ')}")
+    # inner join t1 x t4 (int key) x int predicate x nullable predicate
+    for p1 in PREDS1:
+        for p2 in NPRED:
+            qs.append("SELECT t1.a, t4.m FROM t1 JOIN t4 ON t1.a = t4.d "
+                      f"WHERE ({p1}) and ({p2.replace('m', 't4.m').replace('d ', 't4.d ')})")
+            qs.append("SELECT t1.b, t4.s FROM t1 JOIN t4 ON t1.a = t4.d "
+                      f"WHERE ({p1}) or ({p2.replace('m', 't4.m').replace('d ', 't4.d ')})")
+    # LEFT JOIN pad predicate pairs (both sides of the Kleene table)
+    pads = ["t4.m IS NULL", "t4.m > 2", "t4.s = 'apple'", "t4.s IS NULL",
+            "t4.m + 1 > 3", "not t4.m > 4", "t4.m IS NOT NULL"]
+    for p1 in pads:
+        for p2 in PREDS1:
+            qs.append("SELECT t1.a, t4.m FROM t1 LEFT JOIN t4 "
+                      f"ON t1.a = t4.d WHERE ({p1}) and ({p2})")
+            qs.append("SELECT t1.a FROM t1 LEFT JOIN t4 "
+                      f"ON t1.a = t4.d WHERE ({p1}) or ({p2})")
+    # nullable expression pairs x predicates
+    for (e1, e2) in itertools.combinations(
+            ["m + 1", "m - d", "m * 2", "0 - m", "m % 3", "m / 2",
+             "m + d", "d - m"], 2):
+        for p in ALLP[:10]:
+            qs.append(f"SELECT {e1} AS u, {e2} AS w FROM t4 WHERE {p}")
+    # AND NOT pairs (the Kleene table's third column)
+    for p1, p2 in itertools.combinations(ALLP, 2):
+        qs.append(f"SELECT d, m FROM t4 WHERE ({p1}) and not ({p2})")
+    # membership over t4 x every string/nullable predicate
+    for p in ALLP:
+        qs.append(f"SELECT d FROM t4 WHERE ({p}) AND d IN "
+                  "(SELECT x FROM t2)")
+        qs.append(f"SELECT d, s FROM t4 WHERE ({p}) AND NOT EXISTS "
+                  "(SELECT x FROM t2 WHERE t2.x = t4.d)")
+    # full PREDS2 sweep for correlated EXISTS (completes the [:4] slice)
+    for p1 in PREDS1:
+        qs.append(f"SELECT a FROM t1 WHERE {p1} AND EXISTS "
+                  f"(SELECT x FROM t2 WHERE t2.x = t1.a AND {PREDS2[4]})")
+        qs.append(f"SELECT a FROM t1 WHERE {p1} AND a IN "
+                  f"(SELECT x FROM t2 WHERE {PREDS2[4]})")
+    # IS NULL over projections of every nullable expression
+    for e in ("m + 1", "m - d", "m * 2", "0 - m", "m % 3", "m / 2"):
+        for p in ALLP[:6]:
+            qs.append(f"SELECT d FROM t4 WHERE ({e} IS NULL) and ({p})")
+            qs.append(f"SELECT d FROM t4 WHERE {e} IS NOT NULL and ({p})")
+    return qs
+
+
 def _sqlite_expected(conn, sql):
     cur = conn.execute(sql)
     rows = cur.fetchall()
     out = {}
     for r in rows:
-        key = tuple(NULL_INT(np.int64) if v is None else int(v) for v in r)
+        # native cells: strings stay strings, NULL stays None (our side
+        # decodes through SqlContext.decode_output to the same shape)
+        key = tuple(v if v is None or isinstance(v, str) else int(v)
+                    for v in r)
         out[key] = out.get(key, 0) + 1
     return out
 
@@ -345,17 +573,20 @@ def _run_chunk(queries):
         for t, cols in TABLES.items():
             s, h = add_input_zset(c, (jnp.int64,),
                                   (jnp.int64,) * (len(cols) - 1))
-            ctx.register_table(t, s, cols)
+            ctx.register_table(t, s, cols,
+                               string_cols=STRING_COLS.get(t, ()),
+                               nullable_cols=NULLABLE_COLS.get(t, ()))
             handles[t] = h
-        return handles, [ctx.query(q).output() for q in queries]
+        views = [ctx.query(q) for q in queries]
+        return ctx, handles, views, [v.output() for v in views]
 
-    handle, (handles, outs) = Runtime.init_circuit(1, build)
+    handle, (ctx, handles, views, outs) = Runtime.init_circuit(1, build)
     for t, rows in data.items():
-        handles[t].extend([(r, 1) for r in rows])
+        handles[t].extend([(ctx.encode_row(t, r), 1) for r in rows])
     handle.step()
     failures = []
-    for q, out in zip(queries, outs):
-        got = out.to_dict()
+    for q, view, out in zip(queries, views, outs):
+        got = ctx.decode_output(view, out.to_dict())
         want = _sqlite_expected(conn, _to_sqlite(q))
         if got != want:
             failures.append((q, got, want))
@@ -405,11 +636,23 @@ def test_slt_conformance():
         f"{failures[0]}")
 
 
+def test_slt_null_str_membership():
+    """The round-5 feature corpus: three-valued NULL logic, dictionary
+    strings (=/IN/LIKE/GROUP BY/joins), LEFT-JOIN pads under predicates,
+    and IN (SELECT)/EXISTS lowering — a few hundred cases vs sqlite."""
+    queries = _null_str_cases()
+    assert len(queries) >= 500, len(queries)
+    failures = _run_cases(queries[:300], batch=300)
+    assert not failures, (
+        f"{len(failures)} queries diverge; first 3: {failures[:3]}")
+
+
 def test_slt_full_corpus():
-    """The >=2000-case pairwise corpus (core + generated) vs sqlite —
-    set ops, join chains, FROM-subqueries, and the feature cross-sweeps."""
-    queries = _cases() + _extended_cases()
-    assert len(queries) >= 2000, len(queries)
+    """The >=5000-case pairwise corpus (core + generated + the round-5
+    string/NULL/membership families) vs sqlite — set ops, join chains,
+    FROM-subqueries, feature cross-sweeps, three-valued predicates."""
+    queries = _cases() + _extended_cases() + _null_str_cases()
+    assert len(queries) >= 5000, len(queries)
     failures = _run_cases(queries)
     assert not failures, (
         f"{len(failures)}/{len(queries)} queries diverge; first 3: "
