@@ -16,13 +16,39 @@ of compiled kernel shapes stays logarithmic.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, Row, bucket_cap, concat_batches
+
+# Device-residency budget (rows) for EACH spine: levels beyond it live in
+# HOST memory as numpy-backed batches and transfer on probe. None = no cap.
+# The larger-than-device-memory story (reference: the RocksDB-backed
+# PersistentTrace, trace/persistent/trace.rs:34 — a drop-in Spine whose
+# cold levels spill to disk): here the hierarchy is HBM <- host RAM, the
+# tiers a TPU actually has, and the transfer unit is a whole cold level.
+DEVICE_BUDGET_ROWS: Optional[int] = (
+    int(os.environ["DBSP_TPU_DEVICE_ROWS"])
+    if os.environ.get("DBSP_TPU_DEVICE_ROWS") else None)
+
+
+def _to_cold(batch: Batch) -> Batch:
+    """Move a batch's columns to host memory (numpy). jnp kernels accept
+    numpy operands and device_put them per call, so cold levels stay fully
+    probe-able — each probe pays the transfer, nothing persists on device
+    (the fetched operand buffers die with the call)."""
+    return Batch(tuple(np.asarray(c) for c in batch.keys),
+                 tuple(np.asarray(c) for c in batch.vals),
+                 np.asarray(batch.weights))
+
+
+def _is_cold(batch: Batch) -> bool:
+    return isinstance(batch.weights, np.ndarray)
 
 
 class Spine:
@@ -34,12 +60,45 @@ class Spine:
     (:meth:`probe_ranges`).
     """
 
-    def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = ()):
+    def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = (),
+                 device_budget_rows: Optional[int] = None):
         self.key_dtypes = tuple(jnp.dtype(d) for d in key_dtypes)
         self.val_dtypes = tuple(jnp.dtype(d) for d in val_dtypes)
         self.batches: List[Batch] = []
         self.dirty = False  # any insert since last clear (fixedpoint checks)
         self._consolidated: Optional[Batch] = None
+        self.device_budget_rows = (device_budget_rows
+                                   if device_budget_rows is not None
+                                   else DEVICE_BUDGET_ROWS)
+
+    def device_resident_rows(self) -> int:
+        """Capacity currently held in DEVICE memory (cold levels excluded)
+        — what the budget bounds; tests assert against it."""
+        return sum(b.cap for b in self.batches if not _is_cold(b))
+
+    def _enforce_budget(self) -> None:
+        """Offload the largest device levels to host until the device
+        residency fits the budget. Largest-first: deep levels are the
+        coldest (probed identically but re-merged the least), so one
+        offload buys the most headroom per transfer."""
+        if self.device_budget_rows is None:
+            return
+        hot = sorted((b for b in self.batches
+                      if not _is_cold(b) and not b.sharded),
+                     key=lambda b: b.cap, reverse=True)
+        resident = sum(b.cap for b in hot)
+        # hard cap, largest level first (deep levels are re-merged the
+        # least, so one offload buys the most headroom per transfer); a
+        # budget below the delta size degrades to offload-every-insert —
+        # bounded residency at bounded (transfer-per-probe) slowdown,
+        # which is the PersistentTrace contract
+        for b in hot:
+            if resident <= self.device_budget_rows:
+                break
+            # identity lookup: dataclass == on Batch would compare columns
+            i = next(i for i, x in enumerate(self.batches) if x is b)
+            self.batches[i] = _to_cold(b)
+            resident -= b.cap
 
     # -- maintenance --------------------------------------------------------
     def insert(self, batch: Batch) -> None:
@@ -67,6 +126,7 @@ class Spine:
                         self.batches.sort(key=lambda b: b.cap, reverse=True)
                     merged = True
                     break
+        self._enforce_budget()
 
     def is_empty(self) -> bool:
         return not self.batches
@@ -114,6 +174,7 @@ class Spine:
                 new.append(kept)
         self.batches = sorted(new, key=lambda b: b.cap, reverse=True)
         self._consolidated = None
+        self._enforce_budget()
 
     # -- probes (cursor equivalents) ----------------------------------------
     def probe_ranges(self, query_keys: Tuple[jnp.ndarray, ...]
