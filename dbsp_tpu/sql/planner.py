@@ -626,7 +626,14 @@ class SqlContext:
         else:
             stream = self._plan_project(ast, stream, scope)
         if ast.distinct:
+            inner = stream
             stream = stream.distinct()
+            # distinct re-emits the same columns: carry the SQL metadata
+            # (names/nullable/string markers drive output decoding)
+            for attr in ("_sql_names", "_sql_nullable_cols",
+                         "_sql_str_cols"):
+                if hasattr(inner, attr):
+                    setattr(stream, attr, getattr(inner, attr))
         if ast.limit is not None:
             stream = self._plan_topk(ast, stream)
         return stream

@@ -316,6 +316,18 @@ def _extended_cases():
     return qs
 
 
+
+
+def _qual(pred: str, **cols) -> str:
+    """Qualify bare column names in a generated predicate (word-boundary
+    safe: a naive str.replace of 'd ' corrupts 'and')."""
+    import re as _re
+
+    for col, repl in cols.items():
+        pred = _re.sub(rf"\b{col}\b", repl, pred)
+    return pred
+
+
 def _null_str_cases():
     """String / NULL / set-membership corpus (round-5 planner features):
     three-valued predicates and projections over declared-nullable
@@ -473,8 +485,8 @@ def _null_str_cases():
     for p1 in SPRED[:6]:
         for p2 in NPRED[:6]:
             qs.append("SELECT u.d, v.d FROM t4 u JOIN t4 v "
-                      f"ON u.s = v.s WHERE ({p1.replace('s ', 'u.s ')})"
-                      f" and ({p2.replace('m ', 'v.m ').replace('d ', 'v.d ')})")
+                      f"ON u.s = v.s WHERE ({_qual(p1, s='u.s')})"
+                      f" and ({_qual(p2, m='v.m', d='v.d')})")
     # string GROUP BY x HAVING x aggregate
     for agg in ("count(*)", "count(m)", "sum(m)", "max(m)"):
         for hv in ("count(*) > 1", "count(m) > 1", "sum(m) > 3",
@@ -487,14 +499,14 @@ def _null_str_cases():
     # membership nesting through FROM-subqueries
     for p in PREDS1[:5]:
         qs.append("SELECT u.a FROM (SELECT a, b FROM t1 WHERE a IN "
-                  f"(SELECT x FROM t2)) u WHERE {p.replace('a ', 'u.a ').replace('b ', 'u.b ')}")
+                  f"(SELECT x FROM t2)) u WHERE {_qual(p, a='u.a', b='u.b')}")
     # inner join t1 x t4 (int key) x int predicate x nullable predicate
     for p1 in PREDS1:
         for p2 in NPRED:
             qs.append("SELECT t1.a, t4.m FROM t1 JOIN t4 ON t1.a = t4.d "
-                      f"WHERE ({p1}) and ({p2.replace('m', 't4.m').replace('d ', 't4.d ')})")
+                      f"WHERE ({p1}) and ({_qual(p2, m='t4.m', d='t4.d')})")
             qs.append("SELECT t1.b, t4.s FROM t1 JOIN t4 ON t1.a = t4.d "
-                      f"WHERE ({p1}) or ({p2.replace('m', 't4.m').replace('d ', 't4.d ')})")
+                      f"WHERE ({p1}) or ({_qual(p2, m='t4.m', d='t4.d')})")
     # LEFT JOIN pad predicate pairs (both sides of the Kleene table)
     pads = ["t4.m IS NULL", "t4.m > 2", "t4.s = 'apple'", "t4.s IS NULL",
             "t4.m + 1 > 3", "not t4.m > 4", "t4.m IS NOT NULL"]
