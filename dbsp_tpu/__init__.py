@@ -17,6 +17,9 @@ Architecture (TPU-first, not a port):
   - Worker parallelism is SPMD over a ``jax.sharding.Mesh``: the reference's
     key-hash shard()/exchange maps to an all_to_all over ICI
     (``dbsp_tpu.parallel``).
+  - Observability is one registry-backed subsystem: labeled metrics with
+    Prometheus exposition, per-operator latency histograms, spine residency
+    gauges, and Chrome-trace span export (``dbsp_tpu.obs``).
 
 64-bit integers are enabled globally: stream timestamps (ms since epoch) and
 SQL BIGINT semantics require them.

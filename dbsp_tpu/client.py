@@ -42,6 +42,11 @@ class PipelineHandle:
         with urllib.request.urlopen(self.base + "/metrics", timeout=30) as r:
             return r.read().decode()
 
+    def trace(self) -> dict:
+        """Chrome-trace JSON of the recent step window (Perfetto-loadable;
+        see README §Observability)."""
+        return _req(self.base + "/trace")
+
     def profile(self) -> dict:
         return _req(self.base + "/dump_profile")
 
@@ -158,6 +163,12 @@ class Connection:
 
     def pipelines(self) -> List[dict]:
         return _req(self.base + "/pipelines")
+
+    def metrics(self) -> str:
+        """Fleet-wide Prometheus exposition: every deployed pipeline's
+        registry under a ``pipeline="<name>"`` label."""
+        with urllib.request.urlopen(self.base + "/metrics", timeout=30) as r:
+            return r.read().decode()
 
     def shutdown_pipeline(self, name: str) -> None:
         _req(f"{self.base}/pipelines/{name}/shutdown", data=b"",

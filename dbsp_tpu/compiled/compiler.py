@@ -225,6 +225,10 @@ class CompiledHandle:
         self._max_jit = jax.jit(jnp.maximum)
         self.last_outputs: Dict[int, Batch] = {}
         self.step_times_ns: List[int] = []
+        # grow-and-replay cycles since construction (observability: the
+        # obs registry exports this as
+        # dbsp_tpu_compiled_overflow_replays_total)
+        self.overflow_replays = 0
 
     # -- feeds ---------------------------------------------------------------
     def _feed_indices(self, feeds: Dict) -> Dict[int, Batch]:
@@ -286,7 +290,7 @@ class CompiledHandle:
         # cross-worker communication (all_to_all / all_gather over the mesh
         # axis) — the reference's worker/exchange architecture as a single
         # SPMD program (shard.rs:35-101, exchange.rs:586).
-        from jax import shard_map
+        from dbsp_tpu.parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dbsp_tpu.parallel.mesh import WORKER_AXIS
@@ -355,7 +359,7 @@ class CompiledHandle:
         if self.mesh is None:
             return jax.jit(_scan_body, donate_argnums=(0,))
 
-        from jax import shard_map
+        from dbsp_tpu.parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dbsp_tpu.parallel.mesh import WORKER_AXIS
@@ -675,10 +679,11 @@ class CompiledHandle:
         """Run ticks [t0, t0+n) under a ``gen_fn`` with periodic validation
         and snapshot/replay on overflow (exact: inputs are functions of the
         tick index). ``on_validated(next_tick)`` fires after each validated
-        interval — with ``snapshot_every > 1`` an overflow replays every
-        interval since the last snapshot, RE-firing the callback for
-        already-reported ticks; callbacks must be idempotent per tick
-        (record "progress through tick N", don't accumulate). ``block_each`` waits per tick so ``step_times_ns`` records
+        interval with EXACTLY-ONCE delivery per reported tick: a high-water
+        mark suppresses re-fires while an overflow replay re-runs intervals
+        since the last snapshot (``snapshot_every > 1``), so accumulating
+        callbacks (throughput counters) stay correct across replays.
+        ``block_each`` waits per tick so ``step_times_ns`` records
         true per-tick latency instead of dispatch time (a bare device sync is
         ~0.1ms even over the tunnel; only data fetches are expensive).
 
@@ -690,6 +695,7 @@ class CompiledHandle:
         snap, snap_t = self.snapshot(), t0
         t = t0
         iv = 0
+        reported = t0  # high-water tick already delivered to on_validated
         while t < t0 + n:
             upto = min(t + validate_every, t0 + n)
             if scan:
@@ -700,6 +706,7 @@ class CompiledHandle:
             try:
                 self.validate()
             except CompiledOverflow as e:
+                self.overflow_replays += 1
                 self.grow(e, project_ratio=project_ratio)
                 self.restore(snap)
                 t = snap_t
@@ -713,8 +720,11 @@ class CompiledHandle:
                 # rare overflow widens accordingly, which determinism makes
                 # exact either way
                 snap, snap_t = self.snapshot(), t
-            if on_validated is not None:
+            if on_validated is not None and t > reported:
+                # replayed intervals (t <= reported after an overflow
+                # rewind) were already delivered — suppress the duplicate
                 on_validated(t)
+                reported = t
 
     # -- host views -----------------------------------------------------------
     def output(self, handle_or_op) -> Optional[Batch]:

@@ -25,16 +25,21 @@ tests) sees compiled and host pipelines identically.
 
 from __future__ import annotations
 
+import contextlib
+import logging
 from typing import Dict, Optional
 
 from dbsp_tpu.compiled.compiler import (CompiledHandle, CompiledOverflow,
                                         compile_circuit)
+
+logger = logging.getLogger(__name__)
 
 
 class CompiledCircuitDriver:
     """Controller-facing driver over a compiled circuit (see module doc)."""
 
     mode = "compiled"
+    spans = None  # optional obs.SpanRecorder (set by CompiledInstrumentation)
 
     def __init__(self, handle, compiled: Optional[CompiledHandle] = None):
         from dbsp_tpu.operators.io_handles import OutputOperator, ZSetInput
@@ -64,13 +69,21 @@ class CompiledCircuitDriver:
         validate (grow + exact same-tick replay on overflow) -> deliver
         outputs to the host output operators."""
         feeds: Dict = {op: drain() for op, drain in self._inputs}
+        spans = self.spans
+        if spans is not None:
+            spans.begin(f"tick[{self._tick}]", cat="step")
         snap = self.ch.snapshot()
         while True:
-            self.ch.step(tick=self._tick, feeds=feeds)
+            with (spans.span("compiled_step", cat="compiled") if spans
+                  is not None else contextlib.nullcontext()):
+                self.ch.step(tick=self._tick, feeds=feeds)
             try:
                 self.ch.validate()
                 break
             except CompiledOverflow as e:
+                self.ch.overflow_replays += 1
+                if spans is not None:
+                    spans.instant("overflow_replay", cat="compiled")
                 self.ch.grow(e)
                 self.ch.restore(snap)
         self.ch.maintain()  # spine drains; dispatch-free when nothing due
@@ -79,13 +92,36 @@ class CompiledCircuitDriver:
             batch = self.ch.last_outputs.get(idx)
             if batch is not None:
                 out_op.eval(batch)
+        if spans is not None:
+            spans.end(f"tick[{self._tick - 1}]")
 
 
-def try_compiled_driver(handle):
+def try_compiled_driver(handle, registry=None):
     """Compile the circuit if every operator has a compiled equivalent;
     None when it must stay on the host-driven path (the caller records
-    which mode the pipeline runs — facade.rs's feature gate)."""
+    which mode the pipeline runs — facade.rs's feature gate).
+
+    ANY compile-time failure falls back: ``NotImplementedError`` is the
+    designed signal (operator without a compiled node), but init_state()
+    can also raise (e.g. ``AssertionError`` from CZ1Input for non-Batch
+    feedback) — with compiled mode defaulting on for every manager
+    pipeline, an unexpected compile error must degrade to the host
+    scheduler that previously ran the circuit, not kill the deploy. The
+    failure is logged and, when ``registry`` (obs.MetricsRegistry) is
+    given, counted as ``dbsp_tpu_compiled_fallback_total{reason=...}``."""
     try:
         return CompiledCircuitDriver(handle)
-    except NotImplementedError:
+    except Exception as e:  # noqa: BLE001 — deliberate: fallback, not crash
+        reason = type(e).__name__
+        if isinstance(e, NotImplementedError):
+            logger.debug("compiled driver unavailable: %s", e)
+        else:
+            logger.warning("compiled driver failed (%s: %s); falling back "
+                           "to the host scheduler", reason, e)
+        if registry is not None:
+            registry.counter(
+                "dbsp_tpu_compiled_fallback_total",
+                "Circuits that failed to compile and fell back to the "
+                "host-driven path", labels=("reason",)).labels(
+                    reason=reason).inc()
         return None

@@ -72,6 +72,8 @@ CONSOLE_HTML = r"""<!doctype html>
     <label>output view</label><input id="ocoll" value="totals"/>
     <button onclick="readView()">Read</button>
     <button onclick="readStats()">Stats</button>
+    <button onclick="readMetrics()">Metrics</button>
+    <button onclick="readFleetMetrics()">Fleet metrics</button>
     <pre id="io">-</pre>
   </section>
 </main>
@@ -175,6 +177,15 @@ async function readView() {
 }
 async function readStats() {
   show(await j(`http://127.0.0.1:${val('ioport')}/stats`));
+}
+// registry-backed observability (dbsp_tpu.obs): per-pipeline Prometheus
+// text and the manager's fleet-wide aggregate
+async function readMetrics() {
+  show(await fetch(`http://127.0.0.1:${val('ioport')}/metrics`)
+      .then(r => r.text()));
+}
+async function readFleetMetrics() {
+  show(await fetch('/metrics').then(r => r.text()));
 }
 const val = id => document.getElementById(id).value;
 const post = b => ({ method: 'POST', body: JSON.stringify(b) });

@@ -17,13 +17,17 @@ from urllib.parse import parse_qs, urlparse
 
 from dbsp_tpu.io.controller import Controller
 from dbsp_tpu.io.format import INPUT_FORMATS, OUTPUT_FORMATS
+from dbsp_tpu.obs import export as obs_export
 
 
 class CircuitServer:
     def __init__(self, controller: Controller, host: str = "127.0.0.1",
-                 port: int = 0, profiler=None):
+                 port: int = 0, profiler=None, obs=None):
         self.controller = controller
         self.profiler = profiler
+        # obs: an obs.PipelineObs bundle — /metrics serves its registry
+        # (plus the legacy names) and /trace its Chrome-trace span window
+        self.obs = obs
         self._outputs: Dict[str, list] = {}
         server = self
 
@@ -63,7 +67,13 @@ class CircuitServer:
                     self._json(c.stats())
                 elif route == "/metrics":
                     self._reply(200, server.prometheus().encode(),
-                                "text/plain; version=0.0.4")
+                                obs_export.CONTENT_TYPE)
+                elif route == "/trace":
+                    if server.obs is None:
+                        self._json({"error": "tracing not enabled"}, 400)
+                    else:
+                        self._reply(200,
+                                    server.obs.spans.to_json().encode())
                 elif route == "/dump_profile":
                     if server.profiler is None:
                         self._json({"error": "profiler not enabled"}, 400)
@@ -149,23 +159,15 @@ class CircuitServer:
         self._thread: Optional[threading.Thread] = None
 
     def prometheus(self) -> str:
-        s = self.controller.stats()
-        lines = [
-            "# TYPE dbsp_steps counter",
-            f"dbsp_steps {s['steps']}",
-        ]
-        for name, ep in s["inputs"].items():
-            lines.append(
-                f'dbsp_input_records{{endpoint="{name}"}} '
-                f'{ep["total_records"]}')
-            lines.append(
-                f'dbsp_input_buffered{{endpoint="{name}"}} '
-                f'{ep["buffered_records"]}')
-        for name, out in s["outputs"].items():
-            lines.append(
-                f'dbsp_output_records{{endpoint="{name}"}} '
-                f'{out["total_records"]}')
-        return "\n".join(lines) + "\n"
+        """The /metrics payload: the obs registry's canonical exposition
+        (when a PipelineObs is attached) followed by the legacy
+        ``dbsp_steps``-era names — scrapers written against either surface
+        keep working. All formatting lives in obs/export.py."""
+        legacy = obs_export.legacy_controller_lines(self.controller.stats())
+        body = "\n".join(legacy) + "\n"
+        if self.obs is not None:
+            body = obs_export.prometheus_text(self.obs.registry) + body
+        return body
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,

@@ -89,15 +89,22 @@ class Pipeline:
         self.port: Optional[int] = None
         self.error: Optional[str] = None
         self.mode: Optional[str] = None  # compiled | host (set at deploy)
+        self.obs = None  # obs.PipelineObs (set at deploy)
 
     def compile_and_start(self) -> None:
         from dbsp_tpu.circuit import Runtime
         from dbsp_tpu.io import Catalog, CircuitServer, build_controller
+        from dbsp_tpu.obs import PipelineObs
         from dbsp_tpu.profile import CPUProfiler
 
         self.status = "compiling"
+        self.obs = PipelineObs(name=self.name)
+        # "workers" was already an accepted pipeline-config key
+        # (io/config.py known_sections) but never honored: deploy over an
+        # SPMD worker mesh when requested so managed pipelines shard
+        workers = int((self.config or {}).get("workers", 1))
         handle, (handles, outs) = Runtime.init_circuit(
-            1, _build_fn(self.program))
+            workers, _build_fn(self.program))
         catalog = Catalog()
         for tname, (h, dts) in handles.items():
             catalog.register_input(tname, h, tuple(dts))
@@ -113,7 +120,8 @@ class Pipeline:
         if os.environ.get("DBSP_TPU_MANAGER_COMPILED", "1") != "0":
             from dbsp_tpu.compiled.driver import try_compiled_driver
 
-            compiled = try_compiled_driver(handle)
+            compiled = try_compiled_driver(handle,
+                                           registry=self.obs.registry)
             if compiled is not None:
                 driver = compiled
                 self.mode = "compiled"
@@ -121,11 +129,15 @@ class Pipeline:
             from dbsp_tpu.profile import CompiledProfiler
 
             profiler = CompiledProfiler(driver)
+            self.obs.attach_compiled(driver)
         else:
             profiler = CPUProfiler(handle.circuit)
+            self.obs.attach_circuit(handle.circuit)
         self.controller = build_controller(driver, catalog,
                                            self.config or {})
-        self.server = CircuitServer(self.controller, profiler=profiler)
+        self.obs.attach_controller(self.controller)
+        self.server = CircuitServer(self.controller, profiler=profiler,
+                                    obs=self.obs)
         self.server.start()
         self.port = self.server.port
         self.controller.start()
@@ -238,6 +250,23 @@ class PipelineManager:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.rstrip("/") == "/metrics":
+                    # fleet-wide Prometheus exposition: every deployed
+                    # pipeline's registry under a pipeline="<name>" label
+                    # (one scrape target for the whole manager)
+                    from dbsp_tpu.obs import prometheus_text_many
+                    from dbsp_tpu.obs.export import CONTENT_TYPE
+
+                    with mgr.lock:
+                        regs = [({"pipeline": p.name}, p.obs.registry)
+                                for p in mgr.pipelines.values()
+                                if p.obs is not None]
+                    body = prometheus_text_many(regs).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

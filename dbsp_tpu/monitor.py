@@ -5,6 +5,12 @@ SchedulerEvents that panics on protocol violations (eval outside a step,
 unbalanced start/end, events for unknown nodes), used as a test oracle inside
 engine tests; plus ``visualize_circuit`` (:167) rendering the circuit graph
 to graphviz.
+
+Relationship to ``dbsp_tpu.obs``: the monitor is a *correctness oracle*
+over the event streams (it validates protocol, stores no timings), while
+``obs.CircuitInstrumentation`` is the production *measurement* consumer of
+the same streams (histograms, gauges, Chrome-trace spans). They attach via
+the same ``register_*_event_handler`` API and compose freely.
 """
 
 from __future__ import annotations

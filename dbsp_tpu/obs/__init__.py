@@ -1,0 +1,42 @@
+"""``dbsp_tpu.obs`` — the unified metrics & tracing subsystem.
+
+One coherent observability layer over the engine (reference:
+``profile/cpu.rs`` + ``circuit/metadata.rs`` + ``server/prometheus.rs`` +
+the pipeline-manager's per-pipeline stats, ``dbsp_handle.rs:256-268``):
+
+* :mod:`dbsp_tpu.obs.registry` — labeled counters / gauges / log-bucketed
+  histograms / quantile summaries in a :class:`MetricsRegistry`;
+* :mod:`dbsp_tpu.obs.export` — canonical Prometheus text exposition
+  (single-registry and fleet-wide multi-pipeline aggregation) — the ONLY
+  place in the tree that formats Prometheus text (tools/check_metrics.py
+  enforces this);
+* :mod:`dbsp_tpu.obs.tracing` — a bounded-window span recorder emitting
+  Chrome-trace-format JSON (load the export in Perfetto / chrome://tracing);
+* :mod:`dbsp_tpu.obs.instrument` — hooks subscribing to the circuit's
+  ``SchedulerEvent`` stream (host path) or polling a compiled driver
+  (compiled path), publishing per-operator eval histograms, step latency,
+  spine residency gauges, exchange counters, watermark lag.
+
+Metric names follow ``dbsp_tpu_<subsystem>_<name>_<unit>`` (see
+``registry.validate_metric_name``); the catalog lives in README.md
+§Observability.
+"""
+
+from dbsp_tpu.obs.export import (legacy_controller_lines, prometheus_text,
+                                 prometheus_text_many)
+from dbsp_tpu.obs.instrument import (CircuitInstrumentation,
+                                     CompiledInstrumentation,
+                                     ControllerInstrumentation, PipelineObs)
+from dbsp_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                   MetricNameError, MetricsRegistry, Summary,
+                                   validate_metric_name)
+from dbsp_tpu.obs.tracing import SpanRecorder
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "Summary",
+    "MetricNameError", "validate_metric_name",
+    "prometheus_text", "prometheus_text_many", "legacy_controller_lines",
+    "SpanRecorder",
+    "CircuitInstrumentation", "CompiledInstrumentation",
+    "ControllerInstrumentation", "PipelineObs",
+]

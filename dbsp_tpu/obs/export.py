@@ -1,0 +1,119 @@
+"""Prometheus text exposition (version 0.0.4) for metric registries.
+
+The ONLY Prometheus-format string building in the tree lives here
+(``tools/check_metrics.py`` lints the rest of ``dbsp_tpu/`` for strays):
+
+* :func:`prometheus_text` — one registry, optional constant labels;
+* :func:`prometheus_text_many` — the manager's fleet-wide aggregate: every
+  pipeline's registry under a ``pipeline="<name>"`` label, one ``# TYPE``
+  header per metric family across the fleet (reference:
+  ``server/prometheus.rs`` per pipeline; the aggregate endpoint is ours);
+* :func:`legacy_controller_lines` — the pre-registry metric names
+  (``dbsp_steps``, ``dbsp_input_records`` ...) derived from controller
+  stats, kept so existing scrapers/tests keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dbsp_tpu.obs.registry import (Histogram, Metric, MetricsRegistry,
+                                   Summary, fmt_value)
+
+CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _labelstr(names: Sequence[str], values: Sequence[str],
+              extra: Sequence[Tuple[str, str]] = ()) -> str:
+    pairs = [*zip(names, values), *extra]
+    if not pairs:
+        return ""
+    inner = ",".join(f'{n}="{_escape(str(v))}"' for n, v in pairs)
+    return "{" + inner + "}"
+
+
+def _render_metric(metric: Metric, extra: Sequence[Tuple[str, str]],
+                   lines: List[str], with_header: bool) -> None:
+    if with_header:
+        if metric.help:
+            lines.append(f"# HELP {metric.name} {metric.help}")
+        lines.append(f"# TYPE {metric.name} {metric.kind}")
+    for values, child in metric.samples():
+        if isinstance(metric, Summary):
+            for q in metric.quantiles:
+                ls = _labelstr(metric.label_names, values,
+                               (*extra, ("quantile", str(q))))
+                lines.append(f"{metric.name}{ls} "
+                             f"{fmt_value(metric.quantile_of(child, q))}")
+            base = _labelstr(metric.label_names, values, extra)
+            lines.append(f"{metric.name}_sum{base} {fmt_value(child.sum)}")
+            lines.append(f"{metric.name}_count{base} {child.count}")
+        elif isinstance(metric, Histogram):
+            cum = 0
+            for bound, n in zip(metric.bounds, child.buckets):
+                cum += n
+                ls = _labelstr(metric.label_names, values,
+                               (*extra, ("le", fmt_value(bound))))
+                lines.append(f"{metric.name}_bucket{ls} {cum}")
+            ls = _labelstr(metric.label_names, values,
+                           (*extra, ("le", "+Inf")))
+            lines.append(f"{metric.name}_bucket{ls} {child.count}")
+            base = _labelstr(metric.label_names, values, extra)
+            lines.append(f"{metric.name}_sum{base} {fmt_value(child.sum)}")
+            lines.append(f"{metric.name}_count{base} {child.count}")
+        else:
+            ls = _labelstr(metric.label_names, values, extra)
+            lines.append(f"{metric.name}{ls} {fmt_value(child.value)}")
+
+
+def prometheus_text(registry: MetricsRegistry,
+                    extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """Canonical exposition of one registry (collectors run first)."""
+    extra = tuple((extra_labels or {}).items())
+    lines: List[str] = []
+    for metric in registry.collect():
+        _render_metric(metric, extra, lines, with_header=True)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def prometheus_text_many(
+        registries: Iterable[Tuple[Dict[str, str], MetricsRegistry]]) -> str:
+    """Fleet-wide exposition: merge (constant_labels, registry) pairs so
+    each metric family renders ONE header followed by every instance's
+    samples — what the manager's aggregate ``/metrics`` serves."""
+    collected: List[Tuple[Tuple[Tuple[str, str], ...], List[Metric]]] = [
+        (tuple(labels.items()), reg.collect())
+        for labels, reg in registries]
+    families: Dict[str, List[Tuple[Tuple[Tuple[str, str], ...], Metric]]] = {}
+    for extra, metrics in collected:
+        for m in metrics:
+            families.setdefault(m.name, []).append((extra, m))
+    lines: List[str] = []
+    for name in sorted(families):
+        first = True
+        for extra, m in families[name]:
+            _render_metric(m, extra, lines, with_header=first)
+            first = False
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def legacy_controller_lines(stats: dict) -> List[str]:
+    """The original ad-hoc per-pipeline metric names, derived from
+    ``Controller.stats()`` — kept verbatim for scrapers written against the
+    pre-registry surface (``dbsp_steps`` & co)."""
+    lines = [
+        "# TYPE dbsp_steps counter",
+        f"dbsp_steps {stats['steps']}",
+    ]
+    for name, ep in stats["inputs"].items():
+        ls = _labelstr(("endpoint",), (name,))
+        lines.append(f"dbsp_input_records{ls} {ep['total_records']}")
+        lines.append(f"dbsp_input_buffered{ls} {ep['buffered_records']}")
+    for name, out in stats["outputs"].items():
+        ls = _labelstr(("endpoint",), (name,))
+        lines.append(f"dbsp_output_records{ls} {out['total_records']}")
+    return lines

@@ -1,0 +1,311 @@
+"""Instrumentation: engine state -> registry metrics + trace spans.
+
+Three attachment points, one per execution surface:
+
+* :class:`CircuitInstrumentation` — host-driven circuits. Subscribes to the
+  ``SchedulerEvent`` stream (the same stream ``CPUProfiler`` and
+  ``TraceMonitor`` consume) for per-operator eval-latency histograms and
+  step-latency summaries, and registers a scrape-time collector that walks
+  the circuit graph for spine residency gauges, exchange counters, and
+  watermark lag — state the operators already hold, read at scrape instead
+  of copied per tick.
+* :class:`CompiledInstrumentation` — compiled drivers. The whole tick is one
+  XLA program, so per-operator timings do not exist; exports tick counters,
+  tick-latency quantiles, overflow-replay counts, and per-trace
+  device-resident capacity from the compiled states.
+* :class:`ControllerInstrumentation` — the IO layer. Mirrors
+  ``Controller.stats()`` endpoint counters into the registry at scrape.
+
+:class:`PipelineObs` bundles one registry + one span recorder per deployed
+pipeline (the unit the manager aggregates over).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from dbsp_tpu.obs.registry import MetricsRegistry
+from dbsp_tpu.obs.tracing import SpanRecorder
+
+# span categories for the trace viewer; exchange ops get their own so
+# cross-worker data movement is visually separable from compute
+_EXCHANGE_OPS = ("shard", "unshard")
+
+
+def _gid_str(gid: Tuple[int, ...]) -> str:
+    return ".".join(map(str, gid))
+
+
+class CircuitInstrumentation:
+    """Host-path hooks: scheduler events -> histograms/spans, graph walk ->
+    gauges. Attach once per circuit, after build."""
+
+    def __init__(self, circuit, registry: MetricsRegistry,
+                 spans: Optional[SpanRecorder] = None):
+        self.circuit = circuit
+        self.registry = registry
+        self.spans = spans
+        self._open: Dict[Tuple[int, ...], int] = {}
+        self._step_t0: Optional[int] = None
+        self._depth = 0
+        self._names: Dict[Tuple[int, ...], str] = {}
+        self.eval_hist = registry.histogram(
+            "dbsp_tpu_circuit_operator_eval_seconds",
+            "Host wall-clock of one operator eval (includes kernel "
+            "dispatch; see profile.py for the async caveat)",
+            labels=("operator", "node"))
+        self.step_summary = registry.summary(
+            "dbsp_tpu_circuit_step_seconds",
+            "End-to-end latency of one root-circuit step")
+        self.steps_total = registry.counter(
+            "dbsp_tpu_circuit_steps_total", "Root-circuit steps evaluated")
+        registry.register_collector(self._collect_graph)
+        circuit.register_scheduler_event_handler(self._on_event)
+        # mark exchange operators so they accumulate rows/bytes moved —
+        # this costs one scalar device->host sync per exchange per tick
+        # (shard_op._MovedRowsMixin), so it is env-gated for latency-
+        # critical deploys: DBSP_TPU_OBS_EXCHANGE=0 keeps the counters off
+        if os.environ.get("DBSP_TPU_OBS_EXCHANGE", "1") != "0":
+            for node, _ in self._walk():
+                if node.operator.name in _EXCHANGE_OPS:
+                    node.operator.obs_enabled = True
+
+    # -- event path ---------------------------------------------------------
+    def _on_event(self, ev) -> None:
+        if ev.kind == "eval_start":
+            ts = ev.time_ns or time.perf_counter_ns()
+            self._open[ev.node_id] = ts
+            self._names[ev.node_id] = ev.name or "?"
+            if self.spans is not None and self._depth:
+                cat = "exchange" if ev.name in _EXCHANGE_OPS else "operator"
+                self.spans.begin(f"{ev.name}[{_gid_str(ev.node_id)}]",
+                                 cat=cat, ts_ns=ts)
+        elif ev.kind == "eval_end":
+            t0 = self._open.pop(ev.node_id, None)
+            ts = ev.time_ns or time.perf_counter_ns()
+            if t0 is not None:
+                self.eval_hist.labels(
+                    operator=ev.name or self._names.get(ev.node_id, "?"),
+                    node=_gid_str(ev.node_id)).observe((ts - t0) / 1e9)
+            if self.spans is not None and self._depth:
+                self.spans.end(f"{ev.name}[{_gid_str(ev.node_id)}]",
+                               ts_ns=ts)
+        elif ev.kind == "step_start":
+            ts = ev.time_ns or time.perf_counter_ns()
+            if self._depth == 0:
+                self._step_t0 = ts
+            self._depth += 1
+            if self.spans is not None:
+                self.spans.begin("step" if self._depth == 1 else "substep",
+                                 cat="step", ts_ns=ts)
+        elif ev.kind == "step_end":
+            ts = ev.time_ns or time.perf_counter_ns()
+            if self._depth > 0:
+                self._depth -= 1
+                if self.spans is not None:
+                    self.spans.end("step" if self._depth == 0 else "substep",
+                                   ts_ns=ts)
+                if self._depth == 0 and self._step_t0 is not None:
+                    self.step_summary.observe((ts - self._step_t0) / 1e9)
+                    self.steps_total.inc()
+                    self._step_t0 = None
+
+    # -- scrape-time graph walk ----------------------------------------------
+    def _walk(self, circuit=None, prefix=()):
+        c = circuit if circuit is not None else self.circuit
+        for node in c.nodes:
+            gid = (*prefix, node.index)
+            yield node, gid
+            if node.child is not None:
+                yield from self._walk(node.child, gid)
+
+    def _collect_graph(self) -> None:
+        from dbsp_tpu.operators.trace_op import TraceOp
+        from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
+
+        reg = self.registry
+        for node, gid in self._walk():
+            op = node.operator
+            nid = _gid_str(gid)
+            try:
+                if isinstance(op, TraceOp):
+                    sp = op.spine
+                    reg.gauge("dbsp_tpu_trace_device_resident_rows",
+                              "Device (HBM) resident row capacity of one "
+                              "spine (sharded batches count per-worker cap; "
+                              "see trace/spine.py budget semantics)",
+                              labels=("node",)).labels(node=nid).set(
+                                  sp.device_resident_rows())
+                    reg.gauge("dbsp_tpu_trace_host_offloaded_rows",
+                              "Row capacity offloaded to host memory "
+                              "(cold levels)",
+                              labels=("node",)).labels(node=nid).set(
+                                  sp.host_offloaded_rows())
+                    reg.gauge("dbsp_tpu_trace_level_count",
+                              "Spine LSM levels currently held",
+                              labels=("node",)).labels(node=nid).set(
+                                  len(sp.batches))
+                elif op.name in _EXCHANGE_OPS:
+                    reg.counter("dbsp_tpu_exchange_rows_total",
+                                "Live rows moved through shard/unshard "
+                                "exchanges", labels=("node",)).labels(
+                                    node=nid).set_total(
+                                        getattr(op, "rows_moved", 0))
+                    reg.counter("dbsp_tpu_exchange_bytes_total",
+                                "Bytes moved through shard/unshard "
+                                "exchanges", labels=("node",)).labels(
+                                    node=nid).set_total(
+                                        getattr(op, "bytes_moved", 0))
+                elif isinstance(op, WatermarkMonotonic):
+                    if op._wm is not None:
+                        reg.gauge("dbsp_tpu_timeseries_watermark_timestamp",
+                                  "Current watermark (event-time units)",
+                                  labels=("node",)).labels(node=nid).set(
+                                      op._wm)
+                        # lag = how far the latest batch's events trail
+                        # the event-time frontier (0 for in-order arrival,
+                        # grows when a batch is older than the max seen).
+                        # NOT frontier-minus-watermark: that is identically
+                        # the configured lateness here and carries no
+                        # signal. Both fields can be None (no batch yet /
+                        # restored checkpoint) — skip the gauge then.
+                        if op._max_ts is not None and \
+                                op._last_batch_max is not None:
+                            reg.gauge(
+                                "dbsp_tpu_timeseries_watermark_lag_count",
+                                "Event-time lag of the latest batch "
+                                "behind the frontier (max seen minus "
+                                "latest batch max, event-time units)",
+                                labels=("node",)).labels(node=nid).set(
+                                    op._max_ts - op._last_batch_max)
+            except Exception:
+                # scrape must not take the server down on a mid-step race;
+                # the next scrape sees a consistent value
+                continue
+
+
+class CompiledInstrumentation:
+    """Compiled-path hooks: collector over the driver + compiled states."""
+
+    def __init__(self, driver, registry: MetricsRegistry,
+                 spans: Optional[SpanRecorder] = None):
+        self.driver = driver
+        self.registry = registry
+        self._lat_seen = 0
+        # the pipeline server and the manager's fleet aggregate can scrape
+        # the same registry concurrently; the tail-consume below is a
+        # read-modify-write that would double-observe without this
+        self._lat_lock = threading.Lock()
+        self.tick_summary = registry.summary(
+            "dbsp_tpu_compiled_tick_seconds",
+            "Whole-tick latency of the compiled step program")
+        self.ticks_total = registry.counter(
+            "dbsp_tpu_compiled_ticks_total", "Compiled ticks run")
+        self.replays_total = registry.counter(
+            "dbsp_tpu_compiled_overflow_replays_total",
+            "Grow-and-replay cycles after a capacity overflow")
+        registry.register_collector(self._collect)
+        if spans is not None:
+            driver.spans = spans  # driver records tick/validate spans
+
+    def _collect(self) -> None:
+        from dbsp_tpu.compiled import cnodes
+
+        d = self.driver
+        self.ticks_total.set_total(getattr(d, "_tick", 0))
+        # step_latencies_ns is the driver's live append-only list; slice
+        # only the unseen tail (a full copy would be O(total ticks) per
+        # scrape, unbounded on a serving pipeline)
+        lat = getattr(d, "step_latencies_ns", ())
+        with self._lat_lock:
+            n = len(lat)
+            tail = lat[self._lat_seen:n]
+            self._lat_seen = n
+        for ns in tail:
+            self.tick_summary.observe(ns / 1e9)
+        ch = getattr(d, "ch", None)
+        if ch is None:
+            return
+        self.replays_total.set_total(getattr(ch, "overflow_replays", 0))
+        for cn in ch.cnodes:
+            if not isinstance(cn, cnodes._Leveled):
+                continue
+            nid = str(cn.node.index)
+            caps = sum(cn.caps[k] for k in cn.level_keys)
+            self.registry.gauge(
+                "dbsp_tpu_trace_device_resident_rows",
+                "Device-resident row capacity of one compiled leveled "
+                "trace (all compiled state is device-resident)",
+                labels=("node",)).labels(node=nid).set(caps)
+            self.registry.gauge(
+                "dbsp_tpu_trace_level_count",
+                "Levels of one compiled leveled trace",
+                labels=("node",)).labels(node=nid).set(len(cn.level_keys))
+
+
+class ControllerInstrumentation:
+    """IO-layer mirror: Controller.stats() -> registry, at scrape time."""
+
+    def __init__(self, controller, registry: MetricsRegistry):
+        self.controller = controller
+        self.registry = registry
+        registry.register_collector(self._collect)
+
+    def _collect(self) -> None:
+        reg = self.registry
+        s = self.controller.stats()
+        reg.counter("dbsp_tpu_io_steps_total",
+                    "Controller-driven circuit steps").set_total(s["steps"])
+        reg.counter("dbsp_tpu_io_pushed_records_total",
+                    "Rows pushed via the host API / HTTP endpoints"
+                    ).set_total(s["pushed_records"])
+        for name, ep in s["inputs"].items():
+            reg.counter("dbsp_tpu_io_input_records_total",
+                        "Rows ingested per input endpoint",
+                        labels=("endpoint",)).labels(
+                            endpoint=name).set_total(ep["total_records"])
+            reg.counter("dbsp_tpu_io_input_bytes_total",
+                        "Bytes ingested per input endpoint",
+                        labels=("endpoint",)).labels(
+                            endpoint=name).set_total(ep["total_bytes"])
+            reg.gauge("dbsp_tpu_io_input_buffered_rows",
+                      "Rows buffered awaiting a step",
+                      labels=("endpoint",)).labels(
+                          endpoint=name).set(ep["buffered_records"])
+        for name, out in s["outputs"].items():
+            reg.counter("dbsp_tpu_io_output_records_total",
+                        "Rows emitted per output endpoint",
+                        labels=("endpoint",)).labels(
+                            endpoint=name).set_total(out["total_records"])
+            reg.counter("dbsp_tpu_io_output_bytes_total",
+                        "Bytes emitted per output endpoint",
+                        labels=("endpoint",)).labels(
+                            endpoint=name).set_total(out["total_bytes"])
+
+
+class PipelineObs:
+    """Per-pipeline observability bundle: one registry + one span window.
+
+    Construction wires nothing; call the ``attach_*`` helpers for the
+    surfaces the pipeline actually runs (host circuit, compiled driver,
+    controller). The manager aggregates ``(labels, registry)`` pairs from
+    every deployed pipeline into the fleet-wide exposition."""
+
+    def __init__(self, name: str = "", max_trace_steps: int = 64):
+        self.name = name
+        self.registry = MetricsRegistry()
+        self.spans = SpanRecorder(max_steps=max_trace_steps)
+
+    def attach_circuit(self, circuit) -> CircuitInstrumentation:
+        return CircuitInstrumentation(circuit, self.registry,
+                                      spans=self.spans)
+
+    def attach_compiled(self, driver) -> CompiledInstrumentation:
+        return CompiledInstrumentation(driver, self.registry,
+                                       spans=self.spans)
+
+    def attach_controller(self, controller) -> ControllerInstrumentation:
+        return ControllerInstrumentation(controller, self.registry)

@@ -1,0 +1,106 @@
+"""Span recorder: Chrome-trace-format JSON for a bounded window of steps.
+
+Load the export in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+Spans nest step -> operator eval -> exchange on the host path (driven by
+:class:`~dbsp_tpu.obs.instrument.CircuitInstrumentation` from the
+scheduler-event stream) and tick -> compiled-step/validate/maintain on the
+compiled path (driven by the compiled driver directly).
+
+Format: the JSON-object flavor of the Trace Event Format — ``B``/``E``
+duration events with microsecond timestamps, so nesting is explicit and a
+consumer (or test) can check balance. The window is bounded: only the most
+recent ``max_steps`` completed top-level spans are retained (a serving
+pipeline runs forever; the trace buffer must not).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+
+class SpanRecorder:
+    """Accumulates B/E span events; ring-buffered per top-level span."""
+
+    def __init__(self, max_steps: int = 64, pid: str = "dbsp_tpu"):
+        self.pid = pid
+        self._steps: Deque[List[dict]] = deque(maxlen=max_steps)
+        self._open: List[dict] = []      # events of the in-flight step
+        self._depth = 0
+        self._lock = threading.Lock()
+        self.dropped_steps = 0
+
+    # -- recording ----------------------------------------------------------
+    def begin(self, name: str, cat: str = "operator",
+              ts_ns: Optional[int] = None) -> None:
+        ts = (ts_ns if ts_ns else time.perf_counter_ns()) / 1e3
+        with self._lock:
+            self._open.append({"name": name, "cat": cat, "ph": "B",
+                               "ts": ts, "pid": self.pid, "tid": 0})
+            self._depth += 1
+
+    def end(self, name: str, ts_ns: Optional[int] = None) -> None:
+        ts = (ts_ns if ts_ns else time.perf_counter_ns()) / 1e3
+        with self._lock:
+            if self._depth == 0:
+                return  # unbalanced end (attached mid-step): drop
+            self._open.append({"name": name, "ph": "E", "ts": ts,
+                               "pid": self.pid, "tid": 0})
+            self._depth -= 1
+            if self._depth == 0:
+                if len(self._steps) == self._steps.maxlen:
+                    self.dropped_steps += 1
+                self._steps.append(self._open)
+                self._open = []
+
+    def instant(self, name: str, cat: str = "event",
+                ts_ns: Optional[int] = None) -> None:
+        """A zero-duration marker (overflow replays, re-traces, ...)."""
+        ts = (ts_ns if ts_ns else time.perf_counter_ns()) / 1e3
+        with self._lock:
+            target = self._open if self._depth else None
+            ev = {"name": name, "cat": cat, "ph": "i", "ts": ts,
+                  "pid": self.pid, "tid": 0, "s": "t"}
+            if target is not None:
+                target.append(ev)
+            else:
+                self._steps.append([ev])
+
+    class _Span:
+        __slots__ = ("rec", "name", "cat")
+
+        def __init__(self, rec, name, cat):
+            self.rec, self.name, self.cat = rec, name, cat
+
+        def __enter__(self):
+            self.rec.begin(self.name, self.cat)
+            return self
+
+        def __exit__(self, *exc):
+            self.rec.end(self.name)
+            return False
+
+    def span(self, name: str, cat: str = "operator") -> "_Span":
+        """Context-manager convenience for host-driven span pairs."""
+        return SpanRecorder._Span(self, name, cat)
+
+    # -- export -------------------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return [ev for step in self._steps for ev in step]
+
+    def to_chrome_trace(self) -> dict:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms",
+                "otherData": {"dropped_steps": self.dropped_steps}}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_chrome_trace())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._steps.clear()
+            self._open = []
+            self._depth = 0

@@ -26,11 +26,42 @@ def _exchange_factory(nworkers: int):
     return lambda b: exchange_local(b, nworkers)
 
 
-class ExchangeOp(UnaryOperator):
+def _row_bytes(batch: Batch) -> int:
+    """Bytes per row across all columns + the weight column."""
+    return sum(c.dtype.itemsize for c in batch.cols) + \
+        batch.weights.dtype.itemsize
+
+
+class _MovedRowsMixin:
+    """Rows/bytes-moved accounting shared by shard and unshard.
+
+    Accumulates ONLY when instrumentation flips ``obs_enabled``
+    (obs/instrument.py) — the live-row count is one extra scalar
+    device->host sync per tick on this path."""
+
+    obs_enabled = False
+
+    def _init_obs(self) -> None:
+        self.rows_moved = 0
+        self.bytes_moved = 0
+
+    def _note_moved(self, out: Batch) -> None:
+        if self.obs_enabled:
+            n = int(out.live_count())
+            self.rows_moved += n
+            self.bytes_moved += n * _row_bytes(out)
+
+    def metadata(self):
+        return {"rows_moved": self.rows_moved,
+                "bytes_moved": self.bytes_moved}
+
+
+class ExchangeOp(_MovedRowsMixin, UnaryOperator):
     name = "shard"
 
     def __init__(self, nworkers: int):
         self.nworkers = nworkers
+        self._init_obs()
 
     def eval(self, batch: Batch) -> Batch:
         if not batch.sharded:
@@ -39,14 +70,18 @@ class ExchangeOp(UnaryOperator):
             from dbsp_tpu.circuit.runtime import Runtime
             from dbsp_tpu.parallel.exchange import shard_batch
 
-            return shard_batch(batch, Runtime.current().mesh).shrink_to_fit()
+            out = shard_batch(batch, Runtime.current().mesh).shrink_to_fit()
+            self._note_moved(out)
+            return out
         out = lifted(_exchange_factory, self.nworkers)(batch)
         # all_to_all output cap is nworkers * cap_local; re-bucket to the
         # worst worker's live rows (one scalar sync)
-        return out.shrink_to_fit()
+        out = out.shrink_to_fit()
+        self._note_moved(out)
+        return out
 
 
-class UnshardOp(UnaryOperator):
+class UnshardOp(_MovedRowsMixin, UnaryOperator):
     """Collapse a sharded stream to host-resident 1-D batches (all-gather +
     consolidate). Inserted by operators that are not yet shard-lifted
     (topk / rolling / window) so they run with single-worker semantics
@@ -55,12 +90,17 @@ class UnshardOp(UnaryOperator):
 
     name = "unshard"
 
+    def __init__(self):
+        self._init_obs()
+
     def eval(self, batch: Batch) -> Batch:
         if not batch.sharded:
             return batch
         from dbsp_tpu.parallel.exchange import unshard_batch
 
-        return unshard_batch(batch).shrink_to_fit()
+        out = unshard_batch(batch).shrink_to_fit()
+        self._note_moved(out)
+        return out
 
 
 @stream_method

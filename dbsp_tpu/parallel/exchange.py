@@ -121,7 +121,7 @@ def gather_local(batch: Batch) -> Batch:
 def spmd(mesh: Mesh, fn):
     """Lift a per-worker function over 1-D batches to [W, ...] sharded
     batches via shard_map (leading worker axis squeezed inside)."""
-    from jax import shard_map
+    from dbsp_tpu.parallel.mesh import shard_map
 
     def lifted(*args):
         def body(*local):

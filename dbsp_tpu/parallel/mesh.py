@@ -14,6 +14,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 WORKER_AXIS = "workers"
 
+# version compat: shard_map graduated from jax.experimental to the jax
+# top level; support both so multi-worker circuits run on either jax
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:  # pragma: no cover — depends on installed jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 
 def make_mesh(workers: int) -> Mesh:
     devices = jax.devices()

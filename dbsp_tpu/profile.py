@@ -11,6 +11,14 @@ Note the timings are host wall-clock around operator eval: they include
 kernel dispatch and any host<->device syncs, but XLA may still be executing
 asynchronously — per-step latency (CircuitHandle.step_times_ns) is the
 end-to-end truth; per-operator numbers locate where time is *submitted*.
+
+Relationship to ``dbsp_tpu.obs`` (the unified metrics/tracing subsystem):
+this profiler is the one-shot *report* surface (``/dump_profile`` — full
+per-operator totals and graphviz dumps for a human, on demand), while
+``obs.CircuitInstrumentation`` consumes the SAME scheduler-event stream to
+maintain continuously-scraped histograms/gauges (``/metrics``) and the
+Chrome-trace span window (``/trace``). Both can be attached to one circuit
+simultaneously; neither depends on the other.
 """
 
 from __future__ import annotations

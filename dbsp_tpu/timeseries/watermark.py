@@ -41,24 +41,36 @@ class WatermarkMonotonic(UnaryOperator):
         self.ts_fn = ts_fn
         self.lateness = lateness
         self._wm = None
+        self._max_ts = None        # running max event time (the frontier)
+        self._last_batch_max = None  # latest batch's max (lag gauge; not
+        #                              persisted — transient per process
 
     def clock_start(self, scope: int) -> None:
         self._wm = None
+        self._max_ts = None
+        self._last_batch_max = None
 
     def eval(self, batch: Batch) -> int:
         if int(batch.live_count()) > 0:
             m = int(_max_live(self.ts_fn(batch.keys, batch.vals),
                               batch.weights))
+            self._last_batch_max = m
+            self._max_ts = m if self._max_ts is None else max(self._max_ts, m)
             cand = m - self.lateness
             self._wm = cand if self._wm is None else max(self._wm, cand)
         return self._wm  # None until the first event arrives
 
+    def metadata(self):
+        return {"watermark": self._wm, "max_event_time": self._max_ts,
+                "last_batch_max": self._last_batch_max}
 
     def state_dict(self):
-        return {"wm": self._wm}
+        return {"wm": self._wm, "max_ts": self._max_ts}
 
     def load_state_dict(self, state):
         self._wm = state["wm"]
+        self._max_ts = state.get("max_ts")
+        self._last_batch_max = None
 
 
 @stream_method

@@ -73,20 +73,37 @@ class Spine:
 
     def device_resident_rows(self) -> int:
         """Capacity currently held in DEVICE memory (cold levels excluded)
-        — what the budget bounds; tests assert against it."""
+        — what the budget bounds; tests and the ``dbsp_tpu_trace_device_
+        resident_rows`` gauge read this. Sharded batches count their
+        per-worker capacity (each worker holds ``cap`` rows of HBM), the
+        same capacity :meth:`_enforce_budget` charges against the budget."""
         return sum(b.cap for b in self.batches if not _is_cold(b))
+
+    def host_offloaded_rows(self) -> int:
+        """Row capacity living in HOST memory (cold levels) — the
+        complement of :meth:`device_resident_rows`; exported as
+        ``dbsp_tpu_trace_host_offloaded_rows``."""
+        return sum(b.cap for b in self.batches if _is_cold(b))
 
     def _enforce_budget(self) -> None:
         """Offload the largest device levels to host until the device
         residency fits the budget. Largest-first: deep levels are the
         coldest (probed identically but re-merged the least), so one
-        offload buys the most headroom per transfer."""
+        offload buys the most headroom per transfer.
+
+        Budget semantics on multichip spines: SHARDED batches count toward
+        the resident total (they occupy HBM and the residency gauge counts
+        them) but are never offload candidates — a cold (numpy) operand
+        cannot participate in the SPMD collectives that probe sharded
+        levels. The budget is therefore enforced where it can be (unsharded
+        levels), and a spine whose sharded levels alone exceed the budget
+        stays over it — visibly, since metric and enforcement now agree."""
         if self.device_budget_rows is None:
             return
         hot = sorted((b for b in self.batches
                       if not _is_cold(b) and not b.sharded),
                      key=lambda b: b.cap, reverse=True)
-        resident = sum(b.cap for b in hot)
+        resident = sum(b.cap for b in self.batches if not _is_cold(b))
         # hard cap, largest level first (deep levels are re-merged the
         # least, so one offload buys the most headroom per transfer); a
         # budget below the delta size degrades to offload-every-insert —

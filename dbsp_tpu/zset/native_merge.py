@@ -56,6 +56,12 @@ def _build() -> str:
     global _build_error
     if _build_error is not None:
         raise RuntimeError(_build_error)
+    if not hasattr(jax, "ffi"):
+        # older jax exposes the FFI under jax.extend.ffi with a different
+        # registration ABI; gate the whole native route off rather than
+        # drive an untested bridge (kernels fall back to the XLA sort path)
+        _build_error = "jax.ffi unavailable in this jax version"
+        raise RuntimeError(_build_error)
     if not os.path.exists(_SO) or (
             os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
         include = jax.ffi.include_dir()

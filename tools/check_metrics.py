@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Metrics lint: keep Prometheus formatting in obs/ and names canonical.
+
+Two checks over ``dbsp_tpu/`` (wired into the test suite as a tier-1 test,
+tests/test_obs.py::test_metrics_lint):
+
+1. **No stray exposition formatting.** Prometheus text building (TYPE/HELP
+   headers, ``metric{label="..."}`` interpolation, the exposition
+   content-type literal) is only allowed inside ``dbsp_tpu/obs/`` — the
+   pre-obs tree had a hand-rolled exporter in io/server.py; this keeps a
+   second one from growing back.
+
+2. **Canonical metric names.** Every metric name registered via
+   ``registry.counter/gauge/histogram/summary("...")`` — and every string
+   literal that looks like a metric name — must follow
+   ``dbsp_tpu_<subsystem>_<name>_<unit>`` (registry.validate_metric_name):
+   counters end in ``_total``, the final segment is a known unit.
+
+Usage: ``python tools/check_metrics.py [root]`` — prints violations and
+exits 1 when any are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from dbsp_tpu.obs.registry import (MetricNameError,  # noqa: E402
+                                   validate_metric_name)
+
+# string-literal patterns that mean "this file formats Prometheus text"
+# (the label pattern uses a SINGLE brace: ast has already unescaped the
+# {{ of an f-string, so its Constant parts contain one literal brace)
+_FORMAT_PATTERNS = (
+    re.compile(r"#\s*(TYPE|HELP)\s+\w"),        # exposition headers
+    re.compile(r'\{\w+="'),                     # label rendering
+    re.compile(r"text/plain;\s*version=0\.0\.4"),  # exposition content-type
+)
+
+# a literal that IS a metric name (subject to the naming convention)
+_METRIC_LITERAL = re.compile(r"^dbsp_tpu_[a-z0-9_]+$")
+
+_REGISTER_METHODS = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram", "summary": "summary"}
+
+
+def _iter_py(root: str):
+    for dirpath, _, files in os.walk(root):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _is_obs(path: str, pkg_root: str) -> bool:
+    rel = os.path.relpath(path, pkg_root)
+    return rel.split(os.sep)[0] == "obs"
+
+
+def check_tree(pkg_root: str) -> list:
+    """Return a list of "path:line: message" violation strings."""
+    violations = []
+    for path in _iter_py(pkg_root):
+        with open(path) as f:
+            src = f.read()
+        rel = os.path.relpath(path, os.path.dirname(pkg_root))
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:  # pragma: no cover — tree is importable
+            violations.append(f"{rel}:{e.lineno}: unparsable: {e.msg}")
+            continue
+        in_obs = _is_obs(path, pkg_root)
+        for node in ast.walk(tree):
+            # (1) exposition formatting outside obs/
+            if not in_obs and isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                for pat in _FORMAT_PATTERNS:
+                    if pat.search(node.value):
+                        violations.append(
+                            f"{rel}:{node.lineno}: Prometheus exposition "
+                            f"formatting ({pat.pattern!r}) outside "
+                            "dbsp_tpu/obs/ — use obs.export")
+                        break
+            # (2a) registration calls: name + kind are both known
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _REGISTER_METHODS and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                name = node.args[0].value
+                if name.startswith("dbsp_tpu_"):
+                    try:
+                        validate_metric_name(
+                            name, _REGISTER_METHODS[node.func.attr])
+                    except MetricNameError as e:
+                        violations.append(f"{rel}:{node.lineno}: {e}")
+            # (2b) any metric-shaped literal: convention minus the kind rule
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _METRIC_LITERAL.match(node.value):
+                try:
+                    validate_metric_name(node.value)
+                except MetricNameError as e:
+                    violations.append(f"{rel}:{node.lineno}: {e}")
+    return violations
+
+
+def main(argv=None) -> int:
+    root = (argv or sys.argv[1:] or [os.path.join(_ROOT, "dbsp_tpu")])[0]
+    violations = check_tree(os.path.abspath(root))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_metrics: {len(violations)} violation(s)")
+        return 1
+    print("check_metrics: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
