@@ -1,0 +1,145 @@
+"""CLI: ``python -m dbsp_tpu.analysis <target>`` — analyze demo circuits.
+
+Targets:
+  q0 .. q22   one Nexmark query circuit (nexmark/queries.py)
+  all         every Nexmark query, one report per query
+  defects     a gallery of seeded-defect circuits, one per ERROR rule —
+              shows what each rule's finding looks like
+
+Exit status: 1 when any ERROR finding was produced (matching the
+pipeline-start behavior), else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import sys
+
+import jax
+
+
+def _nexmark_query_names():
+    from dbsp_tpu.nexmark import queries
+
+    names = []
+    for name in dir(queries):
+        fn = getattr(queries, name)
+        if name.startswith("q") and name[1:].isdigit() and callable(fn):
+            required = [p for p in inspect.signature(fn).parameters.values()
+                        if p.default is inspect.Parameter.empty]
+            if len(required) == 3:
+                names.append(name)
+    return sorted(names, key=lambda s: int(s[1:]))
+
+
+def _build_query(name: str):
+    from dbsp_tpu.circuit import RootCircuit
+    from dbsp_tpu.nexmark import build_inputs, queries
+
+    def build(c):
+        (p, a, b), handles = build_inputs(c)
+        return getattr(queries, name)(p, a, b).output()
+
+    circuit, _ = RootCircuit.build(build)
+    return circuit
+
+
+def _defect_circuits():
+    """(label, circuit) pairs, one seeded defect per ERROR rule."""
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit.builder import RootCircuit
+    from dbsp_tpu.operators import Z1, add_input_zset
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.operators.trace_op import TraceOp
+    from dbsp_tpu.zset.batch import Batch
+
+    gallery = []
+
+    # W001 — dangling feedback (built WITHOUT RootCircuit.build, which
+    # would refuse it at finalize)
+    c = RootCircuit()
+    s, _h = add_input_zset(c, [jnp.int64], [jnp.int64])
+    c.add_feedback(Z1(lambda: Batch.empty((jnp.int64,), (jnp.int64,))))
+    gallery.append(("W001 dangling feedback", c))
+
+    # W002 — hand-wired cycle with no strict operator
+    c = RootCircuit()
+    s, _h = add_input_zset(c, [jnp.int64], [jnp.int64])
+    a = s.plus(s)
+    b = a.plus(s)
+    a.node.inputs[1] = b.node_index  # close the loop around plus/plus
+    gallery.append(("W002 non-strict cycle", c))
+
+    # S001 — join over mismatched key dtypes (bypasses the sugar's check)
+    c = RootCircuit()
+    l, _h = add_input_zset(c, [jnp.int64], [jnp.int64])
+    r, _h = add_input_zset(c, [jnp.int32], [jnp.int64])
+    lt = c.add_unary_operator(TraceOp((jnp.int64,), (jnp.int64,)), l)
+    rt = c.add_unary_operator(TraceOp((jnp.int32,), (jnp.int64,)), r)
+    lt.schema, rt.schema = l.schema, r.schema
+    c.add_binary_operator(
+        JoinOp(lambda k, lv, rv: (k, (*lv, *rv)), 1,
+               ((jnp.int64,), (jnp.int64, jnp.int64))), lt, rt).output()
+    gallery.append(("S001 join key dtype mismatch", c))
+
+    # P001 — keyed aggregate with no shard (visible at workers > 1)
+    from dbsp_tpu.operators.aggregate_linear import (LinearAggregateOp,
+                                                     LinearCount)
+
+    c = RootCircuit()
+    s, _h = add_input_zset(c, [jnp.int64], [jnp.int64])
+    # pretend the source does not hash-distribute (and never would)
+    s.key_sharded = s.shard_intent = False
+    c.add_unary_operator(LinearAggregateOp(LinearCount(), (jnp.int64,)),
+                         s).output()
+    gallery.append(("P001 missing shard (analyzed at workers=4)", c))
+
+    # W004 — child circuit whose parent-index bookkeeping was hand-edited
+    from dbsp_tpu.circuit.nested import subcircuit
+
+    c = RootCircuit()
+    subcircuit(c, lambda child: None)
+    c.nodes[0].child._index_in_parent = 7  # re-parented by hand
+    gallery.append(("W004 nested-clock inconsistency", c))
+
+    return gallery
+
+
+def main(argv=None) -> int:
+    jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m dbsp_tpu.analysis",
+        description="static-analyze demo circuits")
+    ap.add_argument("target", help="q0..q22 | all | defects")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="worker count to analyze for (default 1)")
+    args = ap.parse_args(argv)
+
+    from dbsp_tpu.analysis import ERROR, analyze, format_findings
+
+    if args.target == "defects":
+        targets = [(label, c, 4 if label.startswith("P001") else
+                    args.workers) for label, c in _defect_circuits()]
+    elif args.target == "all":
+        targets = [(n, _build_query(n), args.workers)
+                   for n in _nexmark_query_names()]
+    elif args.target in _nexmark_query_names():
+        targets = [(args.target, _build_query(args.target), args.workers)]
+    else:
+        ap.error(f"unknown target {args.target!r}; expected one of "
+                 f"{_nexmark_query_names()} or 'all' / 'defects'")
+
+    any_error = False
+    for label, circuit, workers in targets:
+        findings = analyze(circuit, workers=workers)
+        any_error |= any(f.severity == ERROR for f in findings)
+        print(f"== {label} ==")
+        print(format_findings(findings))
+        print()
+    return 1 if any_error else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,192 @@
+"""Analyzer core: findings, the rule catalog, and the pass manager.
+
+The analyzer runs over a BUILT circuit graph — between ``RootCircuit.build``
+and the first step — so its subject is exactly what the scheduler/compiler
+will execute: :class:`~dbsp_tpu.circuit.builder.Node` objects, their input
+edges, and the node-level ``schema`` / ``key_sharded`` metadata the operator
+sugar writes through :class:`~dbsp_tpu.circuit.builder.Stream` properties.
+Passes are pure functions ``(AnalysisContext) -> [Finding]``; the
+:class:`PassManager` fixes their order (well-formedness first — later passes
+assume a sane graph) and aggregates findings.
+
+Severity contract (enforced by the entry points in ``__init__``):
+  ERROR — the circuit computes wrong answers or cannot run (refuse to start);
+  WARN  — it runs correctly but violates the DBSP cost model (O(delta) work
+          degrading to O(state)) or risks silent overflow (log + count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from dbsp_tpu.circuit.builder import Circuit, CircuitError, Node
+
+ERROR = "error"
+WARN = "warn"
+
+_SEV_ORDER = {ERROR: 0, WARN: 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One catalog entry; the README's rule table renders from these."""
+
+    rule_id: str
+    severity: str
+    title: str
+    catches: str
+    fix_hint: str
+
+
+#: rule_id -> Rule; populated by the pass modules at import time
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, severity: str, title: str, catches: str,
+                  fix_hint: str) -> Rule:
+    if rule_id in RULES:
+        raise ValueError(f"duplicate analysis rule id {rule_id!r}")
+    rule = Rule(rule_id, severity, title, catches, fix_hint)
+    RULES[rule_id] = rule
+    return rule
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: which rule fired, where, and how to fix it."""
+
+    rule_id: str
+    severity: str
+    node_path: str
+    message: str
+    fix_hint: str
+
+    def render(self) -> str:
+        return (f"[{self.severity.upper()}] {self.rule_id} @ "
+                f"{self.node_path}: {self.message}\n"
+                f"    fix: {self.fix_hint}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def make_finding(rule_id: str, circuit: Circuit, node: Optional[Node],
+                 message: str, fix_hint: Optional[str] = None) -> Finding:
+    rule = RULES[rule_id]
+    return Finding(rule_id=rule_id, severity=rule.severity,
+                   node_path=node_path(circuit, node), message=message,
+                   fix_hint=fix_hint if fix_hint is not None
+                   else rule.fix_hint)
+
+
+def node_path(circuit: Circuit, node: Optional[Node]) -> str:
+    """Stable, human-readable node address: ``root/2/5:join`` — the global
+    id joined with '/', suffixed with the operator name."""
+    if node is None:
+        gid: Tuple[int, ...] = circuit.path()
+        name = "circuit"
+    else:
+        gid = circuit.global_id(node.index)
+        name = node.operator.name
+    return "root/" + "/".join(str(i) for i in gid) + ":" + name
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings,
+                  key=lambda f: (_SEV_ORDER.get(f.severity, 9),
+                                 f.rule_id, f.node_path))
+
+
+class AnalysisContext:
+    """What every pass sees: the circuit forest plus derived graph views.
+
+    ``schemas`` starts from the node metadata the builder persisted and is
+    COMPLETED by the schema-inference pass (passes run in PassManager order,
+    so sharding/incrementality passes read inferred entries too). Keys are
+    ``(id(circuit), node_index)`` — node indices are only unique per
+    circuit.
+    """
+
+    def __init__(self, circuit: Circuit, workers: int = 1):
+        self.root = circuit
+        self.workers = workers
+        self.schemas: Dict[Tuple[int, int], Optional[tuple]] = {}
+        self._consumers: Dict[int, List[List[int]]] = {}
+        for c, n in self.walk():
+            self.schemas[(id(c), n.index)] = n.schema
+
+    # -- traversal -----------------------------------------------------------
+    def circuits(self) -> Iterator[Circuit]:
+        stack = [self.root]
+        while stack:
+            c = stack.pop()
+            yield c
+            for n in c.nodes:
+                if n.child is not None:
+                    stack.append(n.child)
+
+    def walk(self) -> Iterator[Tuple[Circuit, Node]]:
+        for c in self.circuits():
+            for n in c.nodes:
+                yield c, n
+
+    def consumers(self, circuit: Circuit) -> List[List[int]]:
+        """consumers[i] = node indices (same circuit) reading node i."""
+        adj = self._consumers.get(id(circuit))
+        if adj is None:
+            adj = [[] for _ in circuit.nodes]
+            for n in circuit.nodes:
+                for i in n.inputs:
+                    if 0 <= i < len(adj):
+                        adj[i].append(n.index)
+            self._consumers[id(circuit)] = adj
+        return adj
+
+    # -- schema helpers ------------------------------------------------------
+    def schema_of(self, circuit: Circuit, index: int) -> Optional[tuple]:
+        return self.schemas.get((id(circuit), index))
+
+    def set_schema(self, circuit: Circuit, index: int, schema) -> None:
+        self.schemas[(id(circuit), index)] = schema
+
+
+AnalysisPass = Callable[[AnalysisContext], List[Finding]]
+
+
+class AnalysisError(CircuitError):
+    """Raised by verify entry points when ERROR findings exist; carries the
+    full finding list so callers (manager HTTP surface, CLI) can render it."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = findings
+        errors = [f for f in findings if f.severity == ERROR]
+        lines = "\n".join(f.render() for f in errors)
+        super().__init__(
+            f"circuit failed static analysis with {len(errors)} error(s):\n"
+            f"{lines}")
+
+
+class PassManager:
+    """Runs registered passes in order over one context; order matters
+    (schema inference feeds sharding/incrementality)."""
+
+    def __init__(self, passes: Optional[List[AnalysisPass]] = None):
+        self.passes: List[AnalysisPass] = list(passes or [])
+
+    def add(self, p: AnalysisPass) -> "PassManager":
+        self.passes.append(p)
+        return self
+
+    def run(self, circuit: Circuit, workers: int = 1) -> List[Finding]:
+        ctx = AnalysisContext(circuit, workers=workers)
+        # graph-level waivers (Stream.waive_lint): filtered centrally so
+        # every rule honors them without each pass re-checking
+        waived = {node_path(c, n): n.lint_waive
+                  for c, n in ctx.walk() if n.lint_waive}
+        findings: List[Finding] = []
+        for p in self.passes:
+            findings.extend(
+                f for f in p(ctx)
+                if f.rule_id not in waived.get(f.node_path, ()))
+        return sort_findings(findings)

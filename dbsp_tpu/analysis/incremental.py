@@ -1,0 +1,79 @@
+"""Incrementality lint: patterns that turn O(delta) ticks into O(state).
+
+DBSP's headline guarantee — per-tick cost proportional to the input change
+— is a property of HOW a query is built, not just what it computes. Two
+build patterns quietly forfeit it:
+
+* a linear aggregate (count/sum/avg) routed through the general
+  trace-gather path re-reads every touched group's full history per tick,
+  where the linear path needs only a delta-sized segment sum;
+* ``integrate()`` on the root clock accumulates a Z-set forever — without
+  a downstream window (or any retention bound) per-tick consolidation cost
+  grows with lifetime state, and at tick 1e6 the "incremental" pipeline is
+  doing batch work. (Nested-circuit integrates reset each epoch and are
+  exempt.)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dbsp_tpu.analysis.core import (AnalysisContext, Finding, make_finding,
+                                    register_rule)
+
+register_rule(
+    "I001", "warn", "linear-aggregate-on-general-path",
+    "aggregate(Count/Sum/Average) built on the general trace-gather path: "
+    "per-tick work is O(touched group history) where the linear path is "
+    "O(delta), and the input stream grows a trace it does not need.",
+    "pass the linear aggregator (LinearCount/LinearSum/LinearAverage) so "
+    "aggregate() dispatches to the delta-only fast path")
+register_rule(
+    "I002", "warn", "unbounded-integrate",
+    "integrate() on the root clock with no downstream window: the running "
+    "sum retains every key ever seen, so per-tick consolidation cost "
+    "grows with lifetime state instead of the delta.",
+    "bound the stream with .window(bounds, gc=True) (timeseries/window.py) "
+    "or consume deltas directly instead of materializing the integral")
+
+
+def incremental_pass(ctx: AnalysisContext) -> List[Finding]:
+    from dbsp_tpu.operators.aggregate import (Average, Count, Sum,
+                                              AggregateOp)
+    from dbsp_tpu.operators.z1 import _PlusNamed
+    from dbsp_tpu.timeseries.window import WindowOp
+
+    out: List[Finding] = []
+    for circuit, n in ctx.walk():
+        op = n.operator
+        # I001 — linear aggregators on the general gather path
+        if isinstance(op, AggregateOp) and \
+                isinstance(op.agg, (Count, Sum, Average)):
+            out.append(make_finding(
+                "I001", circuit, n,
+                f"aggregate<{op.agg.name}> uses the general trace-gather "
+                "path but is linear"))
+        # I002 — root-clock integrate with no window anywhere downstream.
+        # Serving layers that materialize a VIEW integral (state = live
+        # view cardinality, not input history) opt out via waive_lint,
+        # honored centrally by PassManager.run.
+        if circuit is ctx.root and isinstance(op, _PlusNamed) and \
+                op.name == "integrate":
+            consumers = ctx.consumers(circuit)
+            seen = {n.index}
+            stack = [n.index]
+            windowed = False
+            while stack and not windowed:
+                for c in consumers[stack.pop()]:
+                    if isinstance(circuit.nodes[c].operator, WindowOp):
+                        windowed = True
+                        break
+                    if c not in seen:
+                        seen.add(c)
+                        stack.append(c)
+            if not windowed:
+                out.append(make_finding(
+                    "I002", circuit, n,
+                    "integrate() accumulates unbounded state (no window "
+                    "downstream)"))
+    return out

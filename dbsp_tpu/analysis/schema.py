@@ -1,0 +1,146 @@
+"""Schema pass: propagate (key, val) dtypes along edges, then type-check.
+
+Most streams already carry schema metadata (the operator sugar writes it
+through to nodes — circuit/builder.py); this pass fills the gaps (operators
+whose output schema is derivable: traces, joins, aggregates,
+schema-preserving arithmetic) and then checks the dtype rules that the
+runtime would otherwise "repair" with silent casts.
+
+Why S001 is an ERROR and not a nicety: join kernels probe ``keys[:nk]``
+lexicographically and the shard operator hash-partitions on the first key
+column's BITS. A silently cast key column hashes differently on each side,
+so matching keys land on different workers and the join quietly drops
+matches — the worst kind of wrong answer (only at scale, only sharded).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from dbsp_tpu.analysis.core import (AnalysisContext, Finding, make_finding,
+                                    register_rule)
+
+register_rule(
+    "S001", "error", "join-key-dtype-mismatch",
+    "join/semijoin whose two input key column dtypes differ: the silent "
+    "cast changes the hash shard and the lexicographic probe order, so "
+    "matches are dropped (wrong answers, not an exception).",
+    "cast one side's key columns (map_rows / index_by) so both join inputs "
+    "share identical key dtypes")
+register_rule(
+    "S002", "warn", "narrow-accumulator",
+    "an aggregator accumulating into an integer dtype narrower than 64 "
+    "bits; long-running sums/counts overflow int32 after ~2.1e9 "
+    "contributions and wrap silently on TPU.",
+    "declare int64 acc/out dtypes on the aggregator (built-ins already do)")
+
+
+def _dt(x) -> Optional[np.dtype]:
+    try:
+        return np.dtype(x)
+    except TypeError:  # not a dtype-like (opaque schema entry)
+        return None
+
+
+def _key_dtypes(schema) -> Optional[tuple]:
+    if not schema or not isinstance(schema, tuple) or len(schema) != 2:
+        return None
+    dts = tuple(_dt(d) for d in schema[0])
+    return None if any(d is None for d in dts) else dts
+
+
+def _infer(ctx: AnalysisContext) -> None:
+    """Complete ctx.schemas from operator attributes + propagation."""
+    from dbsp_tpu.operators.aggregate import AggregateOp
+    from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+    from dbsp_tpu.operators.basic import Minus, Neg, Plus, SumN
+    from dbsp_tpu.operators.distinct import DistinctOp, StreamDistinct
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+    from dbsp_tpu.operators.trace_op import TraceOp
+    from dbsp_tpu.operators.z1 import Z1, _PlusNamed
+
+    preserving = (Plus, Minus, Neg, SumN, _PlusNamed, ExchangeOp, UnshardOp,
+                  StreamDistinct, DistinctOp)
+    for circuit, n in ctx.walk():
+        if ctx.schema_of(circuit, n.index) is not None:
+            continue
+        op = n.operator
+        if isinstance(op, TraceOp):
+            ctx.set_schema(circuit, n.index,
+                           (tuple(op.key_dtypes), tuple(op.val_dtypes)))
+        elif isinstance(op, (JoinOp, AggregateOp, LinearAggregateOp)):
+            ctx.set_schema(circuit, n.index, op.out_schema)
+    # propagate through schema-preserving ops to a fixpoint (feedback
+    # edges mean one forward sweep is not always enough); monotone —
+    # schemas only move None -> known — so this terminates within
+    # node-count sweeps
+    while True:
+        changed = False
+        for circuit, n in ctx.walk():
+            if ctx.schema_of(circuit, n.index) is not None:
+                continue
+            op = n.operator
+            src: Optional[int] = None
+            if isinstance(op, preserving) and n.inputs:
+                src = n.inputs[0]
+            elif isinstance(op, Z1) and n.kind == "strict_output" and \
+                    n.partner is not None:
+                inp = circuit.nodes[n.partner].inputs
+                src = inp[0] if inp else None
+            if src is not None:
+                s = ctx.schema_of(circuit, src)
+                if s is not None:
+                    ctx.set_schema(circuit, n.index, s)
+                    changed = True
+        if not changed:
+            break
+
+
+def schema_pass(ctx: AnalysisContext) -> List[Finding]:
+    from dbsp_tpu.operators.aggregate import AggregateOp
+    from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.operators.nested_ops import NestedJoinOp
+
+    _infer(ctx)
+    out: List[Finding] = []
+    for circuit, n in ctx.walk():
+        op = n.operator
+        # S001 — join inputs must agree on the probed key columns
+        if isinstance(op, (JoinOp, NestedJoinOp)) and len(n.inputs) == 2:
+            ls = _key_dtypes(ctx.schema_of(circuit, n.inputs[0]))
+            rs = _key_dtypes(ctx.schema_of(circuit, n.inputs[1]))
+            if ls is None or rs is None:
+                continue  # unknown side: nothing provable
+            nk = int(getattr(op, "nk", 0)) or min(len(ls), len(rs))
+            if len(ls) < nk or len(rs) < nk or ls[:nk] != rs[:nk]:
+                out.append(make_finding(
+                    "S001", circuit, n,
+                    f"{op.name!r} joins key dtypes "
+                    f"{tuple(str(d) for d in ls)} against "
+                    f"{tuple(str(d) for d in rs)} (first {nk} must match "
+                    "exactly)"))
+        # S002 — narrow integer accumulators
+        agg = None
+        if isinstance(op, AggregateOp):
+            agg = op.agg
+        elif isinstance(op, LinearAggregateOp):
+            agg = op.agg
+        # order statistics (insert_combinable: Min/Max) select an existing
+        # value rather than accumulate — a narrow out dtype there matches
+        # the data and cannot overflow
+        if agg is not None and not getattr(agg, "insert_combinable", False):
+            acc = (*getattr(agg, "acc_dtypes", ()),
+                   *getattr(agg, "out_dtypes", ()))
+            narrow = sorted({str(d) for d in (_dt(x) for x in acc)
+                             if d is not None and d.kind in "iu"
+                             and d.itemsize < 8})
+            if narrow:
+                out.append(make_finding(
+                    "S002", circuit, n,
+                    f"aggregator {agg.name!r} accumulates into narrow "
+                    f"integer dtype(s) {narrow}"))
+    return out

@@ -1,0 +1,101 @@
+"""Sharding-placement pass: keyed state must be hash-partitioned by key.
+
+Under a multi-worker mesh every stateful keyed operator (trace→join/
+aggregate/distinct, linear aggregate) owns a per-worker slice of state;
+correctness requires its input stream to be hash-partitioned by the SAME
+key (the reference re-shards stateful inputs for exactly this reason,
+shard.rs:35-101). The builder sugar inserts exchanges automatically, but
+hand-assembled graphs — and refactors that re-key a stream without
+re-sharding — break the invariant silently: each worker then probes a
+state slice that holds only a fraction of the matching rows.
+
+Placement facts used here are build-time graph metadata, not runtime data:
+``Node.key_sharded`` (set by ``shard()``/sources), the intent flags
+``Node.shard_intent`` / ``Node.host_intent`` (the sugar recorded a
+placement decision whose exchange/collapse was elided on a 1-worker mesh
+— the same build at workers > 1 would have placed the stream, so what-if
+analysis must not flag it), and "host-resident by construction" (the
+output of an ``UnshardOp``). Only the root circuit is
+checked — nested/recursive children are host-driven and unsharded by
+construction (recursive() collapses its inputs first).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dbsp_tpu.analysis.core import (AnalysisContext, Finding, make_finding,
+                                    register_rule)
+
+register_rule(
+    "P001", "error", "missing-shard",
+    "a stateful keyed operator (trace feeding join/aggregate/distinct, or "
+    "a linear aggregate) whose input is neither key-sharded nor explicitly "
+    "host-resident under a multi-worker runtime: each worker sees a "
+    "fraction of every key's rows (wrong answers at worker count > 1).",
+    "call .shard() on the input stream (the operator sugar does this — "
+    "hand-built graphs must insert the ExchangeOp themselves)")
+register_rule(
+    "P002", "warn", "redundant-exchange",
+    "an exchange over a stream that is already hash-partitioned on the "
+    "same key: every row pays an all_to_all that cannot move it.",
+    "drop the extra .shard(); the circuit cache shares one exchange per "
+    "stream when built through the sugar")
+
+
+def _placed(circuit, idx: int) -> bool:
+    """True when node idx's output has a provable placement: key-sharded,
+    placement-by-sugar-intent (elided exchange/collapse on a 1-worker
+    build — either kind is a deliberate decision), or host-resident by
+    construction (unshard output)."""
+    from dbsp_tpu.operators.shard_op import UnshardOp
+
+    node = circuit.nodes[idx]
+    return (node.key_sharded or node.shard_intent or node.host_intent
+            or isinstance(node.operator, UnshardOp))
+
+
+def sharding_pass(ctx: AnalysisContext) -> List[Finding]:
+    from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.operators.shard_op import ExchangeOp
+    from dbsp_tpu.operators.trace_op import TraceOp
+
+    out: List[Finding] = []
+    circuit = ctx.root
+    nn = len(circuit.nodes)
+    for n in circuit.nodes:
+        op = n.operator
+        # stale input indices are a W004 finding (wellformed pass); this
+        # pass must not crash on them
+        if any(not 0 <= i < nn for i in n.inputs):
+            continue
+        # P002 is a graph-shape smell at any worker count
+        if isinstance(op, ExchangeOp) and n.inputs and \
+                circuit.nodes[n.inputs[0]].key_sharded:
+            out.append(make_finding(
+                "P002", circuit, n,
+                "exchange input is already key-sharded"))
+        if ctx.workers <= 1:
+            continue
+        if isinstance(op, (TraceOp, LinearAggregateOp)):
+            if n.inputs and not _placed(circuit, n.inputs[0]):
+                src = circuit.nodes[n.inputs[0]]
+                out.append(make_finding(
+                    "P001", circuit, n,
+                    f"{op.name!r} consumes {src.operator.name!r} which is "
+                    f"not key-sharded ({ctx.workers} workers)"))
+        if isinstance(op, JoinOp) and len(n.inputs) == 2:
+            a, b = (circuit.nodes[i] for i in n.inputs)
+            # effective placement: really sharded, or WOULD be on a larger
+            # mesh (host_intent means would-be-HOST, not co-sharded)
+            ap = a.key_sharded or a.shard_intent
+            bp = b.key_sharded or b.shard_intent
+            if ap != bp:
+                out.append(make_finding(
+                    "P001", circuit, n,
+                    f"join inputs disagree on placement: "
+                    f"{a.operator.name!r} key_sharded={ap}, "
+                    f"{b.operator.name!r} key_sharded={bp} — "
+                    "not co-sharded"))
+    return out

@@ -1,0 +1,155 @@
+"""Well-formedness pass: graph-shape invariants the scheduler assumes.
+
+DBSP's semantics (VLDB'23 §3) are defined over circuits where every cycle
+passes through a strict (z^-1) operator — that is what makes the per-tick
+evaluation a DAG. The builder makes these hard to violate but not
+impossible (dangling ``FeedbackConnector``, hand-wired graphs, a child
+circuit grafted under the wrong parent), and a violation surfaces as wrong
+answers, not an exception.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dbsp_tpu.analysis.core import (AnalysisContext, Finding, make_finding,
+                                    register_rule)
+
+register_rule(
+    "W001", "error", "dangling-feedback",
+    "add_feedback() whose FeedbackConnector.connect() was never called: the "
+    "strict output half schedules as a source and emits the z^-1 zero "
+    "forever on the open edge (silently wrong answers).",
+    "call connector.connect(<input stream>) to close the feedback loop, or "
+    "remove the add_feedback call")
+register_rule(
+    "W002", "error", "non-strict-cycle",
+    "a dependency cycle that does not pass through a strict (z^-1) "
+    "operator; per-tick evaluation is only defined on a DAG.",
+    "break the cycle with .delay() / add_feedback(Z1) so the loop crosses "
+    "a strict operator")
+register_rule(
+    "W003", "warn", "unreachable-node",
+    "a node whose output reaches no sink, output handle, feedback input, "
+    "export, or condition — dead weight that still evaluates every tick.",
+    "consume the stream (.output()/.inspect()/export) or drop the operator")
+register_rule(
+    "W004", "error", "graph-link-inconsistency",
+    "a node input index out of range, or a subcircuit whose parent/index "
+    "links or import/export/condition node references are inconsistent — "
+    "the executor would read the wrong (or no) streams.",
+    "build graphs via the Stream sugar and children via "
+    "parent.subcircuit()/recursive(); do not hand-edit node links")
+
+
+def wellformed_pass(ctx: AnalysisContext) -> List[Finding]:
+    from dbsp_tpu.operators.io_handles import ZSetInput
+    from dbsp_tpu.operators.upsert import UpsertInput
+
+    out: List[Finding] = []
+    for circuit in ctx.circuits():
+        nodes = circuit.nodes
+        # W001 — dangling feedback connectors
+        for n in nodes:
+            if n.kind == "strict_output" and n.partner is None:
+                out.append(make_finding(
+                    "W001", circuit, n,
+                    f"FeedbackConnector for {n.operator.name!r} was never "
+                    "connected"))
+        # W004 — nested clock consistency (pure link checks: valid on any
+        # graph shape, so they run before the cycle bail-out below)
+        for n in nodes:
+            child = n.child
+            if child is None:
+                continue
+            if child.parent is not circuit:
+                out.append(make_finding(
+                    "W004", circuit, n,
+                    "child circuit's parent link does not point back at "
+                    "the owning circuit"))
+            if child._index_in_parent != n.index:
+                out.append(make_finding(
+                    "W004", circuit, n,
+                    f"child circuit records parent index "
+                    f"{child._index_in_parent}, but lives at node "
+                    f"{n.index}"))
+            nchild = len(child.nodes)
+            for attr in ("exports", "conditions"):
+                for i in getattr(child, attr, ()) or ():
+                    if not (0 <= i < nchild):
+                        out.append(make_finding(
+                            "W004", circuit, n,
+                            f"child {attr} references node {i}, out of "
+                            f"range for {nchild} child nodes"))
+            for pidx, _op in getattr(child, "imports", ()) or ():
+                if not (0 <= pidx < len(nodes)):
+                    out.append(make_finding(
+                        "W004", circuit, n,
+                        f"child import references parent node {pidx}, out "
+                        f"of range for {len(nodes)} parent nodes"))
+        # W004 — stale input indices; toposort/reachability math below is
+        # meaningless over them (a dangling edge would read as a cycle)
+        bad_inputs = False
+        for n in nodes:
+            for i in n.inputs:
+                if not (0 <= i < len(nodes)):
+                    bad_inputs = True
+                    out.append(make_finding(
+                        "W004", circuit, n,
+                        f"{n.operator.name!r} input references node {i}, "
+                        f"out of range for {len(nodes)} nodes"))
+        if bad_inputs:
+            continue
+        # W002 — toposort leftovers are exactly the non-strict cycles
+        # (strict operators are split into two nodes, so legal feedback is
+        # already acyclic here)
+        indeg = [0] * len(nodes)
+        for n in nodes:
+            for i in n.inputs:
+                indeg[n.index] += 1
+        ready = [n.index for n in nodes if indeg[n.index] == 0]
+        seen = 0
+        consumers = ctx.consumers(circuit)
+        while ready:
+            idx = ready.pop()
+            seen += 1
+            for c in consumers[idx]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+        if seen != len(nodes):
+            stuck = [n for n in nodes if indeg[n.index] > 0]
+            names = ", ".join(
+                f"{n.index}:{n.operator.name}" for n in stuck)
+            out.append(make_finding(
+                "W002", circuit, stuck[0] if stuck else None,
+                f"cycle through non-strict nodes [{names}]"))
+            continue  # reachability below assumes a DAG
+        # W003 — reverse reachability from effect nodes
+        effect = set()
+        for n in nodes:
+            if n.kind in ("sink", "strict_input", "subcircuit"):
+                effect.add(n.index)
+        for attr in ("exports", "conditions"):
+            effect.update(getattr(circuit, attr, ()) or ())
+        live = set(effect)
+        stack = list(effect)
+        while stack:
+            idx = stack.pop()
+            for i in nodes[idx].inputs:
+                if i not in live:
+                    live.add(i)
+                    stack.append(i)
+        for n in nodes:
+            if n.index not in live:
+                # a declared-but-unconsumed input table is routine (one
+                # table schema shared by pipelines that each read a
+                # subset) and costs nothing per tick — flagging it on
+                # every deploy would bury real unreachable operators
+                if isinstance(n.operator, (ZSetInput, UpsertInput)):
+                    continue
+                out.append(make_finding(
+                    "W003", circuit, n,
+                    f"{n.operator.name!r} output reaches no sink/output/"
+                    "feedback/export"))
+    return out

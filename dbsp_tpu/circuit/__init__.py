@@ -1,13 +1,14 @@
 from dbsp_tpu.circuit.builder import (
-    Circuit, CircuitEvent, FeedbackConnector, RootCircuit, SchedulerEvent,
-    Stream)
+    Circuit, CircuitError, CircuitEvent, FeedbackConnector, RootCircuit,
+    SchedulerEvent, Stream)
 from dbsp_tpu.circuit.operator import (
     BinaryOperator, ImportOperator, NaryOperator, Operator, SinkOperator,
     SourceOperator, StrictOperator, UnaryOperator)
 from dbsp_tpu.circuit.runtime import CircuitHandle, Runtime
 
 __all__ = [
-    "Circuit", "CircuitEvent", "FeedbackConnector", "RootCircuit",
+    "Circuit", "CircuitError", "CircuitEvent", "FeedbackConnector",
+    "RootCircuit",
     "SchedulerEvent", "Stream", "Operator", "SourceOperator", "SinkOperator",
     "UnaryOperator", "BinaryOperator", "NaryOperator", "StrictOperator",
     "ImportOperator", "CircuitHandle", "Runtime",

@@ -26,6 +26,12 @@ from dbsp_tpu.circuit.operator import (
     BinaryOperator, ImportOperator, NaryOperator, Operator, SinkOperator,
     SourceOperator, StrictOperator, UnaryOperator)
 
+class CircuitError(RuntimeError):
+    """A malformed circuit construction or use (typed — unlike ``assert``,
+    these survive ``python -O``; tools/check_hotpath.py enforces that
+    user-input validation in circuit/ and io/ never relies on assert)."""
+
+
 # ---------------------------------------------------------------------------
 # Construction / scheduler events (reference: circuit/trace.rs:44,496)
 # ---------------------------------------------------------------------------
@@ -55,6 +61,11 @@ class Stream:
     Operator sugar (``map``/``join``/``aggregate``/...) is attached by the
     ``dbsp_tpu.operators`` package, mirroring how the reference implements
     operators as extension methods on ``Stream``.
+
+    ``schema`` / ``key_sharded`` metadata lives on the underlying
+    :class:`Node` (a Stream is a light wrapper; several wrappers may point
+    at one node, and the static analyzer reads the graph, not the
+    wrappers), so setting it through any wrapper is visible to all.
     """
 
     def __init__(self, circuit: "Circuit", node_index: int):
@@ -64,6 +75,68 @@ class Stream:
     @property
     def node(self) -> "Node":
         return self.circuit.nodes[self.node_index]
+
+    def _touch_metadata(self) -> None:
+        """Node metadata feeds the static analyzer; a memoized verification
+        of the old metadata must not gate the mutated graph."""
+        self.circuit.root()._verify_cache = None
+
+    # (key_dtypes, val_dtypes) of the Z-set batches on this edge, or None
+    # for non-batch payloads / unknown
+    @property
+    def schema(self):
+        return self.node.schema
+
+    @schema.setter
+    def schema(self, value) -> None:
+        self.node.schema = value
+        self._touch_metadata()
+
+    # True when rows are provably hash-partitioned over the worker mesh by
+    # the current first key column (set by shard()/sources; reset by
+    # re-keying operators simply by being absent on their output node)
+    @property
+    def key_sharded(self) -> bool:
+        return self.node.key_sharded
+
+    @key_sharded.setter
+    def key_sharded(self, value: bool) -> None:
+        self.node.key_sharded = bool(value)
+        self._touch_metadata()
+
+    # Placement decisions the builder sugar made here when its exchange/
+    # collapse was elided on a 1-worker mesh (shard()/unshard()/sources
+    # no-op at workers == 1). The same build on a larger mesh would have
+    # placed the stream accordingly, so what-if analysis at workers > 1
+    # must treat it as placed. Two independent flags — one node may feed
+    # both a sharded and a host consumer, each of which would get its own
+    # exchange/collapse node on a larger mesh.
+    @property
+    def shard_intent(self) -> bool:
+        return self.node.shard_intent
+
+    @shard_intent.setter
+    def shard_intent(self, value: bool) -> None:
+        self.node.shard_intent = bool(value)
+        self._touch_metadata()
+
+    @property
+    def host_intent(self) -> bool:
+        return self.node.host_intent
+
+    @host_intent.setter
+    def host_intent(self, value: bool) -> None:
+        self.node.host_intent = bool(value)
+        self._touch_metadata()
+
+    def waive_lint(self, *rule_ids: str) -> "Stream":
+        """Mark this stream's node as an intentional exception to the given
+        static-analysis rules (dbsp_tpu/analysis) — the graph-level analog
+        of the AST lint's ``# hotpath: ok`` waiver. Returns self so it
+        chains inside builder expressions."""
+        self.node.lint_waive = (*self.node.lint_waive, *rule_ids)
+        self._touch_metadata()
+        return self
 
     def get(self) -> Any:
         """Value produced this tick (valid during a step)."""
@@ -96,6 +169,15 @@ class Node:
     partner: Optional[int] = None
     # subcircuit payload
     child: Optional["Circuit"] = None
+    # stream metadata (see Stream.schema / Stream.key_sharded /
+    # Stream.shard_intent)
+    schema: Optional[Tuple] = None
+    key_sharded: bool = False
+    shard_intent: bool = False  # sugar would hash-shard this on a larger mesh
+    host_intent: bool = False  # sugar would host-collapse this on a larger mesh
+    # static-analysis rule ids this node is an intentional exception to
+    # (see Stream.waive_lint) — the graph-level '# hotpath: ok'
+    lint_waive: Tuple[str, ...] = ()
 
 
 class FeedbackConnector:
@@ -109,7 +191,16 @@ class FeedbackConnector:
         self.stream = Stream(circuit, output_node)
 
     def connect(self, input_stream: Stream) -> None:
-        assert input_stream.circuit is self.circuit, "feedback across circuits"
+        if input_stream.circuit is not self.circuit:
+            raise CircuitError(
+                f"feedback across circuits: {input_stream} belongs to "
+                f"circuit {input_stream.circuit.path()}, the connector to "
+                f"{self.circuit.path()}")
+        if self.circuit.nodes[self.output_node].partner is not None:
+            raise CircuitError(
+                f"feedback connector for node "
+                f"{self.circuit.global_id(self.output_node)} is already "
+                "connected")
         node = self.circuit._add_node(self.op, "strict_input",
                                       [input_stream.node_index])
         node.partner = self.output_node
@@ -168,6 +259,9 @@ class Circuit:
                     inputs=list(inputs), child=child)
         self.nodes.append(node)
         self._executor = None  # invalidate schedule
+        # graph changed: a memoized verification (analysis/verify_circuit)
+        # of the old graph must not gate the new one
+        self.root()._verify_cache = None
         self._emit_circuit_event(CircuitEvent(
             kind="operator", node_id=self.global_id(node.index), name=op.name))
         for i in inputs:
@@ -205,9 +299,28 @@ class Circuit:
         return FeedbackConnector(self, node.index, op)
 
     def _check_stream(self, s: Stream) -> None:
-        assert s.circuit is self, (
-            f"stream {s} belongs to a different circuit; use delta0/import "
-            "to move values across clock domains")
+        if s.circuit is not self:
+            raise CircuitError(
+                f"stream {s} belongs to a different circuit; use "
+                "delta0/import to move values across clock domains")
+
+    def check_wellformed(self) -> None:
+        """Build-finalize validation: raise :class:`CircuitError` on
+        structurally broken circuits (recursing into children).
+
+        The cheap, always-on subset of the static analyzer
+        (dbsp_tpu/analysis/): a dangling ``FeedbackConnector`` (``connect``
+        never called) would otherwise SCHEDULE — its strict-output half is
+        a source — and yield the z^-1 zero forever on the open edge,
+        surfacing as silently wrong answers instead of an error."""
+        for n in self.nodes:
+            if n.kind == "strict_output" and n.partner is None:
+                raise CircuitError(
+                    f"dangling FeedbackConnector at node "
+                    f"{self.global_id(n.index)} ({n.operator.name}): "
+                    "add_feedback was never connect()ed to an input stream")
+            if n.child is not None:
+                n.child.check_wellformed()
 
     # -- stepping -----------------------------------------------------------
     def step(self) -> None:
@@ -256,5 +369,6 @@ class RootCircuit(Circuit):
               ) -> Tuple["RootCircuit", Any]:
         circuit = RootCircuit()
         result = constructor(circuit)
+        circuit.check_wellformed()
         circuit.clock_start(0)
         return circuit, result

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Tuple
 
-from dbsp_tpu.circuit.builder import Circuit, CircuitEvent, Stream
+from dbsp_tpu.circuit.builder import (Circuit, CircuitError, CircuitEvent,
+                                      Stream)
 from dbsp_tpu.circuit.operator import ImportOperator, Operator
 from dbsp_tpu.zset.batch import Batch
 
@@ -88,12 +89,14 @@ class ChildCircuit(Circuit):
                       hold: bool = False) -> Stream:
         """delta0 import of a parent stream into this clock domain
         (``hold=True``: re-emit the value every child tick)."""
-        assert parent_stream.circuit is self.parent, \
-            "import_stream takes a stream of the immediate parent"
+        if parent_stream.circuit is not self.parent:
+            raise CircuitError(
+                "import_stream takes a stream of the immediate parent")
         if zero_factory is None:
             schema = getattr(parent_stream, "schema", None)
-            assert schema is not None, \
-                "import_stream needs schema metadata or zero_factory"
+            if schema is None:
+                raise CircuitError(
+                    "import_stream needs schema metadata or zero_factory")
             zero_factory = lambda: Batch.empty(*schema)  # noqa: E731
         op = Delta0(zero_factory, hold=hold)
         node = self._add_node(op, "import", [])
@@ -107,16 +110,23 @@ class ChildCircuit(Circuit):
 
         The exported value is the stream's value on the FINAL child tick
         (reference: ``subcircuit``'s export streams)."""
-        assert child_stream.circuit is self
+        if child_stream.circuit is not self:
+            raise CircuitError("export takes a stream of this child circuit")
         self.exports.append(child_stream.node_index)
+        # exports feed the analyzer's reachability/link checks: a memoized
+        # verification of the old graph must not gate the new one
+        self.root()._verify_cache = None
         return len(self.exports) - 1
 
     def add_condition(self, child_stream: Stream) -> None:
         """Register a termination condition: a stream of Z-set batches; the
         iteration stops when ALL condition batches are empty on the same tick
         (reference: ``operator/condition.rs``)."""
-        assert child_stream.circuit is self
+        if child_stream.circuit is not self:
+            raise CircuitError(
+                "add_condition takes a stream of this child circuit")
         self.conditions.append(child_stream.node_index)
+        self.root()._verify_cache = None  # see export()
 
 
 def subcircuit(parent: Circuit, constructor: Callable[[ChildCircuit], Any],
@@ -137,6 +147,7 @@ def subcircuit(parent: Circuit, constructor: Callable[[ChildCircuit], Any],
     child._index_in_parent = node.index
     result = constructor(child)
     node.inputs = [pidx for (pidx, _) in child.imports]
+    parent.root()._verify_cache = None  # inputs changed after _add_node
     for pidx in node.inputs:
         parent._emit_circuit_event(CircuitEvent(
             kind="edge", from_id=parent.global_id(pidx),

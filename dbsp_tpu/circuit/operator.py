@@ -50,7 +50,10 @@ class Operator:
         return {}
 
     def load_state_dict(self, state: dict) -> None:
-        assert not state, f"{self.name} got unexpected checkpoint state"
+        if state:
+            raise ValueError(
+                f"{self.name} got unexpected checkpoint state "
+                f"(keys: {sorted(state)})")
 
 
 class SourceOperator(Operator):

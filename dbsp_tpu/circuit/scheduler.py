@@ -18,20 +18,29 @@ from __future__ import annotations
 import time
 from typing import TYPE_CHECKING, List
 
-from dbsp_tpu.circuit.builder import Circuit, Node, SchedulerEvent
+from dbsp_tpu.circuit.builder import Circuit, CircuitError, Node, \
+    SchedulerEvent
 
 if TYPE_CHECKING:
     pass
 
 
-class CircuitGraphError(RuntimeError):
+class CircuitGraphError(CircuitError):
     pass
 
 
 def static_schedule(circuit: Circuit) -> List[Node]:
     """Topological order; strict-output halves act as sources, so feedback
     cycles are already broken (reference: schedule/static_scheduler.rs:17-88).
+
+    Refuses dangling feedback before ordering (via
+    ``Circuit.check_wellformed`` — one shared scan with build-finalize): a
+    never-connected FeedbackConnector's output half schedules fine on its
+    own (it is a source) and silently emits the z^-1 zero forever — the
+    schedule is the last line of defense for circuits not built via
+    ``RootCircuit.build``.
     """
+    circuit.check_wellformed()
     nodes = circuit.nodes
     indeg = [0] * len(nodes)
     consumers: List[List[int]] = [[] for _ in nodes]

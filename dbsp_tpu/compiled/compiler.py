@@ -733,8 +733,8 @@ class CompiledHandle:
         return self.last_outputs.get(self._op_to_index[id(op)])
 
 
-def compile_circuit(handle, gen_fn: Optional[Callable] = None
-                    ) -> CompiledHandle:
+def compile_circuit(handle, gen_fn: Optional[Callable] = None,
+                    verified: bool = False) -> CompiledHandle:
     """Compile a host :class:`~dbsp_tpu.circuit.runtime.CircuitHandle`'s
     circuit. Existing operator state (spines warmed by host-path steps)
     migrates into the compiled states — warm up host-side, then compile.
@@ -743,9 +743,18 @@ def compile_circuit(handle, gen_fn: Optional[Callable] = None
     compile to a single SPMD program over the runtime's mesh; in that case a
     ``gen_fn`` runs per-worker inside the program and may use
     ``jax.lax.axis_index("workers")`` to generate its slice."""
+    from dbsp_tpu.analysis import verify_circuit
     from dbsp_tpu.circuit.runtime import Runtime
 
     rt = getattr(handle, "runtime", None)
+    # static analysis before tracing: an ERROR circuit (dangling feedback,
+    # mismatched join keys, missing shard) would compile fine and produce
+    # wrong answers; refusing here costs one graph walk. ``verified=True``
+    # skips it for callers (the manager) that already ran verify_circuit —
+    # avoids double-logging every WARN at deploy.
+    if not verified:
+        verify_circuit(handle.circuit,
+                       workers=rt.workers if rt is not None else 1)
     prev = Runtime._swap(rt)
     try:
         return CompiledHandle(handle.circuit, gen_fn=gen_fn, runtime=rt)

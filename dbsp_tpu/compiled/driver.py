@@ -96,7 +96,7 @@ class CompiledCircuitDriver:
             spans.end(f"tick[{self._tick - 1}]")
 
 
-def try_compiled_driver(handle, registry=None):
+def try_compiled_driver(handle, registry=None, verified=False):
     """Compile the circuit if every operator has a compiled equivalent;
     None when it must stay on the host-driven path (the caller records
     which mode the pipeline runs — facade.rs's feature gate).
@@ -109,8 +109,18 @@ def try_compiled_driver(handle, registry=None):
     scheduler that previously ran the circuit, not kill the deploy. The
     failure is logged and, when ``registry`` (obs.MetricsRegistry) is
     given, counted as ``dbsp_tpu_compiled_fallback_total{reason=...}``."""
+    from dbsp_tpu.analysis import AnalysisError
+
     try:
+        if verified:
+            return CompiledCircuitDriver(
+                handle, compiled=compile_circuit(handle, verified=True))
         return CompiledCircuitDriver(handle)
+    except AnalysisError:
+        # a circuit that FAILS STATIC ANALYSIS is broken on every path —
+        # falling back would run it on the host scheduler and produce the
+        # wrong answers the analyzer exists to prevent
+        raise
     except Exception as e:  # noqa: BLE001 — deliberate: fallback, not crash
         reason = type(e).__name__
         if isinstance(e, NotImplementedError):

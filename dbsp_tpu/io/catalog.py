@@ -40,12 +40,14 @@ class Catalog:
 
     def register_input(self, name: str, handle: InputHandle,
                        dtypes: Sequence) -> None:
-        assert name not in self.inputs, f"duplicate input {name}"
+        if name in self.inputs:
+            raise ValueError(f"duplicate input {name}")
         self.inputs[name] = InputCollection(name, handle, tuple(dtypes))
 
     def register_output(self, name: str, handle: OutputHandle,
                         dtypes: Sequence) -> None:
-        assert name not in self.outputs, f"duplicate output {name}"
+        if name in self.outputs:
+            raise ValueError(f"duplicate output {name}")
         self.outputs[name] = OutputCollection(name, handle, tuple(dtypes))
 
     def input(self, name: str) -> InputCollection:

@@ -94,7 +94,9 @@ class _Conn:
     """One line-JSON request/response TCP connection."""
 
     def __init__(self, address: str):
-        assert address.startswith("mini://"), address
+        if not address.startswith("mini://"):
+            raise ValueError(
+                f"minikafka address must start with 'mini://': {address!r}")
         host, port = address[len("mini://"):].rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)), timeout=10)
         self.rfile = self.sock.makefile("rb")

@@ -22,12 +22,29 @@ from dbsp_tpu.obs import export as obs_export
 
 class CircuitServer:
     def __init__(self, controller: Controller, host: str = "127.0.0.1",
-                 port: int = 0, profiler=None, obs=None):
+                 port: int = 0, profiler=None, obs=None, findings=None):
         self.controller = controller
         self.profiler = profiler
         # obs: an obs.PipelineObs bundle — /metrics serves its registry
         # (plus the legacy names) and /trace its Chrome-trace span window
         self.obs = obs
+        # Static-analysis gate (dbsp_tpu/analysis): ERROR findings refuse
+        # to serve; WARNs are logged/counted and exposed at /analysis.
+        # Callers that already verified (the manager) pass their findings
+        # so the analyzer runs — and counts metrics — exactly once.
+        if findings is None:
+            circuit = getattr(controller.handle, "circuit", None)
+            if circuit is not None:
+                from dbsp_tpu.analysis import verify_circuit
+
+                hh = getattr(controller.handle, "host_handle",
+                             controller.handle)
+                runtime = getattr(hh, "runtime", None)
+                findings = verify_circuit(
+                    circuit,
+                    workers=getattr(runtime, "workers", 1),
+                    registry=obs.registry if obs is not None else None)
+        self.analysis_findings = findings or []
         self._outputs: Dict[str, list] = {}
         server = self
 
@@ -68,6 +85,9 @@ class CircuitServer:
                 elif route == "/metrics":
                     self._reply(200, server.prometheus().encode(),
                                 obs_export.CONTENT_TYPE)
+                elif route == "/analysis":
+                    self._json([f.to_dict()
+                                for f in server.analysis_findings])
                 elif route == "/trace":
                     if server.obs is None:
                         self._json({"error": "tracing not enabled"}, 400)

@@ -65,7 +65,12 @@ def _build_fn(program: dict):
             handles[tname] = (h, dts)
         outs = {}
         for vname, sql in views.items():
-            outs[vname] = ctx.query(sql).integrate().output()
+            # the integral IS the served view: its state is the view's live
+            # cardinality (retractions consolidate), not input history, and
+            # SQL clients have no window knob — waive the unbounded-
+            # integrate lint rather than warn on every deploy
+            outs[vname] = (ctx.query(sql).integrate()
+                           .waive_lint("I002").output())
         return handles, outs
 
     return build
@@ -105,6 +110,14 @@ class Pipeline:
         workers = int((self.config or {}).get("workers", 1))
         handle, (handles, outs) = Runtime.init_circuit(
             workers, _build_fn(self.program))
+        # static-analysis gate (dbsp_tpu/analysis): ERROR findings abort
+        # the deploy (AnalysisError surfaces as the pipeline's error);
+        # WARNs are logged and counted on this pipeline's registry as
+        # dbsp_tpu_analysis_findings_total{rule,severity}
+        from dbsp_tpu.analysis import verify_circuit
+
+        findings = verify_circuit(handle.circuit, workers=workers,
+                                  registry=self.obs.registry)
         catalog = Catalog()
         for tname, (h, dts) in handles.items():
             catalog.register_input(tname, h, tuple(dts))
@@ -121,7 +134,8 @@ class Pipeline:
             from dbsp_tpu.compiled.driver import try_compiled_driver
 
             compiled = try_compiled_driver(handle,
-                                           registry=self.obs.registry)
+                                           registry=self.obs.registry,
+                                           verified=True)
             if compiled is not None:
                 driver = compiled
                 self.mode = "compiled"
@@ -137,7 +151,7 @@ class Pipeline:
                                            self.config or {})
         self.obs.attach_controller(self.controller)
         self.server = CircuitServer(self.controller, profiler=profiler,
-                                    obs=self.obs)
+                                    obs=self.obs, findings=findings)
         self.server.start()
         self.port = self.server.port
         self.controller.start()

@@ -483,9 +483,9 @@ def aggregate(self: Stream, agg, name=None) -> Stream:
     general trace-gather path (aggregate/mod.rs:204,600)."""
     from dbsp_tpu.operators.aggregate_linear import (LinearAggregateOp,
                                                      LinearAggregator)
+    from dbsp_tpu.operators.registry import require_schema
 
-    schema = getattr(self, "schema", None)
-    assert schema is not None, "aggregate needs stream schema metadata"
+    schema = require_schema(self, "aggregate")
     if getattr(self.circuit, "nested_incremental", False):
         # inside a recursive() child: aggregate over the (epoch, iteration)
         # product lattice (reference: aggregate/mod.rs:204,410 is generic
@@ -518,8 +518,9 @@ def stream_aggregate(self: Stream, agg: Aggregator, name=None) -> Stream:
     """Non-incremental variant: aggregates each tick's batch alone
     (aggregate/mod.rs:172) — the differential-testing oracle for
     :func:`aggregate` via ``integrate().stream_aggregate()``."""
-    schema = getattr(self, "schema", None)
-    assert schema is not None
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(self, "stream_aggregate")
     nk = len(schema[0])
     op_name = name or f"stream_aggregate<{agg.name}>"
 

@@ -105,8 +105,9 @@ def distinct(self: Stream) -> Stream:
     schema = getattr(self, "schema", None)
     if getattr(self.circuit, "nested_incremental", False):
         from dbsp_tpu.operators.nested_ops import NestedDistinctOp
+        from dbsp_tpu.operators.registry import require_schema
 
-        assert schema is not None, "distinct needs stream schema metadata"
+        schema = require_schema(self, "distinct (nested)")
         out = self.circuit.add_unary_operator(
             NestedDistinctOp(schema, self.circuit), self)
         out.schema = schema

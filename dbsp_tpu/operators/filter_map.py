@@ -22,7 +22,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.builder import CircuitError, Stream
 from dbsp_tpu.circuit.operator import UnaryOperator
 from dbsp_tpu.operators.registry import stream_method
 from dbsp_tpu.parallel.lift import lifted_op
@@ -202,8 +202,10 @@ def index_by(self: Stream, key_fn: Callable[[Cols, Cols], Cols],
     if val_fn is None:
         val_fn = lambda k, v: (*k, *v)  # noqa: E731
         schema = getattr(self, "schema", None)
-        assert schema is not None or val_dtypes is not None, (
-            "index_by needs val_dtypes when the input stream has no schema")
+        if schema is None and val_dtypes is None:
+            raise CircuitError(
+                "index_by needs val_dtypes when the input stream has no "
+                "schema")
         if val_dtypes is None:
             val_dtypes = (*schema[0], *schema[1])
     fn = lambda k, v: (key_fn(k, v), val_fn(k, v))  # noqa: E731

@@ -170,6 +170,7 @@ def add_input_zset(circuit: Circuit, key_dtypes: Sequence,
     s = circuit.add_source(op)
     s.schema = (op.key_dtypes, op.val_dtypes)
     s.key_sharded = Runtime.worker_count() > 1  # sources hash-distribute
+    s.shard_intent = True  # ... and would on any larger mesh too
     return s, InputHandle(op)
 
 

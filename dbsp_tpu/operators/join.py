@@ -135,6 +135,7 @@ class JoinOp(BinaryOperator):
 
     def __init__(self, fn: JoinFn, nk: int, out_schema, name="join"):
         self.name = name
+        self.nk = nk  # probed key-column count (read by analysis/schema S001)
         self.out_schema = out_schema
         # Left delta joins the right trace INCLUDING this tick's right delta;
         # right delta joins the left trace EXCLUDING this tick's (delayed).
@@ -164,9 +165,17 @@ def join_index(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
     to output key/value columns (join.rs:200 ``join_index`` semantics; plain
     ``join`` == identity keys).
     """
-    ls, rs = getattr(self, "schema", None), getattr(other, "schema", None)
-    assert ls is not None and rs is not None, "join needs schemas on both sides"
-    assert ls[0] == rs[0], f"join key dtypes differ: {ls[0]} vs {rs[0]}"
+    from dbsp_tpu.circuit.builder import CircuitError
+    from dbsp_tpu.operators.registry import require_schema
+
+    ls = require_schema(self, "join (left input)")
+    rs = require_schema(other, "join (right input)")
+    if ls[0] != rs[0]:
+        # build-time twin of analysis rule S001 (a silent key cast changes
+        # the hash shard and probe order — wrong answers, not an exception)
+        raise CircuitError(
+            f"join key dtypes differ: {ls[0]} vs {rs[0]} — cast one side "
+            "(map_rows/index_by) so both inputs share identical key dtypes")
     out_schema = (tuple(out_key_dtypes), tuple(out_val_dtypes))
     if getattr(self.circuit, "nested_incremental", False):
         # inside a recursive() child: joins are incremental over the

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Callable
 
-from dbsp_tpu.circuit.builder import Circuit, Stream
+from dbsp_tpu.circuit.builder import Circuit, CircuitError, Stream
 from dbsp_tpu.circuit.nested import ChildCircuit, subcircuit
 from dbsp_tpu.operators.registry import stream_method
 from dbsp_tpu.operators.z1 import Z1
@@ -36,11 +36,9 @@ def recursive_streams(parent: Circuit, inputs, f):
     ONE child circuit, so rules may join across relations (mutual
     recursion, e.g. galen's p/q). Returns one delta stream per relation.
     """
-    schemas = []
-    for s in inputs:
-        schema = getattr(s, "schema", None)
-        assert schema is not None, "recursive needs schema metadata"
-        schemas.append(schema)
+    from dbsp_tpu.operators.registry import require_schema
+
+    schemas = [require_schema(s, "recursive_streams") for s in inputs]
     inputs = [s.unshard() for s in inputs]  # nested ops are not shard-lifted
 
     def ctor(child: ChildCircuit):
@@ -53,12 +51,14 @@ def recursive_streams(parent: Circuit, inputs, f):
             fb.stream.schema = schema
             fbs.append(fb)
         steps = f(child, [fb.stream for fb in fbs])
-        assert len(steps) == len(inputs), (
-            f"f must return {len(inputs)} streams, got {len(steps)}")
+        if len(steps) != len(inputs):
+            raise CircuitError(
+                f"f must return {len(inputs)} streams, got {len(steps)}")
         for step, i0, fb, schema in zip(steps, i0s, fbs, schemas):
-            assert getattr(step, "schema", None) == schema, (
-                f"f must preserve the relation schema {schema}, got "
-                f"{getattr(step, 'schema', None)}")
+            if getattr(step, "schema", None) != schema:
+                raise CircuitError(
+                    f"f must preserve the relation schema {schema}, got "
+                    f"{getattr(step, 'schema', None)}")
             new = step.plus(i0)
             new.schema = schema
             delta = new.distinct()
@@ -94,8 +94,9 @@ def recursive(parent: Circuit, input_stream: Stream,
     the input change, not the accumulated relation. The output stream
     carries the DELTA of the fixedpoint relation per parent tick.
     """
-    schema = getattr(input_stream, "schema", None)
-    assert schema is not None, "recursive needs schema metadata on the input"
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(input_stream, "recursive")
     # nested operators are not shard-lifted: collapse a sharded input first
     input_stream = input_stream.unshard()
 
@@ -105,9 +106,10 @@ def recursive(parent: Circuit, input_stream: Stream,
         fb = child.add_feedback(Z1(lambda: Batch.empty(*schema)))
         fb.stream.schema = schema
         step = f(child, fb.stream)
-        assert getattr(step, "schema", None) == schema, (
-            f"f must preserve the relation schema {schema}, got "
-            f"{getattr(step, 'schema', None)}")
+        if getattr(step, "schema", None) != schema:
+            raise CircuitError(
+                f"f must preserve the relation schema {schema}, got "
+                f"{getattr(step, 'schema', None)}")
         new = step.plus(i0)
         new.schema = schema
         delta = new.distinct()      # nested: only rows whose status changed

@@ -7,11 +7,24 @@ its sugar through :func:`stream_method` so `dbsp_tpu.operators` import order
 is the only wiring needed.
 """
 
-from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.builder import CircuitError, Stream
 
 
 def stream_method(fn):
-    assert not hasattr(Stream, fn.__name__), (
-        f"Stream.{fn.__name__} registered twice")
+    if hasattr(Stream, fn.__name__):
+        raise CircuitError(f"Stream.{fn.__name__} registered twice")
     setattr(Stream, fn.__name__, fn)
     return fn
+
+
+def require_schema(stream: Stream, who: str):
+    """Typed replacement for the sugar's ``assert schema is not None``
+    guards: user-facing validation must survive ``python -O`` (the static
+    analyzer backs this up at pipeline start, but build-time is earlier)."""
+    schema = getattr(stream, "schema", None)
+    if schema is None:
+        raise CircuitError(
+            f"{who} needs stream schema metadata on {stream!r}; build the "
+            "stream through the operator sugar (add_input_zset/map_rows/"
+            "index_by) or set .schema = (key_dtypes, val_dtypes)")
+    return schema

@@ -21,8 +21,9 @@ from dbsp_tpu.zset.batch import Batch
 @stream_method
 def keys_distinct(self: Stream) -> Stream:
     """Distinct set of this indexed Z-set's keys (drops value columns)."""
-    schema = getattr(self, "schema", None)
-    assert schema is not None
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(self, "keys_distinct")
     key_dtypes = schema[0]
     projected = self.map_rows(lambda k, v: (k, ()), key_dtypes, (),
                               name="keys")
@@ -33,8 +34,9 @@ def keys_distinct(self: Stream) -> Stream:
 def semijoin(self: Stream, other: Stream) -> Stream:
     """Rows of self whose key appears in other (semijoin.rs:38) —
     incremental; preserves self's weights (multiplied by key presence)."""
-    schema = getattr(self, "schema", None)
-    assert schema is not None
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(self, "semijoin")
     return self.join_index(
         other.keys_distinct(),
         lambda k, lv, rv: (k, lv),

@@ -112,6 +112,7 @@ def shard(self: Stream) -> Stream:
 
     rt = Runtime.current()
     if rt is None or rt.workers <= 1:
+        self.shard_intent = True  # exchange elided on a 1-worker mesh
         return self
     if getattr(self, "key_sharded", False):
         return self
@@ -122,6 +123,7 @@ def shard(self: Stream) -> Stream:
     out = self.circuit.add_unary_operator(ExchangeOp(rt.workers), self)
     out.schema = getattr(self, "schema", None)
     out.key_sharded = True
+    out.shard_intent = True
     self.circuit.cache[key] = out
     return out
 
@@ -133,6 +135,7 @@ def unshard(self: Stream) -> Stream:
 
     rt = Runtime.current()
     if rt is None or rt.workers <= 1:
+        self.host_intent = True  # collapse elided on a 1-worker mesh
         return self
     key = ("unshard", self.node_index)
     cached = self.circuit.cache.get(key)

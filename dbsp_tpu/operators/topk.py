@@ -146,8 +146,9 @@ class TopKOp(UnaryOperator):
 @stream_method
 def topk(self: Stream, k: int, largest: bool = True, name=None) -> Stream:
     """Top-K rows per key, ordered by the value columns (see module doc)."""
-    schema = getattr(self, "schema", None)
-    assert schema is not None, "topk needs stream schema metadata"
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(self, "topk")
     # sharded streams stay sharded: rows are key-hash distributed, so every
     # group lives wholly on one worker and per-worker top-K unions exactly
     # (the reference's window-function path self-shards the same way)

@@ -89,13 +89,14 @@ def trace(self: Stream, shard: bool = True) -> Stream:
     join.rs:268-270). ``shard=False`` instead collapses the stream to a
     host-resident trace (for consumers not yet lifted over the mesh:
     topk / rolling / window)."""
+    from dbsp_tpu.operators.registry import require_schema
+
     src = self.shard() if shard else self.unshard()
     key = ("trace", src.node_index)
     cached = src.circuit.cache.get(key)
     if cached is not None:
         return cached
-    schema = getattr(src, "schema", None)
-    assert schema is not None, "trace() needs stream schema metadata"
+    schema = require_schema(src, "trace()")
     out = src.circuit.add_unary_operator(TraceOp(*schema), src)
     out.schema = schema
     out.key_sharded = getattr(src, "key_sharded", False)

@@ -150,6 +150,7 @@ def add_input_map(circuit: Circuit, key_dtypes: Sequence,
     s = circuit.add_source(op)
     s.schema = (op.key_dtypes, op.val_dtypes)
     s.key_sharded = Runtime.worker_count() > 1  # deltas are hash-distributed
+    s.shard_intent = True  # ... and would be on any larger mesh too
     return s, UpsertHandle(op)
 
 

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
-from dbsp_tpu.circuit.builder import FeedbackConnector, Stream
+from dbsp_tpu.circuit.builder import (CircuitError, FeedbackConnector,
+                                      Stream)
 from dbsp_tpu.circuit.operator import BinaryOperator, StrictOperator
 from dbsp_tpu.operators.basic import group_add
 from dbsp_tpu.operators.registry import stream_method
@@ -118,7 +119,9 @@ class _PlusNamed(BinaryOperator):
 
 def _schema_zero(stream: Stream) -> Callable[[], Any]:
     schema = getattr(stream, "schema", None)
-    assert schema is not None, (
-        "stream has no schema metadata; pass zero_factory= explicitly "
-        "(needed by delay/integrate/differentiate to produce the t=0 value)")
+    if schema is None:
+        raise CircuitError(
+            "stream has no schema metadata; pass zero_factory= explicitly "
+            "(needed by delay/integrate/differentiate to produce the t=0 "
+            "value)")
     return _zero_like_factory(schema)

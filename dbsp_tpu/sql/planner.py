@@ -465,11 +465,13 @@ class SqlContext:
         planner then keeps the cheaper two-valued kernels and linear
         aggregates (the inverse of SQL DDL's default, chosen so hot
         streams don't pay for nullability they never use)."""
-        schema = getattr(stream, "schema", None)
-        assert schema is not None, "registered streams need schema metadata"
+        from dbsp_tpu.operators.registry import require_schema
+
+        schema = require_schema(stream, f"register_table({name!r})")
         ncols = len(schema[0]) + len(schema[1])
-        assert len(columns) == ncols, (
-            f"{name}: {len(columns)} column names for {ncols} columns")
+        if len(columns) != ncols:
+            raise ValueError(
+                f"{name}: {len(columns)} column names for {ncols} columns")
         for label, sel in (("string_cols", string_cols),
                            ("nullable_cols", nullable_cols)):
             unknown = set(sel) - set(columns)

@@ -29,7 +29,7 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from dbsp_tpu.circuit.builder import Stream
+from dbsp_tpu.circuit.builder import CircuitError, Stream
 from dbsp_tpu.circuit.operator import UnaryOperator
 # TODO(next round): unify RangeGather/_range_gather_level with aggregate's
 # GroupGather/_gather_level (distinct lo/hi query cols + optional key-column
@@ -335,9 +335,13 @@ def partitioned_rolling_aggregate(self: Stream, agg: Aggregator,
     doc). The stream must be keyed (partition, time). ``use_tree=False``
     forces the O(window) recompute path (the differential-testing oracle
     for the radix-tree path)."""
-    schema = getattr(self, "schema", None)
-    assert schema is not None and len(schema[0]) == 2, (
-        "partitioned_rolling_aggregate needs keys (partition, time)")
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(self, "partitioned_rolling_aggregate")
+    if len(schema[0]) != 2:
+        raise CircuitError(
+            "partitioned_rolling_aggregate needs keys (partition, time), "
+            f"got {len(schema[0])} key column(s)")
     # sharded streams stay sharded: rows route by the partition column, so
     # every partition's window lives wholly on one worker and per-worker
     # rolling unions exactly (reference: rolling_aggregate.rs:235

@@ -177,8 +177,9 @@ def window(self: Stream, bounds: Stream, gc: bool = False) -> Stream:
     trace the same way, time_series/mod.rs): bounds are global scalars, each
     worker slices its own key range, and the union of per-worker slices IS
     the window of the union."""
-    schema = getattr(self, "schema", None)
-    assert schema is not None, "window needs stream schema metadata"
+    from dbsp_tpu.operators.registry import require_schema
+
+    schema = require_schema(self, "window")
     t = self.trace()
     out = self.circuit.add_binary_operator(WindowOp(schema, gc), t, bounds)
     out.schema = schema

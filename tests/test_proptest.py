@@ -25,6 +25,8 @@ hours instead of ~2 minutes.
 import os
 
 import pytest
+
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, example, given, settings, strategies as st
 from hypothesis.database import DirectoryBasedExampleDatabase
 

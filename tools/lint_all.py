@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Unified lint runner: every static check the repo carries, one exit code.
+
+Three fronts (each independently runnable; this bundles them for CI and
+the tier-1 test in tests/test_analysis.py):
+
+1. ``tools/check_metrics.py``  — Prometheus formatting stays in obs/,
+   metric names follow the convention.
+2. ``tools/check_hotpath.py``  — no host round-trips in operator eval
+   bodies / jitted functions; no load-bearing asserts in circuit/ and io/.
+3. **Analyzer self-check** — build every Nexmark query circuit plus a set
+   of representative demo circuits and run the static analyzer
+   (dbsp_tpu/analysis) over each: any ERROR finding is a lint failure
+   (the zero-false-positive contract — known-good circuits must verify).
+
+Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
+1 when any front fails.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+PKG = os.path.join(_ROOT, "dbsp_tpu")
+
+
+def run_check_metrics() -> list:
+    from tools.check_metrics import check_tree
+
+    return check_tree(PKG)
+
+
+def run_check_hotpath() -> list:
+    from tools.check_hotpath import check_tree
+
+    return check_tree(PKG)
+
+
+def _demo_circuits():
+    """Representative known-good circuits beyond Nexmark: the operator
+    shapes the test suite leans on (feedback sugar, linear + general
+    aggregates, distinct, semijoin, recursion, windows)."""
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit import RootCircuit
+    from dbsp_tpu.operators import LinearCount, Max, add_input_zset
+    from dbsp_tpu.zset.batch import Batch
+
+    def basic(c):
+        s, h = add_input_zset(c, [jnp.int64], [jnp.int64])
+        s.differentiate().integrate().output()
+        s.distinct().output()
+        return h
+
+    def joins(c):
+        a, _ = add_input_zset(c, [jnp.int64], [jnp.int64])
+        b, _ = add_input_zset(c, [jnp.int64], [jnp.int64])
+        a.join_index(b, lambda k, lv, rv: (k, (*lv, *rv)),
+                     [jnp.int64], [jnp.int64, jnp.int64]).output()
+        a.semijoin(b).output()
+        return None
+
+    def aggregates(c):
+        s, _ = add_input_zset(c, [jnp.int64], [jnp.int64])
+        s.aggregate(LinearCount()).output()
+        s.aggregate(Max()).output()
+        s.topk(3).output()
+        return None
+
+    def recursion(c):
+        edges, _ = add_input_zset(c, [jnp.int64], [jnp.int64])
+        closure = edges.recurse(
+            lambda child, r: r.join_index(
+                child.import_stream(edges),
+                lambda k, lv, rv: ((lv[0],), (rv[0],)),
+                [jnp.int64], [jnp.int64], name="step"))
+        closure.output()
+        return None
+
+    names = {"basic": basic, "joins": joins, "aggregates": aggregates,
+             "recursion": recursion}
+    for name, build in names.items():
+        circuit, _ = RootCircuit.build(build)
+        yield name, circuit
+
+
+def run_analyzer_selfcheck() -> list:
+    """ERROR findings over known-good circuits, as violation strings."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dbsp_tpu.analysis import ERROR, analyze
+    from dbsp_tpu.analysis.__main__ import (_build_query,
+                                            _nexmark_query_names)
+
+    violations = []
+    targets = [(n, _build_query(n)) for n in _nexmark_query_names()]
+    targets += list(_demo_circuits())
+    for name, circuit in targets:
+        # workers=4 is the what-if sweep: a single-worker build carries
+        # placement intent (elided exchanges), so probing a larger mesh
+        # must stay free of false P001 errors too
+        for workers in (1, 4):
+            for f in analyze(circuit, workers=workers):
+                if f.severity == ERROR:
+                    violations.append(
+                        f"analyzer false positive on {name} "
+                        f"(workers={workers}): {f.render()}")
+    return violations
+
+
+def main() -> int:
+    fronts = [("check_metrics", run_check_metrics),
+              ("check_hotpath", run_check_hotpath),
+              ("analyzer_selfcheck", run_analyzer_selfcheck)]
+    failed = 0
+    for name, fn in fronts:
+        violations = fn()
+        for v in violations:
+            print(v)
+        status = "ok" if not violations else f"{len(violations)} violation(s)"
+        print(f"lint_all: {name}: {status}")
+        failed += bool(violations)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
