@@ -333,12 +333,14 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     _debug(f"[{qname}] warmup total {detail['warmup_s']}s (caps: "
            f"{ {cn.op.name: dict(cn.caps) for cn in ch.cnodes if cn.caps} })")
 
-    # Measured run. CPU: per-tick blocking (true latency distribution).
+    # Measured run. CPU: depth-1 pipelined ticks (tick t+1's host work
+    # overlaps tick t's device compute; samples are completion-to-
+    # completion wall times — a true per-tick latency distribution).
     # TPU: each validation interval is ONE scanned dispatch (lax.scan over
     # the tick index) — per-tick dispatch overhead over the tunnel amortizes
     # across the chunk; the first chunk's compile counts toward elapsed
     # (reported separately as scan_compile_s).
-    ch.step_times_ns.clear()
+    ch.reset_timing()
     t0 = _time.perf_counter()
     m0 = warm_ticks + 1
 
@@ -380,15 +382,37 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         steady_ns = sum(samples)
         steady_events = min(len(samples), ticks) * batch
     if samples:
+        p50_ns = per_tick[len(per_tick) // 2]
+        p99_ns = per_tick[min(len(per_tick) - 1, int(len(per_tick) * 0.99))]
         detail.update(
-            p50_tick_ms=round(per_tick[len(per_tick) // 2] / 1e6, 2),
-            p99_tick_ms=round(
-                per_tick[min(len(per_tick) - 1,
-                             int(len(per_tick) * 0.99))] / 1e6, 2),
+            p50_tick_ms=round(p50_ns / 1e6, 2),
+            p99_tick_ms=round(p99_ns / 1e6, 2),
+            p99_over_p50=round(p99_ns / max(p50_ns, 1), 2),
             latency_samples=len(per_tick),
             latency_granularity=gran,
             steady_state_events_per_s=round(steady_events
                                             / (steady_ns / 1e9), 1))
+        # Tail attribution: a spike (> 3x p50) tick is explained by the
+        # causes the handle annotated against its sample index (maintain
+        # drain / snapshot copy / program retrace) — BENCH_r06 can show
+        # the tail is attributed, not guessed. Raw samples are CHUNK times
+        # in scan mode while p50_ns is per-tick: scale the threshold back
+        # to chunk units there.
+        ann: dict = {}
+        for idx, cause in ch.tick_causes:
+            ann.setdefault(idx, set()).add(cause)
+        spike_ns = 3 * p50_ns * (validate_every if scan else 1)
+        spike_causes: dict = {}
+        for i, s in enumerate(samples):
+            if s > spike_ns:
+                for cause in (ann.get(i) or {"unattributed"}):
+                    spike_causes[cause] = spike_causes.get(cause, 0) + 1
+        detail["spike_causes"] = spike_causes
+        detail["host_overhead_ms"] = {
+            phase: round(sum(v) / 1e6, 2)
+            for phase, v in ch.host_overhead_ns.items()}
+        detail["maintain"] = {
+            k: int(v) for k, v in ch.maintain_stats.items()}
     expected = (ticks // validate_every + (1 if ticks % validate_every else 0)
                 ) if scan else ticks
     detail.update(elapsed_s=round(elapsed, 3), events=measured, ticks=ticks,

@@ -26,6 +26,18 @@ The input side can be closed over too: pass ``gen_fn(tick) -> feeds`` (e.g.
 the same XLA program — a benchmark tick then transfers NOTHING between host
 and device.
 
+Between-tick discipline (the wall-clock side of the contract): ticks run
+PIPELINED at depth 1 (``_run_pipelined`` — dispatch t, wait t-1), snapshots
+are INCREMENTAL (deep trace levels are version-counted and only re-copied
+after a drain touched them), and LSM maintenance is BUDGETED
+(``DBSP_TPU_MAINTAIN_BUDGET_ROWS`` bounds rows moved per ``maintain`` call,
+with a resumable prefix-slice cursor), so no single tick absorbs a drain
+cascade and host work per interval is O(level 0 + budget), not O(state).
+Each between-tick phase is timed into ``host_overhead_ns`` and annotated
+onto the next latency sample (``tick_causes``) — tail ticks are attributable
+to maintain / snapshot / retrace from the bench output alone.
+``tools/check_hotpath.py`` (rule 3) keeps new syncs out of the step loop.
+
 Reference analog: ``crates/dataflow-jit`` (compile the dataflow once,
 schema-driven, no per-record interpretation) — here XLA is the codegen and
 the circuit graph is the IR (SURVEY.md §2.4).
@@ -43,6 +55,7 @@ around a compiled core).
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -54,6 +67,14 @@ from dbsp_tpu.circuit.scheduler import static_schedule
 from dbsp_tpu.compiled import cnodes
 from dbsp_tpu.compiled.cnodes import CNode
 from dbsp_tpu.zset.batch import Batch, bucket_cap
+
+# Maintenance budget (rows MOVED between trace levels per maintain() call).
+# Bounding the per-call drain volume amortizes an LSM cascade over several
+# validation intervals instead of letting one tick absorb l0->l1->...->tail
+# in a single burst (the 8.3x p99/p50 tail measured in BENCH r05). The knob
+# (DBSP_TPU_MAINTAIN_BUDGET_ROWS; <=0 = unbounded) is OWNED by the host
+# spine and imported here so both engines share one amortization discipline.
+from dbsp_tpu.trace.spine import MAINTAIN_BUDGET_ROWS  # noqa: E402
 
 
 class CompiledOverflow(RuntimeError):
@@ -181,6 +202,33 @@ def _drain_pair(receiver: Batch, source: Batch, cap: int):
     return receiver.merge_with(source).with_cap(cap), source.masked(False)
 
 
+@partial(jax.jit, static_argnums=(3,), donate_argnums=(0, 1))
+def _drain_slice(receiver: Batch, source: Batch, n, cap: int):
+    """Drain only the FIRST ``n`` live rows of ``source`` into ``receiver``
+    — the resumable merge cursor of budgeted maintenance. Live rows are
+    packed at the front of a consolidated level, so the taken prefix is
+    itself a consolidated batch and the remainder keeps every level
+    invariant; the cursor is implicitly always 0. A key split across the
+    slice boundary lands in two levels, which consumers already net
+    (``_reduce_groups_impl(..., net=True)``). The remainder re-packs by a
+    ROLL (the kept rows are already contiguous at [n, live)), not a
+    compaction — ``kernels.compact`` assumes an unsharded row axis, while
+    levels here may carry a worker axis ([W, cap]); roll + mask work on
+    the last axis of either layout. On a sharded level ``n`` applies
+    per-worker slice (lives are max-worker counts, the same convention
+    capacity bucketing uses)."""
+    idx = jnp.arange(source.cap, dtype=jnp.int32)
+    take = source.masked(idx < n)
+    rolled = Batch(
+        tuple(jnp.roll(k, -n, axis=-1) for k in source.keys),
+        tuple(jnp.roll(v, -n, axis=-1) for v in source.vals),
+        jnp.roll(source.weights, -n, axis=-1))
+    # positions that wrapped around hold the taken prefix — dead them;
+    # rolled live rows occupy [0, live - n), already packed at the front
+    rest = rolled.masked(idx < source.cap - n)
+    return receiver.merge_with(take).with_cap(cap), rest
+
+
 class CompiledHandle:
     """Drives a compiled circuit: step / validate / grow / snapshot-replay."""
 
@@ -229,6 +277,27 @@ class CompiledHandle:
         # obs registry exports this as
         # dbsp_tpu_compiled_overflow_replays_total)
         self.overflow_replays = 0
+        # -- tail attribution + incremental-snapshot bookkeeping ------------
+        # host_overhead_ns: wall time of each between-tick host phase (obs
+        # exports dbsp_tpu_compiled_tick_host_overhead_seconds{phase});
+        # tick_causes: (sample index, cause) annotations — a spike in
+        # step_times_ns[i] is explained by the causes recorded against i
+        # (bench.py turns these into per-cause spike counts)
+        self.host_overhead_ns: Dict[str, List[int]] = {
+            "validate": [], "maintain": [], "snapshot": []}
+        self.tick_causes: List[Tuple[int, str]] = []
+        self._pending_causes: set = set()
+        # maintain amortization state (see maintain()): cumulative stats the
+        # cascade test and obs read, plus the per-(state, level) version
+        # counters the incremental snapshot uses to skip re-copying deep
+        # levels that no drain has touched since the last snapshot
+        self.maintain_stats: Dict[str, int] = {
+            "calls": 0, "drains": 0, "partial_drains": 0, "rows_moved": 0,
+            "max_slice_rows": 0, "max_budgeted_slice_rows": 0,
+            "exempt_drains": 0}
+        self.maintain_pending = False
+        self._level_versions: Dict[str, List[int]] = {}
+        self._snap_levels: Dict[str, List[Optional[Tuple[int, Batch]]]] = {}
 
     # -- feeds ---------------------------------------------------------------
     def _feed_indices(self, feeds: Dict) -> Dict[int, Batch]:
@@ -333,10 +402,12 @@ class CompiledHandle:
                 lambda s, t: self._run_nodes(s, t, {})[1], states, t0)
             init_outs = jax.tree_util.tree_map(
                 lambda sh: jnp.zeros(sh.shape, sh.dtype), outs_shape)
-            if varying:
+            if varying and hasattr(jax.lax, "pcast"):
                 # inside shard_map the per-tick outputs are worker-varying;
                 # the zero init must carry the same vma type or the scan
-                # carry types mismatch
+                # carry types mismatch. Older JAX (< varying-manual-axes)
+                # has no pcast and no vma tracking — skip, the carry
+                # already type-checks there.
                 from dbsp_tpu.parallel.mesh import WORKER_AXIS
 
                 init_outs = jax.tree_util.tree_map(
@@ -386,8 +457,6 @@ class CompiledHandle:
     def step_scanned(self, t0: int, n: int, block: bool = False) -> None:
         """Run ticks [t0, t0+n) as one scanned dispatch (see _make_scan).
         Programs are cached per chunk length n."""
-        import time
-
         cache = getattr(self, "_scan_jits", None)
         if cache is None:
             cache = self._scan_jits = {}
@@ -401,32 +470,90 @@ class CompiledHandle:
         self._req = req if self._req is None else self._max_jit(self._req, req)
         if block:
             self.block()
-        self.step_times_ns.append(time.perf_counter_ns() - t_start)
+        self._append_sample(time.perf_counter_ns() - t_start)
 
     # -- stepping ------------------------------------------------------------
-    def step(self, tick: int = 0, feeds: Optional[Dict] = None,
-             block: bool = False) -> None:
-        """Dispatch one tick. No host sync unless ``block``; call
-        :meth:`validate` (one sync) before trusting outputs/state."""
-        import time
+    def _note_cause(self, cause: str) -> None:
+        """Annotate the NEXT latency sample with a spike cause (maintain /
+        snapshot / retrace) — consumed by :meth:`_append_sample`."""
+        self._pending_causes.add(cause)
 
+    def _append_sample(self, ns: int) -> None:
+        idx = len(self.step_times_ns)
+        self.step_times_ns.append(ns)
+        if self._pending_causes:
+            for c in sorted(self._pending_causes):
+                self.tick_causes.append((idx, c))
+            self._pending_causes.clear()
+
+    def reset_timing(self) -> None:
+        """Clear latency samples, cause annotations, host-overhead records,
+        and maintain stats (harnesses call this between warmup and the
+        measured run, so reported slices/rows describe the measured window,
+        not warmup's presize-era cascades)."""
+        self.step_times_ns.clear()
+        self.tick_causes.clear()
+        self._pending_causes.clear()
+        for v in self.host_overhead_ns.values():
+            v.clear()
+        for k in self.maintain_stats:
+            self.maintain_stats[k] = 0
+
+    def _dispatch(self, tick: int, feeds: Optional[Dict] = None) -> None:
+        """Dispatch one tick's program asynchronously (no timing, no sync)."""
         if self._step_jit is None:
+            self._note_cause("retrace")  # first call compiles the program
             self._step_jit = self._make_step()
-        t0 = time.perf_counter_ns()
         f = self._feed_indices(feeds) if feeds else {}
         states, outputs, req = self._step_jit(
             self.states, jnp.asarray(tick, jnp.int64), f)
         self.states = states
         self.last_outputs = outputs
         self._req = req if self._req is None else self._max_jit(self._req, req)
+
+    def step(self, tick: int = 0, feeds: Optional[Dict] = None,
+             block: bool = False) -> None:
+        """Dispatch one tick. No host sync unless ``block``; call
+        :meth:`validate` (one sync) before trusting outputs/state."""
+        t0 = time.perf_counter_ns()
+        self._dispatch(tick, feeds)
         if block:
             self.block()
-        self.step_times_ns.append(time.perf_counter_ns() - t0)
+        self._append_sample(time.perf_counter_ns() - t0)
+
+    def _run_pipelined(self, t0: int, upto: int) -> None:
+        """Run ticks [t0, upto) with a depth-1 pipeline: dispatch tick t,
+        then wait for tick t-1 — host-side work (feed indexing, pytree
+        flattening, dispatch) of one tick overlaps device compute of the
+        previous one, replacing the old block-per-tick protocol that
+        serialized host and device. One latency sample per iteration
+        (dispatch of t + completion wait of t-1): on a backend where the
+        donating step call is effectively synchronous (measured on this
+        CPU PJRT client: a donated dispatch blocks until its input
+        buffers' producer finishes) the sample IS tick t's latency; on a
+        truly async backend it is tick t-1's, shifted by one. The
+        interval's LAST tick completes inside the caller's validate()
+        fetch — the designated sync point — and its wall time lands in
+        ``host_overhead_ns["validate"]``."""
+        prev = None
+        t_prev = time.perf_counter_ns()
+        for tt in range(t0, upto):
+            self._dispatch(tt)
+            # completion marker for THIS tick: the requirement running-max
+            # (outputs when the circuit has no capacity checks) — outputs
+            # and req are program results, never donated, so a held marker
+            # stays valid across the next dispatch
+            marker = self._req if self._req is not None else self.last_outputs
+            if prev is not None:
+                jax.block_until_ready(prev)  # hotpath: ok pipeline barrier on tick t-1
+            now = time.perf_counter_ns()
+            self._append_sample(now - t_prev)
+            t_prev = now
+            prev = marker
 
     def block(self) -> None:
         """Wait for dispatched work (cheap sync, no data transfer)."""
-        jax.tree_util.tree_map(
-            lambda x: x.block_until_ready(), self.states)
+        jax.block_until_ready(self.states)
 
     # -- validation / growth -------------------------------------------------
     def validate(self) -> None:
@@ -454,7 +581,7 @@ class CompiledHandle:
                 return int(r)
         return None
 
-    def maintain(self) -> bool:
+    def maintain(self, budget_rows: Optional[int] = None) -> bool:
         """Host-side spine maintenance: drain half-full trace levels into
         the next level, between validated intervals (the compiled-mode
         analog of the reference's background spine merger,
@@ -474,9 +601,34 @@ class CompiledHandle:
         level whose capacity this method normally grows. Growing middle
         levels instead would quietly absorb every cascade: the tail would
         never compact and the middle of the ladder would balloon toward
-        the tail's size."""
+        the tail's size.
+
+        ``budget_rows`` (default: module :data:`MAINTAIN_BUDGET_ROWS`, env
+        ``DBSP_TPU_MAINTAIN_BUDGET_ROWS``; None/<=0 = unbounded) bounds the
+        rows MOVED between levels per call — the fuel. A level whose live
+        rows exceed the remaining budget drains a prefix slice
+        (:func:`_drain_slice`, the resumable cursor) and the rest stays
+        due, resuming on the next call, so a full cascade amortizes over
+        several intervals instead of landing in one tick. Deferral is
+        always safe: the trace is the union of its levels at every point,
+        so consumers see identical content (proven bit-identical by
+        tests/test_maintenance.py); only compaction, not correctness, is
+        deferred. Two carve-outs keep deferral from regressing into worse
+        failure modes: level 0's drain is budget-EXEMPT (deferring it
+        risks an overflow replay + retrace, and its slice is bounded by
+        l0's capacity — one interval's inflow), and a budgeted drain whose
+        receiver lacks room FILLS the receiver to its existing capacity
+        instead of growing it (a mid-run middle-level grow would retrace
+        the step program)."""
         from dbsp_tpu.circuit.runtime import Runtime
 
+        if budget_rows is None:
+            budget_rows = MAINTAIN_BUDGET_ROWS
+        left = budget_rows if budget_rows and budget_rows > 0 else None
+        stats = self.maintain_stats
+        stats["calls"] += 1
+        rows_before = stats["rows_moved"]
+        self.maintain_pending = False
         changed = False
         prev_rt = Runtime._swap(self.runtime) if self.mesh is not None \
             else None
@@ -517,28 +669,98 @@ class CompiledHandle:
                            for k in range(K - 1)):
                     cn._live_cache = lives
                     continue
+                vers = self._level_versions.setdefault(key, [0] * K)
 
-                def drain(k):
-                    nonlocal changed
-                    if k + 1 < K - 1 and \
+                def drain(k, exempt=False):
+                    nonlocal changed, left
+                    # l0 is budget-exempt: deferring IT is not a deferred
+                    # compaction but an overflow REPLAY + step-program
+                    # retrace (measured: a 17s p99 tick), and its slice is
+                    # bounded by l0's capacity — one interval's inflow
+                    budgeted = left is not None and not exempt and k > 0
+                    if not budgeted and left is None and k + 1 < K - 1 and \
                             (lives[k] + lives[k + 1]) * 2 > levels[k + 1].cap:
-                        drain(k + 1)  # make room downstream first
-                    need = lives[k] + lives[k + 1]
+                        # unbounded mode: make room downstream first (the
+                        # budgeted path instead fills receivers to capacity
+                        # and lets the shallow-first sweep drain them)
+                        drain(k + 1)
+                    n = min(lives[k], left) if budgeted else lives[k]
+                    if n <= 0:
+                        self.maintain_pending = True  # fuel ran out
+                        return
                     rk1 = cn.level_keys[k + 1]
+                    need = lives[k + 1] + n
                     if need > cn.caps[rk1]:
-                        # tail growth (or an inverted ladder after l0 grew
-                        # past an initial middle level): non-tail receivers
-                        # get headroom to absorb further drains
-                        cn.caps[rk1] = bucket_cap(
-                            need if k + 1 == K - 1 else need * 2)
-                        changed = True
-                    levels[k + 1], levels[k] = _drain_pair(
-                        levels[k + 1], levels[k], cn.caps[rk1])
-                    lives[k + 1] = need  # upper bound (netting may shrink)
-                    lives[k] = 0
+                        if k + 1 == K - 1:
+                            # tail growth: unavoidable — the tail holds the
+                            # whole trace (presize projects it to end-of-run
+                            # size precisely to keep this out of the run)
+                            cn.caps[rk1] = bucket_cap(need)
+                            changed = True
+                        elif left is None:
+                            # unbounded mode: legacy headroom growth (an
+                            # inverted ladder after l0 outgrew a middle
+                            # level) — receivers absorb further drains
+                            cn.caps[rk1] = bucket_cap(need * 2)
+                            changed = True
+                        else:
+                            # budgeted: growing a middle level invalidates
+                            # the step program (measured: a ~10-20s q4
+                            # recompile landing in ONE tick). Fill the
+                            # receiver to its existing capacity instead —
+                            # the shallow-first sweep (or the next call)
+                            # drains it onward; the remainder stays here.
+                            n = cn.caps[rk1] - lives[k + 1]
+                            if k == 0 and n < lives[k]:
+                                # last resort: l0 MUST drain FULLY — a
+                                # residue plus the next interval's inflow
+                                # overflows l0 (replay + retrace). Force
+                                # room below regardless of budget (rare;
+                                # beats the overflow replay it prevents).
+                                stats["exempt_drains"] += 1
+                                drain(k + 1, exempt=True)
+                                n = cn.caps[rk1] - lives[k + 1]
+                            if n <= 0:
+                                self.maintain_pending = True
+                                return
+                            n = min(n, lives[k])
+                            need = lives[k + 1] + n
+                    if n >= lives[k]:
+                        levels[k + 1], levels[k] = _drain_pair(
+                            levels[k + 1], levels[k], cn.caps[rk1])
+                        stats["drains"] += 1
+                    else:
+                        levels[k + 1], levels[k] = _drain_slice(
+                            levels[k + 1], levels[k],
+                            jnp.asarray(n, jnp.int32), cn.caps[rk1])
+                        stats["partial_drains"] += 1
+                        self.maintain_pending = True  # remainder stays due
+                    vers[k] += 1
+                    vers[k + 1] += 1
+                    lives[k + 1] += n  # upper bound (netting may shrink)
+                    lives[k] -= n
+                    stats["rows_moved"] += n
+                    stats["max_slice_rows"] = max(stats["max_slice_rows"], n)
+                    if budgeted:
+                        stats["max_budgeted_slice_rows"] = max(
+                            stats["max_budgeted_slice_rows"], n)
+                        left -= n
 
-                for k in range(K - 2, -1, -1):
+                # Order: unbounded keeps the legacy deep-first cascade
+                # (receivers make room before their feeders). Budgeted
+                # runs SHALLOW-first — fill-to-cap makes draining into a
+                # full receiver safe, and the sweep reaches that receiver
+                # next, so the inflow path (l0 -> l1) can never starve
+                # behind a multi-interval tail compaction; the deep,
+                # state-sized drains get whatever fuel remains and defer
+                # across calls.
+                order = range(K - 1) if left is not None \
+                    else range(K - 2, -1, -1)
+                for k in order:
                     if lives[k] and lives[k] * 2 >= levels[k].cap:
+                        if k > 0 and left is not None and left <= 0:
+                            self.maintain_pending = True
+                            continue  # deep compaction defers; l0 may not
                         drain(k)
                 cn._live_cache = lives
                 base_val = sum(lives[1:])
@@ -547,7 +769,10 @@ class CompiledHandle:
         finally:
             if self.mesh is not None:
                 Runtime._swap(prev_rt)
+        if stats["rows_moved"] > rows_before:
+            self._note_cause("maintain")
         if changed:
+            self._note_cause("retrace")
             self._step_jit = None
             self._scan_jits = {}
         return changed
@@ -617,6 +842,45 @@ class CompiledHandle:
             self._scan_jits = {}
             self._req = None
             self.restore(snap)  # re-pad states to the new capacities
+        self.prewarm_maintenance()
+
+    def prewarm_maintenance(self) -> None:
+        """Compile the maintenance drain kernels for the CURRENT ladder
+        shapes, on warmup's clock instead of the measured run's.
+
+        Each (receiver cap, source cap, out cap, schema) combination of
+        :func:`_drain_pair` / :func:`_drain_slice` compiles on first use;
+        left to happen lazily, those compiles land inside the measured
+        window the first time each level pair drains (measured: ~5s of
+        q4's mini-run maintain overhead was drain-kernel compiles, dwarfing
+        the drains themselves). Presize fixes the ladder for the planned
+        run, so every pair can be compiled here by running one throwaway
+        drain over COPIES of the live levels (donation consumes the
+        copies, never the state; results are discarded)."""
+        from dbsp_tpu.circuit.runtime import Runtime
+
+        prev_rt = Runtime._swap(self.runtime) if self.mesh is not None \
+            else None
+        try:
+            for cn in self.cnodes:
+                if not isinstance(cn, cnodes._Leveled):
+                    continue
+                st = self.states.get(str(cn.node.index))
+                if st is None or len(st[0]) < 2:
+                    continue
+                levels = st[0]
+                for k in range(len(levels) - 1):
+                    recv, src = levels[k + 1], levels[k]
+                    cap = cn.caps[cn.level_keys[k + 1]]
+                    if recv.cap != cap:
+                        continue  # growth pending; shapes would not match
+                    _drain_pair(_copy_tree(recv), _copy_tree(src), cap)
+                    if MAINTAIN_BUDGET_ROWS:
+                        _drain_slice(_copy_tree(recv), _copy_tree(src),
+                                     jnp.asarray(0, jnp.int32), cap)
+        finally:
+            if self.mesh is not None:
+                Runtime._swap(prev_rt)
 
     def grow(self, overflow: CompiledOverflow, headroom: int = 2,
              project_ratio: float = 1.0) -> None:
@@ -645,6 +909,20 @@ class CompiledHandle:
         self._scan_jits = {}
         self._req = None
 
+    def _snap_cacheable(self, key: str):
+        """The leveled cnode for ``key`` if its deep levels are
+        copy-skippable (untouched between maintain calls), else None.
+        Window-GC'd traces are excluded: the step program truncates EVERY
+        level in-program each tick, so their deep levels are never clean."""
+        cn = self.by_index.get(int(key))
+        if isinstance(cn, cnodes._Leveled) and \
+                not getattr(cn, "_gc_refresh", False):
+            st = self.states.get(key)
+            if isinstance(st, tuple) and len(st) == 2 and \
+                    isinstance(st[0], tuple) and len(st[0]) > 1:
+                return cn
+        return None
+
     def snapshot(self) -> Dict[str, Any]:
         """A restorable DEEP copy of the current (validated) states.
 
@@ -652,14 +930,70 @@ class CompiledHandle:
         is what keeps untouched trace levels copy-free per tick), so a
         reference snapshot would be invalidated by the very next step —
         the copy here is the price of in-place stepping, paid per
-        snapshot interval instead of per tick."""
-        return _copy_tree(dict(self.states))
+        snapshot interval instead of per tick.
+
+        INCREMENTAL: the step program only ever writes level 0 of a
+        leveled trace — deeper levels change solely in :meth:`maintain`
+        (version-counted there). A deep level whose version matches the
+        cached copy from a previous snapshot reuses that copy instead of
+        being copied again, so steady-state snapshot cost is O(level 0 +
+        small states), not O(whole trace). Cached copies are plain result
+        buffers (never donated anywhere — :meth:`restore` copies before
+        use), so sharing them across snapshots is safe."""
+        to_copy: Dict[str, Any] = {}
+        reuse: Dict[str, Dict[int, Batch]] = {}
+        for key, st in self.states.items():
+            cn = self._snap_cacheable(key)
+            if cn is None:
+                to_copy[key] = st
+                continue
+            levels, b = st
+            vers = self._level_versions.setdefault(key, [0] * len(levels))
+            cache = self._snap_levels.get(key) or [None] * len(levels)
+            kept: Dict[int, Batch] = {}
+            fresh: Dict[int, Batch] = {}
+            for i, lvl in enumerate(levels):
+                ent = cache[i] if i > 0 else None
+                if ent is not None and ent[0] == vers[i]:
+                    kept[i] = ent[1]
+                else:
+                    fresh[i] = lvl
+            to_copy[key] = (fresh, b)
+            reuse[key] = kept
+        copied = _copy_tree(to_copy)  # ONE dispatch for every fresh leaf
+        snap: Dict[str, Any] = {}
+        for key, st in self.states.items():
+            if key not in reuse:
+                snap[key] = copied[key]
+                continue
+            levels, _ = st
+            fresh_c, base_c = copied[key]
+            vers = self._level_versions[key]
+            cache = self._snap_levels.setdefault(
+                key, [None] * len(levels))
+            merged = []
+            for i in range(len(levels)):
+                if i in reuse[key]:
+                    merged.append(reuse[key][i])
+                else:
+                    merged.append(fresh_c[i])
+                    if i > 0:
+                        cache[i] = (vers[i], fresh_c[i])
+            snap[key] = (tuple(merged), base_c)
+        return snap
 
     def restore(self, snap: Dict[str, Any]) -> None:
         """Restore a snapshot (copying again — the snapshot must survive
         the restored states being donated), re-padding trace states to the
         current capacities (no-op when capacities haven't changed)."""
         states = _copy_tree(dict(snap))
+        # the restored buffers are new objects at possibly new capacities;
+        # drop the deep-level copy cache and advance every version so a
+        # later snapshot never pairs a stale copy with the rewound state
+        self._snap_levels.clear()
+        for vers in self._level_versions.values():
+            for i in range(len(vers)):
+                vers[i] += 1
         for cn in self.cnodes:
             key = str(cn.node.index)
             if key in states:
@@ -675,7 +1009,8 @@ class CompiledHandle:
                   on_validated: Optional[Callable] = None,
                   block_each: bool = False, scan: bool = False,
                   project_ratio: float = 1.0,
-                  snapshot_every: int = 1) -> None:
+                  snapshot_every: int = 1,
+                  maintain_budget_rows: Optional[int] = None) -> None:
         """Run ticks [t0, t0+n) under a ``gen_fn`` with periodic validation
         and snapshot/replay on overflow (exact: inputs are functions of the
         tick index). ``on_validated(next_tick)`` fires after each validated
@@ -683,16 +1018,27 @@ class CompiledHandle:
         mark suppresses re-fires while an overflow replay re-runs intervals
         since the last snapshot (``snapshot_every > 1``), so accumulating
         callbacks (throughput counters) stay correct across replays.
-        ``block_each`` waits per tick so ``step_times_ns`` records
-        true per-tick latency instead of dispatch time (a bare device sync is
-        ~0.1ms even over the tunnel; only data fetches are expensive).
+
+        ``block_each`` runs each interval PIPELINED at depth 1 (see
+        :meth:`_run_pipelined`): tick t+1's host work overlaps tick t's
+        device compute, and ``step_times_ns`` records the wall time between
+        consecutive tick completions — a real per-tick latency distribution
+        without the old sync-per-tick serialization. Without it, ticks
+        dispatch fully async and the only syncs are the validation
+        fetches at interval boundaries.
 
         ``scan=True`` runs each validation interval as ONE scanned dispatch
         (see :meth:`step_scanned`) — per-tick latency is then the chunk time
         / chunk length. ``project_ratio`` is handed to :meth:`grow` so an
-        overflow mid-run jumps monotone capacities to end-of-run size."""
+        overflow mid-run jumps monotone capacities to end-of-run size.
+        ``maintain_budget_rows`` bounds each interval's maintenance slice
+        (see :meth:`maintain`); between-tick host phases are timed into
+        ``host_overhead_ns`` and annotated onto the next latency sample."""
         assert self._gen_fn is not None, "run_ticks needs a gen_fn"
+        overhead = self.host_overhead_ns
+        h0 = time.perf_counter_ns()
         snap, snap_t = self.snapshot(), t0
+        overhead["snapshot"].append(time.perf_counter_ns() - h0)
         t = t0
         iv = 0
         reported = t0  # high-water tick already delivered to on_validated
@@ -700,26 +1046,39 @@ class CompiledHandle:
             upto = min(t + validate_every, t0 + n)
             if scan:
                 self.step_scanned(t, upto - t, block=block_each)
+            elif block_each:
+                self._run_pipelined(t, upto)
             else:
                 for tt in range(t, upto):
-                    self.step(tick=tt, block=block_each)
+                    self.step(tick=tt)
+            h0 = time.perf_counter_ns()
             try:
                 self.validate()
             except CompiledOverflow as e:
+                overhead["validate"].append(time.perf_counter_ns() - h0)
                 self.overflow_replays += 1
                 self.grow(e, project_ratio=project_ratio)
                 self.restore(snap)
+                self._note_cause("retrace")
                 t = snap_t
                 continue  # replay from the snapshot at the new capacities
-            self.maintain()  # state stays valid; may re-trace next step
+            overhead["validate"].append(time.perf_counter_ns() - h0)
+            h0 = time.perf_counter_ns()
+            # state stays valid; may re-trace next step
+            self.maintain(budget_rows=maintain_budget_rows)
+            overhead["maintain"].append(time.perf_counter_ns() - h0)
             iv += 1
             t = upto
             if iv % max(1, snapshot_every) == 0:
-                # snapshots are O(state) copies (states are donated) —
-                # coarser cadence amortizes them; the replay window on a
-                # rare overflow widens accordingly, which determinism makes
-                # exact either way
+                # snapshots copy level 0 + the small states (deep levels
+                # reuse version-matched cached copies, see snapshot()) —
+                # coarser cadence amortizes them further; the replay window
+                # on a rare overflow widens accordingly, which determinism
+                # makes exact either way
+                h0 = time.perf_counter_ns()
                 snap, snap_t = self.snapshot(), t
+                overhead["snapshot"].append(time.perf_counter_ns() - h0)
+                self._note_cause("snapshot")
             if on_validated is not None and t > reported:
                 # replayed intervals (t <= reported after an overflow
                 # rewind) were already delivered — suppress the duplicate

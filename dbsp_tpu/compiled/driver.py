@@ -12,11 +12,18 @@ program per tick instead of per-operator dispatches.
 Feed/overflow protocol: inputs arrive through the normal host
 ``InputHandle`` buffers (the catalog's ``push_rows``); each ``step`` drains
 them via ``ZSetInput.eval`` (same canonicalization as the host path),
-snapshots the compiled states, runs the tick, and validates capacity
-requirements immediately. On overflow it grows, restores the snapshot, and
-replays the SAME tick from the retained feeds — serving pipelines validate
-every tick (the retained-feed window is one step), trading the benchmark
-path's amortized validation for bounded replay.
+runs the tick, and validates capacity requirements at the validation
+cadence. On overflow it grows, restores the interval-start snapshot, and
+replays the retained feeds — deterministic, so the replay is exact.
+
+Validation cadence (``DBSP_TPU_SERVE_VALIDATE_EVERY``, default 1): at 1,
+every tick snapshots, validates, and delivers immediately — the bounded-
+replay contract serving pipelines shipped with. At N > 1 the driver
+PIPELINES: ticks dispatch asynchronously (JAX async dispatch lets the host
+encode of tick t+1 — the input drain — overlap device compute of tick t),
+feeds are retained for replay, and outputs buffer until the interval
+validates, then deliver in order. One snapshot + one device fetch per N
+ticks instead of per tick; output visibility lags up to N-1 ticks.
 
 Outputs flow back through the host ``OutputOperator.eval`` so every
 existing consumer (HTTP ``/read`` cursors, output transports, ``to_dict``
@@ -27,7 +34,8 @@ from __future__ import annotations
 
 import contextlib
 import logging
-from typing import Dict, Optional
+import os
+from typing import Dict, List, Optional, Tuple
 
 from dbsp_tpu.compiled.compiler import (CompiledHandle, CompiledOverflow,
                                         compile_circuit)
@@ -41,7 +49,8 @@ class CompiledCircuitDriver:
     mode = "compiled"
     spans = None  # optional obs.SpanRecorder (set by CompiledInstrumentation)
 
-    def __init__(self, handle, compiled: Optional[CompiledHandle] = None):
+    def __init__(self, handle, compiled: Optional[CompiledHandle] = None,
+                 validate_every: Optional[int] = None):
         from dbsp_tpu.operators.io_handles import OutputOperator, ZSetInput
         from dbsp_tpu.operators.upsert import UpsertInput
 
@@ -49,6 +58,9 @@ class CompiledCircuitDriver:
         self.circuit = handle.circuit
         self.ch = compiled or compile_circuit(handle)
         self._tick = 0
+        self.validate_every = max(1, validate_every if validate_every
+                                  is not None else int(os.environ.get(
+                                      "DBSP_TPU_SERVE_VALIDATE_EVERY", "1")))
         # (op, drain_fn): ZSetInput feeds its tick batch; UpsertInput feeds
         # the raw command batch its compiled node diffs against state
         self._inputs = []
@@ -59,6 +71,11 @@ class CompiledCircuitDriver:
                 self._inputs.append((cn.op, cn.op.take_commands))
         self._outputs = [(cn.node.index, cn.op) for cn in self.ch.cnodes
                          if isinstance(cn.op, OutputOperator)]
+        # interval state: snapshot at interval start, retained (tick, feeds)
+        # for exact replay, buffered per-tick outputs awaiting validation
+        self._snap = None
+        self._retained: List[Tuple[int, Dict]] = []
+        self._out_buffer: List[Dict[int, object]] = []
 
     @property
     def step_latencies_ns(self):
@@ -66,17 +83,34 @@ class CompiledCircuitDriver:
 
     def step(self) -> None:
         """One serving tick: drain input buffers -> compiled step ->
-        validate (grow + exact same-tick replay on overflow) -> deliver
+        (at the validation cadence) validate, grow + exact replay of the
+        retained interval on overflow, maintain, and deliver the buffered
         outputs to the host output operators."""
         feeds: Dict = {op: drain() for op, drain in self._inputs}
         spans = self.spans
         if spans is not None:
             spans.begin(f"tick[{self._tick}]", cat="step")
-        snap = self.ch.snapshot()
+        if not self._retained:
+            self._snap = self.ch.snapshot()  # interval-start checkpoint
+        self._retained.append((self._tick, feeds))
+        with (spans.span("compiled_step", cat="compiled") if spans
+              is not None else contextlib.nullcontext()):
+            self.ch.step(tick=self._tick, feeds=feeds)
+        # feeds are host-built program INPUTS (never donated), so the
+        # retained references replay the identical batches after a grow
+        self._out_buffer.append(dict(self.ch.last_outputs))
+        self._tick += 1
+        if len(self._retained) >= self.validate_every:
+            self._flush()
+        if spans is not None:
+            spans.end(f"tick[{self._tick - 1}]")
+
+    def _flush(self) -> None:
+        """Validate the open interval; on overflow grow + replay the
+        retained feeds from the interval-start snapshot (exact); then run
+        a bounded maintenance slice and deliver outputs in tick order."""
+        spans = self.spans
         while True:
-            with (spans.span("compiled_step", cat="compiled") if spans
-                  is not None else contextlib.nullcontext()):
-                self.ch.step(tick=self._tick, feeds=feeds)
             try:
                 self.ch.validate()
                 break
@@ -85,15 +119,27 @@ class CompiledCircuitDriver:
                 if spans is not None:
                     spans.instant("overflow_replay", cat="compiled")
                 self.ch.grow(e)
-                self.ch.restore(snap)
+                self.ch.restore(self._snap)
+                self._out_buffer.clear()
+                for tick, feeds in self._retained:
+                    self.ch.step(tick=tick, feeds=feeds)
+                    self._out_buffer.append(dict(self.ch.last_outputs))
         self.ch.maintain()  # spine drains; dispatch-free when nothing due
-        self._tick += 1
-        for idx, out_op in self._outputs:
-            batch = self.ch.last_outputs.get(idx)
-            if batch is not None:
-                out_op.eval(batch)
-        if spans is not None:
-            spans.end(f"tick[{self._tick - 1}]")
+        for outputs in self._out_buffer:
+            for idx, out_op in self._outputs:
+                batch = outputs.get(idx)
+                if batch is not None:
+                    out_op.eval(batch)
+        self._out_buffer.clear()
+        self._retained.clear()
+        self._snap = None
+
+    def flush(self) -> None:
+        """Force validation/delivery of a partially-filled interval (the
+        controller calls this on pause/stop and before barrier reads so a
+        cadence > 1 never leaves undelivered ticks behind)."""
+        if self._retained:
+            self._flush()
 
 
 def try_compiled_driver(handle, registry=None, verified=False):

@@ -162,7 +162,7 @@ class Controller:
         self.state = "paused"
         self._running.clear()
         with self._step_lock:  # quiesce: wait out any in-flight step
-            pass
+            self._flush_driver_locked()
 
     def stop(self) -> None:
         self.state = "shutdown"
@@ -172,6 +172,18 @@ class Controller:
             ep.transport.stop()
         if self._thread:
             self._thread.join(timeout=10)
+        with self._step_lock:
+            self._flush_driver_locked()
+
+    def _flush_driver_locked(self) -> None:
+        """Validate + deliver a compiled driver's open interval (no-op for
+        host handles and at the default serve cadence of 1). Called with
+        the step lock held, at quiesce points and when the loop idles, so
+        a validation cadence > 1 never strands buffered outputs."""
+        flush = getattr(self.handle, "flush", None)
+        if flush is not None:
+            flush()
+            self._emit_outputs()
 
     def eoi_reached(self) -> bool:
         """All inputs exhausted AND fully processed.
@@ -184,6 +196,10 @@ class Controller:
                    for ep in self.inputs.values()):
             return False
         with self._step_lock:
+            # "fully processed" includes a compiled driver's open deferred-
+            # validation interval — validate + deliver it before answering,
+            # or a cadence > 1 strands the final ticks' outputs
+            self._flush_driver_locked()
             return all(ep.eoi and ep.buffered() == 0
                        for ep in self.inputs.values())
 
@@ -212,6 +228,8 @@ class Controller:
                         last_flush = now
                         stepped = True
             if not stepped:
+                with self._step_lock:
+                    self._flush_driver_locked()
                 time.sleep(0.005)
             self._backpressure()
 
@@ -229,6 +247,9 @@ class Controller:
                 ep.collection.push_rows(rows)
         self.handle.step()
         self.steps += 1
+        self._emit_outputs()
+
+    def _emit_outputs(self) -> None:
         for out in self.outputs.values():
             # per-consumer queue: the HTTP server's /read peeks the same
             # handle, so a destructive take() here would race it

@@ -207,6 +207,24 @@ class CompiledInstrumentation:
         self.replays_total = registry.counter(
             "dbsp_tpu_compiled_overflow_replays_total",
             "Grow-and-replay cycles after a capacity overflow")
+        # between-tick host phases (validate fetch / maintain drains /
+        # snapshot copies) — the wall-clock the async tick pipeline exists
+        # to bound; a spike tick's cause annotations are counted per cause
+        self.host_overhead_hist = registry.histogram(
+            "dbsp_tpu_compiled_tick_host_overhead_seconds",
+            "Host wall-clock of one between-tick phase of the compiled "
+            "step loop (validate = the per-interval device fetch, "
+            "maintain = bounded LSM drain slice, snapshot = incremental "
+            "state copy)", labels=("phase",))
+        self.causes_total = registry.counter(
+            "dbsp_tpu_compiled_tick_causes_total",
+            "Latency-sample annotations by cause (maintain drain, "
+            "snapshot copy, program retrace) — attributes tail ticks",
+            labels=("cause",))
+        self.maintain_rows_total = registry.counter(
+            "dbsp_tpu_compiled_maintain_moved_rows_total",
+            "Rows moved between trace levels by bounded maintenance")
+        self._overhead_seen: Dict[str, int] = {}
         registry.register_collector(self._collect)
         if spans is not None:
             driver.spans = spans  # driver records tick/validate spans
@@ -230,6 +248,25 @@ class CompiledInstrumentation:
         if ch is None:
             return
         self.replays_total.set_total(getattr(ch, "overflow_replays", 0))
+        # host-overhead phases: same unseen-tail protocol as latencies
+        overhead = getattr(ch, "host_overhead_ns", None)
+        if overhead:
+            with self._lat_lock:
+                for phase, samples in overhead.items():
+                    n = len(samples)
+                    tail = samples[self._overhead_seen.get(phase, 0):n]
+                    self._overhead_seen[phase] = n
+                    child = self.host_overhead_hist.labels(phase=phase)
+                    for ns in tail:
+                        child.observe(ns / 1e9)
+        causes: Dict[str, int] = {}
+        for _, cause in getattr(ch, "tick_causes", ()):
+            causes[cause] = causes.get(cause, 0) + 1
+        for cause, count in causes.items():
+            self.causes_total.labels(cause=cause).set_total(count)
+        stats = getattr(ch, "maintain_stats", None)
+        if stats:
+            self.maintain_rows_total.set_total(stats.get("rows_moved", 0))
         for cn in ch.cnodes:
             if not isinstance(cn, cnodes._Leveled):
                 continue

@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -98,6 +99,15 @@ class SqlError(ValueError):
     pass
 
 
+def _like_regex(pattern: str):
+    """Compile a SQL LIKE pattern (% = any run, _ = any one char)."""
+    import re
+
+    return re.compile("^" + "".join(
+        ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+        for ch in pattern) + "$")
+
+
 class SqlStrings:
     """Append-only string dictionary shared by every string column of one
     SqlContext (the engine-wide VARCHAR design: variable-length text is
@@ -113,14 +123,55 @@ class SqlStrings:
     def __init__(self):
         self._codes: Dict[str, int] = {}
         self._strs: List[str] = []
+        # (pattern, compiled regex, dictionary length) per planned LIKE:
+        # the code set a LIKE lowered to is exact only for this prefix of
+        # the dictionary — growth past it is checked in encode()
+        self._like_plans: List[Tuple[str, object, int]] = []
 
     def encode(self, s: str) -> int:
         code = self._codes.get(s)
         if code is None:
+            # Dictionary-growth hazard (ADVICE r5): a planned LIKE matches
+            # a code set snapshotted at trace time, so a string first seen
+            # AFTER the trace can never enter that set. Growth is harmless
+            # while the new string matches no planned pattern (its absence
+            # from the hit set is the correct answer, for NOT LIKE too);
+            # a string that WOULD match must refuse ingestion instead of
+            # silently dropping rows from the maintained view.
+            for pattern, rx, snap in self._like_plans:
+                if rx.match(s):
+                    raise SqlError(
+                        f"string dictionary grew past a planned LIKE: "
+                        f"{s!r} matches pattern {pattern!r}, which was "
+                        f"lowered against the dictionary at {snap} "
+                        f"entries ({len(self._strs)} now) and can never "
+                        "match codes minted later — the view would "
+                        "silently miss these rows. Register the full "
+                        "string domain before planning, or re-plan the "
+                        "LIKE views (rebuild the SqlContext and call "
+                        "replanned_like()) after new strings arrive.")
             code = len(self._strs)
             self._codes[s] = code
             self._strs.append(s)
         return code
+
+    def like_planned(self, pattern: str) -> None:
+        """Record that a LIKE over ``pattern`` was traced against the
+        CURRENT dictionary — encode() henceforth rejects new strings that
+        the planned filter would wrongly never match. A retrace of the
+        same pattern refreshes its snapshot in place (between two traces
+        no matching string can have been minted — it would have raised)."""
+        entry = (pattern, _like_regex(pattern), len(self._strs))
+        for i, (p, _, _) in enumerate(self._like_plans):
+            if p == pattern:
+                self._like_plans[i] = entry
+                return
+        self._like_plans.append(entry)
+
+    def replanned_like(self) -> None:
+        """Drop the LIKE snapshots after the owner re-planned every LIKE
+        view (re-tracing re-snapshots the dictionary via like_planned)."""
+        self._like_plans.clear()
 
     def decode(self, code: int) -> Optional[str]:
         if code == NULL_INT(np.int64) or code < 0 or \
@@ -131,11 +182,7 @@ class SqlStrings:
     def like_codes(self, pattern: str) -> List[int]:
         """Codes of all known strings matching a SQL LIKE pattern
         (% = any run, _ = any one char)."""
-        import re
-
-        rx = re.compile("^" + "".join(
-            ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
-            for ch in pattern) + "$")
+        rx = _like_regex(pattern)
         return [i for i, s in enumerate(self._strs) if rx.match(s)]
 
 
@@ -381,6 +428,13 @@ def _eval3(expr, scope: _Scope, cols) -> _V:
         if not v.is_str:
             raise SqlError("LIKE requires a string expression")
         codes = scope.strings.like_codes(expr.pattern)
+        # Snapshot the dictionary when the filter KERNEL traces (cols are
+        # tracers) — from then on encode() of a new matching string raises
+        # instead of silently missing this filter (see SqlStrings). The
+        # plan-time type probe (eager sample columns) is not a snapshot:
+        # its code set is discarded and re-derived at trace time.
+        if isinstance(v.val, jax.core.Tracer):
+            scope.strings.like_planned(expr.pattern)
         hit = jnp.asarray(False)
         for c in codes:
             hit = hit | (v.val == c)

@@ -36,6 +36,22 @@ DEVICE_BUDGET_ROWS: Optional[int] = (
     int(os.environ["DBSP_TPU_DEVICE_ROWS"])
     if os.environ.get("DBSP_TPU_DEVICE_ROWS") else None)
 
+# Maintenance budget (rows one maintenance call may move/merge) — the ONE
+# owner of the DBSP_TPU_MAINTAIN_BUDGET_ROWS knob; the compiled engine
+# (compiled/compiler.py) imports it so both engines stay in lockstep. An
+# equal-bucket compaction whose pair cost exceeds the budget defers to a
+# later insert/maintain call instead of landing its whole merge in one
+# tick. The trace is the union of its batches at every point, so deferral
+# changes only WHEN compaction happens, never any consumer result
+# (tests/test_maintenance.py proves bit-identity). 0/negative = unbounded
+# (None); unset defaults to 131072 rows.
+_env_maintain = os.environ.get("DBSP_TPU_MAINTAIN_BUDGET_ROWS")
+if _env_maintain:
+    MAINTAIN_BUDGET_ROWS: Optional[int] = (
+        int(_env_maintain) if int(_env_maintain) > 0 else None)
+else:
+    MAINTAIN_BUDGET_ROWS = 1 << 17
+
 
 def _to_cold(batch: Batch) -> Batch:
     """Move a batch's columns to host memory (numpy). jnp kernels accept
@@ -61,7 +77,8 @@ class Spine:
     """
 
     def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = (),
-                 device_budget_rows: Optional[int] = None):
+                 device_budget_rows: Optional[int] = None,
+                 maintain_budget_rows: Optional[int] = None):
         self.key_dtypes = tuple(jnp.dtype(d) for d in key_dtypes)
         self.val_dtypes = tuple(jnp.dtype(d) for d in val_dtypes)
         self.batches: List[Batch] = []
@@ -70,6 +87,16 @@ class Spine:
         self.device_budget_rows = (device_budget_rows
                                    if device_budget_rows is not None
                                    else DEVICE_BUDGET_ROWS)
+        self.maintain_budget_rows = (maintain_budget_rows
+                                     if maintain_budget_rows is not None
+                                     else MAINTAIN_BUDGET_ROWS)
+        # amortization bookkeeping: last_slice_rows is the row capacity the
+        # most recent insert/maintain call actually merged (what the
+        # cascade test bounds); pending_compaction flags deferred merges
+        self.maintain_stats = {"merged_rows": 0, "max_slice_rows": 0,
+                               "merges": 0, "forced_merges": 0}
+        self.last_slice_rows = 0
+        self.pending_compaction = False
 
     def device_resident_rows(self) -> int:
         """Capacity currently held in DEVICE memory (cold levels excluded)
@@ -119,7 +146,8 @@ class Spine:
 
     # -- maintenance --------------------------------------------------------
     def insert(self, batch: Batch) -> None:
-        """Insert a consolidated delta batch; merge equal-sized levels."""
+        """Insert a consolidated delta batch; merge equal-sized levels
+        (amortized — see :meth:`maintain`)."""
         batch = _shrink(batch)
         if batch is None:
             return
@@ -127,23 +155,64 @@ class Spine:
         self._consolidated = None
         self.batches.append(batch)
         self.batches.sort(key=lambda b: b.cap, reverse=True)
-        # Merge while two levels share a capacity bucket (LSM compaction).
-        # Levels are consolidated (sorted), so each merge is one rank-based
-        # sorted-merge kernel, not a re-sort of the combined rows.
+        self.maintain()
+        self._enforce_budget()
+
+    def maintain(self, budget_rows: Optional[int] = None) -> bool:
+        """One bounded compaction slice: merge levels sharing a capacity
+        bucket (LSM compaction) until the per-call budget is spent.
+
+        Levels are consolidated (sorted), so each merge is one rank-based
+        sorted-merge kernel, not a re-sort of the combined rows. The budget
+        (default: the spine's ``maintain_budget_rows``) bounds the summed
+        row capacity merged per call — the host-path analog of the
+        reference's merge fuel (spine_fueled.rs:107) and of the compiled
+        engine's drain budget: a cascade (merge chains re-bucketing into
+        the next class) spreads over subsequent insert/maintain calls
+        instead of one tick absorbing it. Deferred pairs are correct
+        merely-uncompacted state (probes fan over all batches); a bucket
+        holding MORE than two batches force-merges regardless of budget so
+        a budget below one pair's cost degrades to late compaction, never
+        to unbounded batch growth. Returns True while work remains
+        (``pending_compaction``)."""
+        budget = (budget_rows if budget_rows is not None
+                  else self.maintain_budget_rows)
+        left = budget if budget and budget > 0 else None
+        sliced = 0
         merged = True
+        deferred = False
         while merged:
             merged = False
+            buckets: Dict[int, int] = {}
+            for b in self.batches:
+                buckets[b.cap] = buckets.get(b.cap, 0) + 1
             for i in range(len(self.batches) - 1):
-                if self.batches[i].cap == self.batches[i + 1].cap:
-                    a = self.batches.pop(i + 1)
-                    b = self.batches.pop(i)
-                    m = _shrink(a.merge_with(b))
-                    if m is not None:
-                        self.batches.insert(i, m)
-                        self.batches.sort(key=lambda b: b.cap, reverse=True)
-                    merged = True
-                    break
-        self._enforce_budget()
+                if self.batches[i].cap != self.batches[i + 1].cap:
+                    continue
+                cost = self.batches[i].cap + self.batches[i + 1].cap
+                over = left is not None and cost > left - sliced
+                forced = buckets.get(self.batches[i].cap, 0) > 2
+                if over and not forced:
+                    deferred = True
+                    continue
+                a = self.batches.pop(i + 1)
+                b = self.batches.pop(i)
+                m = _shrink(a.merge_with(b))
+                if m is not None:
+                    self.batches.insert(i, m)
+                    self.batches.sort(key=lambda b: b.cap, reverse=True)
+                sliced += cost
+                self.maintain_stats["merged_rows"] += cost
+                self.maintain_stats["merges"] += 1
+                if over:
+                    self.maintain_stats["forced_merges"] += 1
+                merged = True
+                break
+        self.last_slice_rows = sliced
+        self.maintain_stats["max_slice_rows"] = max(
+            self.maintain_stats["max_slice_rows"], sliced)
+        self.pending_compaction = deferred
+        return deferred
 
     def is_empty(self) -> bool:
         return not self.batches

@@ -549,6 +549,47 @@ def test_hotpath_lint_catches_violations(tmp_path):
     assert any("assert used for validation" in v for v in violations)
 
 
+def test_hotpath_lint_step_loop_sync_rule(tmp_path):
+    """Rule 3: block_until_ready / jax.device_get inside the compiled
+    engine's per-tick step-loop methods is a violation (waivable); the
+    designated sync points (validate/block) and other directories stay
+    exempt."""
+    from tools.check_hotpath import check_tree
+
+    pkg = tmp_path / "pkg"
+    (pkg / "compiled").mkdir(parents=True)
+    (pkg / "compiled" / "loop.py").write_text(
+        "import jax\n"
+        "class H:\n"
+        "    def step(self, t):\n"
+        "        self.states = self._jit(self.states, t)\n"
+        "        jax.block_until_ready(self.states)\n"
+        "    def run_ticks(self, n):\n"
+        "        for t in range(n):\n"
+        "            self.step(t)\n"
+        "        r = jax.device_get(self._req)\n"
+        "        return r\n"
+        "    def _run_pipelined(self, prev):\n"
+        "        jax.block_until_ready(prev)  # hotpath: ok depth-1 barrier\n"
+        "    def validate(self):\n"
+        "        return jax.device_get(self._req)\n"
+        "    def block(self):\n"
+        "        jax.block_until_ready(self.states)\n")
+    # same calls OUTSIDE compiled/ are rule-3-exempt
+    (pkg / "other.py").write_text(
+        "import jax\n"
+        "class X:\n"
+        "    def step(self):\n"
+        "        jax.block_until_ready(self.s)\n")
+    violations = check_tree(str(pkg))
+    sync = [v for v in violations if "per-tick step loop" in v]
+    assert len(sync) == 2, sync  # step's block + run_ticks' device_get
+    assert any("H.step" in v and "block_until_ready" in v for v in sync)
+    assert any("H.run_ticks" in v and "device_get" in v for v in sync)
+    assert not any("H.validate" in v or "(H.block)" in v or "other.py" in v
+                   for v in sync)
+
+
 def test_metrics_and_hotpath_lints_via_lint_all():
     from tools.lint_all import run_check_hotpath, run_check_metrics
 

@@ -535,3 +535,42 @@ def test_compiled_upsert_matches_host():
     comp = run(True)
     assert comp == host
     assert host[-1] == {(2, 22): 1, (3, 30): 1}
+
+
+def test_compiled_driver_deferred_validation_matches_per_tick():
+    """Serving cadence > 1 (DBSP_TPU_SERVE_VALIDATE_EVERY): ticks dispatch
+    without per-tick validation, feeds are retained for exact replay, and
+    outputs buffer until the interval validates — delivered in order, so
+    the flushed state is identical to the validate-every-tick driver; a
+    partial interval is delivered by flush() (the controller calls it at
+    quiesce points and when its loop idles)."""
+    from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+    from dbsp_tpu.operators.upsert import add_input_map
+
+    def run(validate_every):
+        def build(c):
+            s, h = add_input_map(c, (jnp.int64,), (jnp.int64,))
+            return h, s.integrate().output()
+
+        handle, (h, out) = Runtime.init_circuit(1, build)
+        driver = CompiledCircuitDriver(handle, validate_every=validate_every)
+        seen = []
+        for t in range(7):
+            h.upsert((t % 3,), (t * 10,))
+            driver.step()
+            seen.append(out.to_dict())
+        driver.flush()
+        seen.append(out.to_dict())
+        return seen
+
+    per_tick = run(1)
+    deferred = run(3)
+    # nothing visible mid-interval...
+    assert deferred[0] == {} and deferred[1] == {}
+    # ...the validated interval delivers its ticks in order (last wins)...
+    assert deferred[2] == per_tick[2]
+    assert deferred[3] == per_tick[2]  # stale until the next flush
+    assert deferred[5] == per_tick[5]
+    # ...and the trailing partial interval arrives via flush()
+    assert deferred[-1] == per_tick[-1]
+    assert per_tick[-1] == per_tick[6]

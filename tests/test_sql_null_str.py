@@ -176,3 +176,39 @@ def test_type_errors():
             return ()
 
         RootCircuit.build(build)
+
+
+def test_like_dictionary_growth_hazard():
+    """ADVICE r5: a string first ingested AFTER a LIKE filter was traced
+    can never enter the filter's snapshotted code set. Growth by strings
+    the pattern does NOT match stays exact (their absence from the hit set
+    is the right answer, for NOT LIKE too) and must keep working; a string
+    the pattern WOULD match must be refused at encode time instead of
+    silently vanishing from the maintained view."""
+    def build(c):
+        t1, h1 = add_input_zset(c, [jnp.int64], [jnp.int64, jnp.int64])
+        ctx = SqlContext(c)
+        ctx.register_table("t1", t1, ["a", "b", "s"], string_cols=("s",),
+                           nullable_cols=("b", "s"))
+        view = ctx.query("SELECT a FROM t1 WHERE s LIKE 'ap%'")
+        return ctx, h1, view, view.integrate().output()
+
+    circuit, (ctx, h1, view, out) = RootCircuit.build(build)
+    h1.extend([(ctx.encode_row("t1", (1, 10, "apple")), 1),
+               (ctx.encode_row("t1", (2, -4, "banana")), 1)])
+    circuit.step()  # traces the filter -> snapshots the dictionary
+    assert ctx.decode_output(view, out.to_dict()) == {(1,): 1}
+
+    # growth by a NON-matching string: exact under the snapshot, accepted
+    h1.extend([(ctx.encode_row("t1", (3, 7, "cherry")), 1)])
+    circuit.step()
+    assert ctx.decode_output(view, out.to_dict()) == {(1,): 1}
+
+    # growth by a MATCHING string: would silently never match — refused
+    with pytest.raises(SqlError, match="planned LIKE"):
+        ctx.encode_row("t1", (4, 2, "apricot"))
+
+    # a deliberate replan clears the snapshots and re-admits the domain
+    ctx.strings.replanned_like()
+    code = ctx.strings.encode("apricot")
+    assert ctx.strings.decode(code) == "apricot"

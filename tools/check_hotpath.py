@@ -25,6 +25,19 @@ tests/test_analysis.py, and bundled into tools/lint_all.py):
    ``python -O``, turning validation into undefined behavior. Raise typed
    exceptions (CircuitError / ValueError) instead.
 
+3. **No stray syncs in the compiled per-tick step loop.** In
+   ``dbsp_tpu/compiled/``, the methods that form the tick pipeline
+   (``step``/``_dispatch``/``_run_pipelined``/``step_scanned``/
+   ``run_ticks``/``maintain``/``snapshot``/``restore``) must not call
+   ``block_until_ready`` or ``jax.device_get`` directly: the async tick
+   pipeline exists precisely because every such sync serializes host and
+   device (BENCH r05: ~70% of q3's elapsed was between-tick host work).
+   Synchronization belongs in the designated sync points — ``validate()``
+   (the one device->host fetch per interval) and ``block()`` — which the
+   loop calls at interval boundaries. A deliberate in-loop barrier (the
+   depth-1 pipeline wait on tick t-1) carries a ``# hotpath: ok`` waiver
+   stating why.
+
 Usage: ``python tools/check_hotpath.py [root]`` — prints violations and
 exits 1 when any are found.
 """
@@ -43,6 +56,11 @@ HOT_METHODS = ("eval", "eval_strict", "get_output", "import_value")
 
 #: directories (relative to the package root) where assert is banned
 NO_ASSERT_DIRS = ("circuit", "io")
+
+#: rule 3 — the compiled engine's per-tick step loop: no direct syncs here
+STEP_LOOP_DIR = "compiled"
+STEP_LOOP_METHODS = ("step", "_dispatch", "_run_pipelined", "step_scanned",
+                     "run_ticks", "maintain", "snapshot", "restore")
 
 WAIVER = "# hotpath: ok"
 
@@ -100,6 +118,38 @@ def _forbidden_call(node: ast.Call) -> str | None:
     return None
 
 
+def _forbidden_sync(node: ast.Call) -> str | None:
+    """The rule-3 label if this call synchronizes host and device, else
+    None: any .block_until_ready() (method or jax.block_until_ready) or
+    jax.device_get inside the compiled step loop."""
+    dotted = _dotted(node.func)
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "block_until_ready":
+        return ".block_until_ready()"
+    if dotted in ("jax.block_until_ready", "block_until_ready"):
+        return "jax.block_until_ready()"
+    if dotted in ("jax.device_get", "device_get"):
+        return dotted + "()"
+    return None
+
+
+def _check_sync_body(fn: ast.AST, kind: str, rel: str, lines,
+                     violations) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        label = _forbidden_sync(node)
+        if label is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{rel}:{node.lineno}: host/device sync {label} inside the "
+            f"per-tick step loop ({kind}) — sync only at the designated "
+            f"points (validate/block), or waive with '{WAIVER} <reason>'")
+
+
 def _check_body(fn: ast.AST, kind: str, rel: str, lines, violations) -> None:
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
@@ -149,6 +199,17 @@ def check_tree(pkg_root: str) -> list:
                     any(_is_jit_expr(d) for d in node.decorator_list)
                 if is_jit:
                     _check_body(node, f"jitted function {node.name}", rel,
+                                lines, violations)
+        # rule 3: no stray syncs in the compiled per-tick step loop
+        if rel_pkg.split(os.sep)[0] == STEP_LOOP_DIR:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)) and \
+                                item.name in STEP_LOOP_METHODS:
+                            _check_sync_body(
+                                item, f"{node.name}.{item.name}", rel,
                                 lines, violations)
         # rule 2: no asserts in circuit/ and io/
         if rel_pkg.split(os.sep)[0] in NO_ASSERT_DIRS:
