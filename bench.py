@@ -85,13 +85,45 @@ def _debug(msg: str) -> None:
         print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
               flush=True)
 
-# Persistent compile cache: TPU compiles are tens of seconds; cache them
-# across bench invocations.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_bench_cache"))
+# Persistent compile cache: TPU compiles are tens of seconds (and q4's CPU
+# warmup measured 37 s of pure retrace/recompile against a 3.1 s measured
+# window, BENCH r05); cache programs across bench invocations.
+# DBSP_TPU_COMPILE_CACHE_DIR (the engine-wide knob, see
+# dbsp_tpu.compiled.driver.enable_compile_cache) overrides the default
+# per-repo cache directory.
+_COMPILE_CACHE_DIR = (os.environ.get("DBSP_TPU_COMPILE_CACHE_DIR")
+                      or os.path.join(
+                          os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_bench_cache"))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _COMPILE_CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
+
+def _cache_entries() -> int:
+    d = os.environ.get("JAX_COMPILATION_CACHE_DIR", _COMPILE_CACHE_DIR)
+    try:
+        return sum(1 for _ in os.scandir(d))
+    except OSError:
+        return 0
+
+
+# Cold-vs-warm attribution is PROCESS-level: the first query of a run
+# against an empty cache directory populates it, so a per-query entry
+# count would mislabel later queries' (still cold-compiling) warmups as
+# warm. Captured once at import, before any measurement compiles.
+_CACHE_COLD_AT_START = _cache_entries() == 0
+
+
+def _compile_cache_state() -> dict:
+    """Cold-vs-warm attribution for warmup_s: whether the cache directory
+    was empty when THIS PROCESS started (a cold run pays every
+    trace+compile inside warmup_s; a warm rerun deserializes), plus the
+    entry count when the query began."""
+    return {"dir": os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                  _COMPILE_CACHE_DIR),
+            "entries_before": _cache_entries(),
+            "cold": _CACHE_COLD_AT_START}
 
 
 def _emit(metric: str, value: float, detail: dict) -> None:
@@ -282,6 +314,14 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     scan = platform != "cpu"
 
     detail.update(query=qname, batch_per_tick=batch, events=0)
+    # cold-vs-warm warmup attribution: warmup_s is dominated by
+    # trace+compile on a cold cache and by deserialization on a warm one
+    cache_state = _compile_cache_state()
+    detail["compile_cache"] = cache_state
+    detail["warmup_cold"] = cache_state["cold"]
+    from dbsp_tpu.zset import kernels as _zk
+
+    consolidate_before = dict(_zk.CONSOLIDATE_COUNTS)
     cfg = GeneratorConfig(seed=1)
 
     def build(c):
@@ -415,6 +455,11 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
             k: int(v) for k, v in ch.maintain_stats.items()}
     expected = (ticks // validate_every + (1 if ticks % validate_every else 0)
                 ) if scan else ticks
+    # consolidation-regime dispatch decisions this query exercised (see
+    # zset/kernels.py CONSOLIDATE_COUNTS — traced calls count per trace)
+    detail["consolidate_paths"] = {
+        k: int(v - consolidate_before.get(k, 0))
+        for k, v in _zk.CONSOLIDATE_COUNTS.items()}
     detail.update(elapsed_s=round(elapsed, 3), events=measured, ticks=ticks,
                   replayed_intervals=max(0, len(samples) - expected))
     return eps

@@ -21,14 +21,13 @@ validation points and grows + retraces on overflow.
 INPUT trace states (CTrace — the integrators consumers probe) are LEVELED
 inside the program — the spine, compiled (reference: the fueled spine's
 amortization contract, ``crates/dbsp/src/trace/spine_fueled.rs:1-81``).
-Each trace is a static tuple of K consolidated level batches in geometric
-capacity classes; a tick's delta rank-merges into level 0 (O(|L0|+|Δ|)),
-and a level that fills past half its capacity spills into the next via
-``lax.cond`` — so a big merge touching the tail runs only every
-~cap(K-2)/2 appended rows, and per-tick HBM traffic is O(Δ·levels)
-amortized instead of O(state). The spill decision is a device scalar: no
-host round-trip ever schedules a merge, which is what the reference's fuel
-bookkeeping exists to do.
+Each trace is a static tuple of K level batches in geometric capacity
+classes; a tick's delta lands in a SLOT of level 0 with one
+dynamic-update-slice (O(|Δ|) copied bytes, no merge — see
+``_Leveled._levels_append``), and deeper compaction happens between
+validated intervals in host-driven maintenance — so per-tick HBM traffic
+is O(Δ) and the merge work is amortized to one sorted-run fold per
+interval instead of per tick.
 
 Two design rules keep leveling from costing more than it saves (measured
 on Nexmark q4, CPU backend — violating either regressed steady-state ~5x):
@@ -84,18 +83,23 @@ def levels_for_run(ticks: int) -> int:
     """Level count that amortizes tail merges for a planned run length.
 
     State ≈ ticks·Δ and L0 holds ~2 deltas, so with growth ratio g the tail
-    absorbs a spill every ~2·g^(K-2) ticks; K = 2 + log_g(ticks/8) keeps
-    that to a handful per run. Short runs (few large batches) get K=1-2 —
-    measured on Nexmark q4/CPU, a K too high for the run length loses
-    ~1.8x steady-state to spill overhead, and K too low loses ~1.8x to
-    O(state) re-merges (BENCH round-4 sweep: K=1 2831 ev/s, K=2 4342,
-    K=4 5231 at 96 ticks)."""
+    absorbs a spill every ~2·g^(K-2) ticks; K ≈ log_g(ticks/8) deep levels
+    keeps that to a handful per run. Short runs (few large batches) get
+    K=1-2 — a K too high for the run length loses steady-state to spill
+    overhead, and K too low loses to O(state) re-merges (BENCH round-4
+    sweep, pre-slotting: K=1 2831 ev/s, K=2 4342, K=4 5231 at 96 ticks).
+
+    Since the SLOTTED level 0 landed (one ladder of per-delta slots folded
+    once per interval), l0 itself absorbs what the first deep level used
+    to, so the formula carries one level less than the pre-slot tuning:
+    re-measured on Nexmark q4/CPU at 100 ticks, K=3 beats K=4 on both p50
+    (9.4 vs 9.8 ms) and elapsed (1.53 vs 1.62 s)."""
     import math
 
     if ticks <= 1:
         return 1
     extra = max(0.0, math.log(ticks / 8, LEVEL_GROWTH))
-    return max(1, min(4, 2 + math.ceil(extra)))
+    return max(1, min(4, 1 + math.ceil(extra)))
 
 
 class _Leveled:
@@ -106,9 +110,9 @@ class _Leveled:
     the subclass's ``TAIL_KEY`` (which keeps its legacy name so
     MONOTONE_CAPS / presize semantics carry over unchanged).
 
-    Spill scheduling is HOST-DRIVEN: the per-tick program only merges the
-    delta into level 0 (one native two-pointer merge on CPU) and touches
-    nothing else — levels 1..K-1 flow through the step function unmodified,
+    Spill scheduling is HOST-DRIVEN: the per-tick program only writes the
+    delta into level 0 (a slot append — see :meth:`_levels_append`) and
+    touches nothing else — levels 1..K-1 flow through the step unmodified,
     so XLA aliases them instead of copying. Draining level k into level k+1
     happens BETWEEN validated intervals in ``CompiledHandle.maintain()``
     (an earlier in-program ``lax.cond`` cascade copied every level's full
@@ -134,6 +138,11 @@ class _Leveled:
     def _levels_init(self, schema, lead, migrated: Optional[Batch]):
         lv = [Batch.empty(*schema, cap=self.caps[k], lead=lead)
               for k in self.level_keys]
+        # level 0's run tag is ALWAYS None: the slotted append produces an
+        # untagged batch, and the tag is pytree AUX data — it must be
+        # byte-identical at init, after appends, and across drains, or
+        # scan carries mismatch and every tick retraces the step program
+        lv[0] = lv[0].tagged(None)
         base = 0
         if migrated is not None:
             # warm start: the host spine's consolidated state becomes the tail
@@ -142,28 +151,147 @@ class _Leveled:
         return (tuple(lv), jnp.full(lead, base, jnp.int64))
 
     def _levels_append(self, ctx, state, delta: Batch):
-        """Merge a delta into level 0 (the only in-program state write).
+        """Append a delta to level 0 (the only in-program state write).
 
-        Registers two requirements: level 0's live count (drained each
-        maintenance interval, so its running max is the per-interval
-        inflow) and the whole-trace size (base_live + level-0 live) under
-        ``TAIL_KEY`` — the monotone capacity presize projects linearly.
+        SLOTTED append (the steady-state path): level 0 is a ladder of
+        ``cap(l0) / cap(delta)`` static SLOTS of one delta capacity each.
+        Appending writes the (padded, consolidated) delta into the next
+        free slot with one ``dynamic_update_slice`` — O(|delta|) copied
+        bytes, NO merge, NO O(cap) sentinel re-fill. The slot contents are
+        sorted runs at STATIC offsets, so consumers probe them as extra
+        ladder levels (:meth:`_view_levels`) and maintenance folds them
+        with sorted merges once per interval instead of the step program
+        merging every tick (measured ~1-1.6 ms per trace per tick at q4
+        caps — the single largest per-tick cost after the fused cursors
+        landed). Occupancy is DERIVED (count of non-empty slots — empty
+        deltas re-use their slot), so the state layout is unchanged.
+        Falls back to the legacy merge when the slot geometry doesn't hold
+        (delta capacity not dividing l0) or the trace is window-GC'd
+        (in-program truncation compacts across slot boundaries).
+
+        Registers two requirements: level 0's consumed capacity (slots in
+        use after this append x slot size — drained each maintenance
+        interval, so its running max is the per-interval inflow) and the
+        whole-trace size (base_live + level-0 rows) under ``TAIL_KEY`` —
+        the monotone capacity presize projects linearly. When the slots
+        are full, further rows land in the LAST slot (clobbered) and the
+        capacity requirement exceeds cap — the runner's validation grows
+        and replays, the standard overflow contract.
         """
+        from jax import lax
+
         levels, base = state
         new = list(levels)
-        m0 = new[0].merge_with(delta)
+        l0 = new[0]
+        dcap = delta.cap
+        can_slot = (not getattr(self, "_gc_refresh", False)
+                    and not getattr(self, "_no_slots", False)  # per-level
+                    # consumers (range join / window / rolling) fan one
+                    # launch per viewed level — see compiler.__init__
+                    and len(self.level_keys) > 1  # K=1: l0 IS the tail —
+                    # no maintenance drain would ever fold the slots
+                    and l0.cap % dcap == 0
+                    and delta.weights.ndim == l0.weights.ndim)
+        # the slot size is PINNED per instance: geometry must describe the
+        # CONTENT of l0, which survives across retraces — re-deriving it
+        # from each trace's delta capacity would reinterpret slots written
+        # at one size as sorted runs at another (unsorted garbage to every
+        # fused probe). A delta whose capacity doesn't match the pin takes
+        # the canonicalize-then-merge fallback below; its output (one
+        # consolidated run) remains a valid slot ladder at ANY size, so
+        # matching deltas resume slotting afterwards.
+        if can_slot and getattr(self, "_slot_cap", None) is None:
+            self._slot_cap = dcap
+        slotted = can_slot and self._slot_cap == dcap
+        if slotted:
+            nslots = l0.cap // dcap
+            w_slots = l0.weights.reshape(
+                *l0.weights.shape[:-1], nslots, dcap)
+            occ = jnp.sum(jnp.any(w_slots != 0, axis=-1), axis=-1)
+            has = jnp.any(delta.weights != 0, axis=-1)
+            start = (jnp.minimum(occ, nslots - 1) * dcap).astype(jnp.int32)
+            # write ONLY when the delta has rows and a free slot exists: an
+            # unconditional write would clobber the last occupied slot on
+            # an empty delta at full occupancy (no overflow would fire —
+            # the requirement stays == cap), silently losing rows. A full
+            # ladder with a NON-empty delta also skips the write: its rows
+            # are lost either way, but the capacity requirement then
+            # exceeds cap and the runner replays from the snapshot.
+            write = has & (occ < nslots)
+
+            def put(dst, src):
+                return jnp.where(
+                    write,
+                    lax.dynamic_update_slice_in_dim(
+                        dst, src.astype(dst.dtype), start, axis=-1),
+                    dst)
+
+            l0_live = jnp.sum(l0.weights != 0) + jnp.sum(delta.weights != 0)
+            new[0] = Batch(
+                tuple(put(k, dk) for k, dk in zip(l0.keys, delta.keys)),
+                tuple(put(v, dv) for v, dv in zip(l0.vals, delta.vals)),
+                put(l0.weights, delta.weights))
+            ctx.require(self, self.level_keys[0],
+                        (occ + jnp.where(has, 1, 0)) * dcap)
+            if self.TAIL_KEY != self.level_keys[0]:
+                ctx.require(self, self.TAIL_KEY, base + l0_live)
+            return (tuple(new), base)
+        if getattr(self, "_slot_cap", None) is not None:
+            # l0 may hold slot runs: canonicalize before the merge (whose
+            # contract requires sorted inputs). Only mismatched-capacity
+            # deltas pay this in-program sort; stable feeds never do.
+            nk0 = len(l0.keys)
+            cols0, w0 = kernels.consolidate_cols(l0.cols, l0.weights)
+            l0 = Batch(cols0[:nk0], cols0[nk0:], w0)
+        m0 = l0.merge_with(delta)
         live0 = m0.live_count()
         ctx.require(self, self.level_keys[0], live0)
         if self.TAIL_KEY != self.level_keys[0]:
             ctx.require(self, self.TAIL_KEY, base + live0)
-        new[0] = m0.with_cap(self.caps[self.level_keys[0]])
+        new[0] = m0.with_cap(self.caps[self.level_keys[0]]).tagged(None)
         return (tuple(new), base)
+
+    def _view_levels(self, levels) -> Tuple[Batch, ...]:
+        """The level tuple consumers probe: slotted level 0 expands into
+        its per-slot runs (static slices, each a consolidated batch), the
+        deeper levels pass through. The fused trace cursors fan over the
+        whole expansion in one probe, so extra slots cost probe lanes, not
+        kernel launches."""
+        slot = getattr(self, "_slot_cap", None)
+        l0 = levels[0]
+        if not slot or l0.cap == slot or l0.cap % slot != 0:
+            return tuple(levels)
+        slices = tuple(
+            Batch(tuple(k[..., i * slot:(i + 1) * slot] for k in l0.keys),
+                  tuple(v[..., i * slot:(i + 1) * slot] for v in l0.vals),
+                  l0.weights[..., i * slot:(i + 1) * slot], runs=(slot,))
+            for i in range(l0.cap // slot))
+        return (*slices, *levels[1:])
 
     def _levels_repad(self, state):
         levels, base = state
-        return (tuple(
-            b.with_cap(self.caps[k]) if b.cap != self.caps[k] else b
-            for b, k in zip(levels, self.level_keys)), base)
+        # re-tag while re-padding: levels are consolidated by contract —
+        # EXCEPT a slotted level 0, whose runs live at slot offsets (its
+        # state rides untagged; maintain re-tags before folding). A
+        # uniform tag per level keeps the state pytree aux byte-stable
+        # across drains/restores (an aux change would retrace the step).
+        out = []
+        for i, (b, k) in enumerate(zip(levels, self.level_keys)):
+            if i == 0 and getattr(self, "_slot_cap", None) is not None:
+                # a SLOTTED l0 canonicalizes on restore: the grow that
+                # preceded it may have changed the producer's delta
+                # capacity, and the append path re-checks the pinned slot
+                # size against a consolidated l0 safely (any contiguous
+                # window of a consolidated region is itself a valid
+                # sorted run at every slot size). Never-slotted traces
+                # keep their l0 consolidated by construction — no sort.
+                b = b.consolidate().with_cap(self.caps[k]).tagged(None)
+            elif i == 0:
+                b = b.with_cap(self.caps[k]).tagged(None)
+            else:
+                b = b.with_cap(self.caps[k]).tagged((self.caps[k],))
+            out.append(b)
+        return (tuple(out), base)
 
 
 def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
@@ -181,71 +309,33 @@ def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
 
 def join_levels(delta: Batch, levels: Sequence[Batch], nk: int, fn,
                 out_cap: int) -> Tuple[Batch, jnp.ndarray]:
-    """Join a delta against K trace levels into ONE shared out_cap buffer.
-
-    Each level's matches (packed at the front of its raw
-    :func:`~dbsp_tpu.operators.join._join_level_impl` output) scatter into
-    the shared buffer at the running offset, so downstream pays a single
-    out_cap-sized consolidation instead of sorting K padded buffers. The
-    returned requirement is the UNCLAMPED total across levels — when it
-    exceeds ``out_cap`` later levels' rows drop off the end and the runner's
+    """Join a delta against ALL trace levels into ONE out_cap buffer via the
+    fused trace cursor (zset/cursor.py): one probe pair over the whole
+    ladder and one cross-level expansion, where the per-level loop emitted
+    K probe kernels, K expansions, and K offset-scatters. The returned
+    requirement is the UNCLAMPED total across levels — when it exceeds
+    ``out_cap`` the tail matches drop off the end and the runner's
     validation grows the cap and replays."""
-    from dbsp_tpu.operators.join import _join_level_impl
+    from dbsp_tpu.zset import cursor
 
     assert levels, "join_levels: trace has no levels (TRACE_LEVELS >= 1)"
-    j = jnp.arange(out_cap, dtype=jnp.int32)
-    bufs, wbuf = None, None
-    offset = jnp.asarray(0, jnp.int32)
-    req = jnp.asarray(0, jnp.int64)
-    for lvl in levels:
-        out, t = _join_level_impl(delta, lvl, nk, fn, out_cap)
-        req = req + t.astype(jnp.int64)
-        t32 = jnp.minimum(t, out_cap).astype(jnp.int32)
-        idx = jnp.where(j < t32, j + offset, out_cap)  # OOB slots drop
-        if bufs is None:
-            bufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
-                         for c in out.cols)
-            wbuf = jnp.zeros((out_cap,), out.weights.dtype)
-        bufs = tuple(b.at[idx].set(c, mode="drop")
-                     for b, c in zip(bufs, out.cols))
-        wbuf = wbuf.at[idx].set(jnp.where(j < t32, out.weights, 0),
-                                mode="drop")
-        offset = jnp.minimum(offset + t32, out_cap)
-    nko = len(out.keys)
-    return Batch(bufs[:nko], bufs[nko:], wbuf), req
+    out, total = cursor.join_ladder(delta, levels, nk, fn, out_cap)
+    return out, total.astype(jnp.int64)
 
 
 def gather_levels(qkeys, qlive, levels: Sequence[Batch], out_cap: int):
-    """Gather the query keys' rows from K trace levels into ONE shared
-    (qrow, vals, w) part of capacity ``out_cap`` (same offset-scatter scheme
-    as :func:`join_levels`). Dead slots carry qrow == q_cap + sentinel vals.
-    Returns (part, unclamped total). NOTE: with K > 1 the combined part may
-    hold cross-level insert/retract rows for the same (qrow, vals) — reducers
-    must net them (``_reduce_groups_impl(..., net=True)``)."""
-    from dbsp_tpu.operators.aggregate import _gather_level_impl
+    """Gather the query keys' rows from ALL trace levels into ONE shared
+    (qrow, vals, w) part of capacity ``out_cap`` via the fused trace cursor
+    (one ladder probe pair + one cross-level expansion). Dead slots carry
+    qrow == q_cap + sentinel vals. Returns (part, unclamped total). NOTE:
+    with K > 1 the combined part may hold cross-level insert/retract rows
+    for the same (qrow, vals) — reducers must net them
+    (``_reduce_groups_impl(..., net=True)``)."""
+    from dbsp_tpu.zset import cursor
 
     assert levels, "gather_levels: trace has no levels (TRACE_LEVELS >= 1)"
-    q_cap = qlive.shape[-1]
-    j = jnp.arange(out_cap, dtype=jnp.int32)
-    qbuf = jnp.full((out_cap,), jnp.int32(q_cap))
-    vbufs, wbuf = None, None
-    offset = jnp.asarray(0, jnp.int32)
-    req = jnp.asarray(0, jnp.int64)
-    for lvl in levels:
-        qrow, vals, w, t = _gather_level_impl(qkeys, qlive, lvl, out_cap)
-        req = req + t.astype(jnp.int64)
-        t32 = jnp.minimum(t, out_cap).astype(jnp.int32)
-        idx = jnp.where(j < t32, j + offset, out_cap)
-        if vbufs is None:
-            vbufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
-                          for c in vals)
-        qbuf = qbuf.at[idx].set(qrow, mode="drop")
-        vbufs = tuple(b.at[idx].set(c, mode="drop")
-                      for b, c in zip(vbufs, vals))
-        wbuf = (jnp.zeros((out_cap,), w.dtype) if wbuf is None else wbuf
-                ).at[idx].set(jnp.where(j < t32, w, 0), mode="drop")
-        offset = jnp.minimum(offset + t32, out_cap)
-    return (qbuf, vbufs, wbuf), req
+    part, total = cursor.gather_ladder(qkeys, qlive, levels, out_cap)
+    return part, total.astype(jnp.int64)
 
 
 def trim_queries(ctx, cn: "CNode", qkeys, qlive):
@@ -363,9 +453,13 @@ class CInput(CNode):
 
 class CPure(CNode):
     """Map/filter/flat_map — the host op's kernel is already a pure
-    Batch -> Batch function; reuse it directly."""
+    Batch -> Batch function; reuse it directly. With
+    ``defer_consolidate`` (compiler placement pass) a map/flat_map skips
+    its trailing consolidation — every consumer canonicalizes anyway."""
 
     def eval(self, ctx, state, inputs):
+        if getattr(self, "defer_consolidate", False):
+            return None, self.op._inner_raw(inputs[0])
         return None, self.op._inner(inputs[0])
 
 
@@ -392,7 +486,10 @@ class CNeg(CNode):
 
 class CSumN(CNode):
     def eval(self, ctx, state, inputs):
-        return None, concat_batches(list(inputs)).consolidate()
+        cat = concat_batches(list(inputs))
+        if getattr(self, "defer_consolidate", False):
+            return None, cat
+        return None, cat.consolidate()
 
 
 class COutput(CNode):
@@ -442,7 +539,8 @@ class CTrace(CNode, _Leveled):
     def eval(self, ctx, state, inputs):
         delta = inputs[0]
         post = self._levels_append(ctx, state, delta)
-        return post, CView(delta=delta, pre=state[0], post=post[0])
+        return post, CView(delta=delta, pre=self._view_levels(state[0]),
+                           post=self._view_levels(post[0]))
 
 
 class CJoin(CNode):
@@ -473,7 +571,9 @@ class CJoin(CNode):
         rout, rtot = join_levels(right.delta, left.pre, nk, flipped,
                                  self.caps["right"])
         ctx.require(self, "right", rtot)
-        out = concat_batches([lout, rout]).consolidate()
+        out = concat_batches([lout, rout])
+        if not getattr(self, "defer_consolidate", False):
+            out = out.consolidate()
         return None, out
 
 
@@ -605,7 +705,7 @@ class CAggregate(CNode):
 
         cols, w = _diff_outputs_impl(qkeys, qlive, new_vals, new_present,
                                      old_vals, old_present)
-        out = Batch(cols[:nk], cols[nk:], w)
+        out = Batch(cols[:nk], cols[nk:], w, runs=(int(w.shape[-1]),))
         state2, required = static_append(out_trace, out)
         ctx.require(self, "out_trace", required)
         return (state2, ever_neg), out
@@ -714,17 +814,15 @@ class CTopK(CNode):
 
 
 class CDistinct(CNode):
-    """Incremental distinct over a CView (stateless given the view)."""
+    """Incremental distinct over a CView (stateless given the view); the
+    old-weight lookup probes every pre-tick level in one fused cursor."""
 
     def eval(self, ctx, state, inputs):
-        from dbsp_tpu.operators.distinct import (_distinct_delta_impl,
-                                                 _old_weights_level_impl)
+        from dbsp_tpu.operators.distinct import _distinct_delta_impl
+        from dbsp_tpu.zset import cursor
 
         view: CView = inputs[0]
-        old_w = None
-        for lvl in view.pre:
-            w = _old_weights_level_impl(view.delta, lvl)
-            old_w = w if old_w is None else old_w + w
+        old_w = cursor.old_weights_ladder(view.delta, view.pre)
         return None, _distinct_delta_impl(view.delta, old_w)
 
 
@@ -809,7 +907,10 @@ class CRangeJoin(CNode):
                          self.op._left)
         rout = self._fan(ctx, "right", right.delta, left.pre,
                          self.op._right)
-        return None, concat_batches([lout, rout]).consolidate()
+        out = concat_batches([lout, rout])
+        if not getattr(self, "defer_consolidate", False):
+            out = out.consolidate()
+        return None, out
 
 
 class CRolling(CNode):
@@ -895,7 +996,7 @@ class CRolling(CNode):
             a_cap)
         cols, w = _diff_outputs_impl((ap, at), alive, new_vals, new_present,
                                      old_vals, old_present)
-        out = Batch(cols[:2], cols[2:], w)
+        out = Batch(cols[:2], cols[2:], w, runs=(int(w.shape[-1]),))
         state2, required = static_append(state, out)
         ctx.require(self, "out_trace", required)
         return state2, out

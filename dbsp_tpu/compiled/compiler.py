@@ -224,8 +224,12 @@ def _drain_slice(receiver: Batch, source: Batch, n, cap: int):
         tuple(jnp.roll(v, -n, axis=-1) for v in source.vals),
         jnp.roll(source.weights, -n, axis=-1))
     # positions that wrapped around hold the taken prefix — dead them;
-    # rolled live rows occupy [0, live - n), already packed at the front
-    rest = rolled.masked(idx < source.cap - n)
+    # rolled live rows occupy [0, live - n), already packed at the front.
+    # The remainder IS still one consolidated run (sorted suffix, packed,
+    # sentinel tail) — tag it so the level's pytree aux stays IDENTICAL
+    # across drains; an aux flip here would retrace the whole step program
+    # on the next tick (run metadata is static data).
+    rest = rolled.masked(idx < source.cap - n).tagged((source.cap,))
     return receiver.merge_with(take).with_cap(cap), rest
 
 
@@ -245,6 +249,16 @@ class CompiledHandle:
         # exclude it from linear presize projection (instance attr shadows
         # the class-level MONOTONE_CAPS)
         for cn in self.cnodes:
+            # per-level consumers that were NOT fused over the expanded
+            # slot ladder (range joins, windows, rolling aggregates) would
+            # pay one launch per SLOT per tick — their input traces keep
+            # the legacy merged l0 instead of slotting
+            if isinstance(cn, (cnodes.CRangeJoin, cnodes.CWindow,
+                               cnodes.CRolling)):
+                for i in cn.node.inputs:
+                    tgt = self.by_index.get(i)
+                    if isinstance(tgt, cnodes.CTrace):
+                        tgt._no_slots = True
             if isinstance(cn, cnodes.CWindow) and cn.op.gc:
                 tgt = self.by_index.get(cn.node.inputs[0])
                 if isinstance(tgt, cnodes.CTrace):
@@ -257,6 +271,7 @@ class CompiledHandle:
         # map host InputHandle ops -> node indices (for feeds dicts)
         self._op_to_index = {id(n.operator): n.index for n in self.order}
         self._gen_fn = gen_fn
+        self.deferred_consolidations = self._place_consolidations()
         self.states: Dict[str, Any] = {}
         for cn in self.cnodes:
             cn.lead = (self.workers,) if self.workers > 1 else ()
@@ -298,6 +313,86 @@ class CompiledHandle:
         self.maintain_pending = False
         self._level_versions: Dict[str, List[int]] = {}
         self._snap_levels: Dict[str, List[Optional[Tuple[int, Batch]]]] = {}
+
+    # -- consolidate placement ----------------------------------------------
+    def _place_consolidations(self) -> int:
+        """Dedupe adjacent consolidations and defer them toward sinks.
+
+        A consolidation is PURELY canonicalizing: it changes a batch's
+        layout (sorted, netted, packed), never its Z-set value. When every
+        consumer of a node re-canonicalizes anyway — a general map/flat_map
+        (they consolidate after transforming, and row-wise transforms
+        commute with netting), an n-ary sum (concat + consolidate), a
+        key-hash exchange (consolidates after the all_to_all), or a host
+        output sink (reads canonicalize lazily, see :meth:`output`) — the
+        node's own trailing consolidation is dead work and is removed from
+        the traced program (``defer_consolidate``). Order-preserving
+        pass-throughs (filter, neg) inherit their consumers' requirement,
+        so a join -> filter -> map chain defers the join's sort too.
+
+        Everything stateful (traces, aggregates, distinct, plus/minus
+        merges, windows, order-preserving maps) REQUIRES consolidated
+        inputs and fences the deferral. Returns the number of deferred
+        consolidations (each counted under ``path="deferred"`` in
+        ``dbsp_tpu_zset_consolidate_total``).
+        ``DBSP_TPU_DEFER_CONSOLIDATE=0`` disables the pass (bisect knob)."""
+        import os
+
+        from dbsp_tpu.operators.filter_map import FilterOp, FlatMapOp, MapOp
+        from dbsp_tpu.zset import kernels as zkernels
+
+        if os.environ.get("DBSP_TPU_DEFER_CONSOLIDATE", "1") == "0":
+            return 0
+
+        consumers: Dict[int, List[CNode]] = {}
+        for cn in self.cnodes:
+            for i in cn.node.inputs:
+                consumers.setdefault(i, []).append(cn)
+
+        def input_need(cn: CNode) -> bool:
+            """Does ``cn`` require consolidated INPUT batches? (Consumers
+            are resolved before producers — reversed toposort — so
+            pass-through nodes may read their own ``_out_need``.)"""
+            if isinstance(cn, cnodes.COutput):
+                return False  # host reads canonicalize at the sink
+            if isinstance(cn, cnodes.CExchange):
+                return False  # consolidates after the all_to_all
+            if isinstance(cn, cnodes.CSumN):
+                # consolidates itself unless deferred — and deferral only
+                # ever happens when its own consumers don't need
+                # consolidated rows, so either way the inputs may arrive
+                # unconsolidated
+                return False
+            if isinstance(cn, cnodes.CPure):
+                op = cn.op
+                if isinstance(op, FilterOp):
+                    return getattr(cn, "_out_need", True)
+                if isinstance(op, MapOp):
+                    return op.preserves_order
+                if isinstance(op, FlatMapOp):
+                    return False
+                return True
+            if isinstance(cn, cnodes.CNeg):
+                return getattr(cn, "_out_need", True)
+            return True
+
+        deferred = 0
+        for cn in reversed(self.cnodes):
+            cons = consumers.get(cn.node.index, [])
+            cn._out_need = (not cons) or any(input_need(c) for c in cons)
+            if cn._out_need:
+                continue
+            can_defer = isinstance(
+                cn, (cnodes.CJoin, cnodes.CRangeJoin, cnodes.CSumN))
+            if isinstance(cn, cnodes.CPure) and \
+                    isinstance(cn.op, (MapOp, FlatMapOp)) and \
+                    not getattr(cn.op, "preserves_order", False):
+                can_defer = True
+            if can_defer:
+                cn.defer_consolidate = True
+                deferred += 1
+                zkernels.count_consolidate_path("deferred")
+        return deferred
 
     # -- feeds ---------------------------------------------------------------
     def _feed_indices(self, feeds: Dict) -> Dict[int, Batch]:
@@ -661,11 +756,31 @@ class CompiledHandle:
                     cache = [int(b.max_worker_live()) for b in levels]
                 lives = cache
                 req = self._req_value(cn, cn.level_keys[0])
+                due0 = lives[0]
                 if req is not None:
-                    lives[0] = req
+                    due0 = req
+                    if getattr(cn, "_slot_cap", None):
+                        # SLOTTED l0: the l0 requirement is slot CAPACITY
+                        # consumed, not rows — using it as a row count
+                        # would inflate every downstream lives[] (sparse
+                        # deltas occupy whole slots) and burn the drain
+                        # budget on phantom rows. The ROW count comes from
+                        # the TAIL requirement (base + l0 live rows) minus
+                        # the known deep lives; capacity still drives the
+                        # drain-due check (full slots must fold even when
+                        # sparsely filled).
+                        tail_req = self._req_value(cn, cn.TAIL_KEY)
+                        if tail_req is not None:
+                            lives[0] = max(0, tail_req - sum(lives[1:]))
+                        else:
+                            lives[0] = req
+                    else:
+                        lives[0] = req
                 # dispatch-free fast path: with cached lives the drain-due
                 # check is host arithmetic — most intervals touch nothing
-                if not any(lives[k] and lives[k] * 2 >= levels[k].cap
+                # (l0's due check uses its consumed CAPACITY, see above)
+                dues = [due0] + lives[1:]
+                if not any(dues[k] and dues[k] * 2 >= levels[k].cap
                            for k in range(K - 1)):
                     cn._live_cache = lives
                     continue
@@ -725,14 +840,28 @@ class CompiledHandle:
                                 return
                             n = min(n, lives[k])
                             need = lives[k + 1] + n
+                    if k == 0 and getattr(cn, "_slot_cap", None):
+                        # slotted l0: fold the per-slot sorted runs into
+                        # one consolidated batch (rank-merge regime) so
+                        # the drain merge sees its sorted-input contract;
+                        # the step program's l0 aux stays untagged, so
+                        # re-tag the emptied level after the drain
+                        slot = cn._slot_cap
+                        levels[0] = levels[0].tagged(
+                            (slot,) * (levels[0].cap // slot)).consolidate()
                     if n >= lives[k]:
                         levels[k + 1], levels[k] = _drain_pair(
                             levels[k + 1], levels[k], cn.caps[rk1])
+                        if k == 0:
+                            # the step program's l0 aux is always None
+                            levels[0] = levels[0].tagged(None)
                         stats["drains"] += 1
                     else:
                         levels[k + 1], levels[k] = _drain_slice(
                             levels[k + 1], levels[k],
                             jnp.asarray(n, jnp.int32), cn.caps[rk1])
+                        if k == 0:
+                            levels[0] = levels[0].tagged(None)
                         stats["partial_drains"] += 1
                         self.maintain_pending = True  # remainder stays due
                     vers[k] += 1
@@ -757,7 +886,8 @@ class CompiledHandle:
                 order = range(K - 1) if left is not None \
                     else range(K - 2, -1, -1)
                 for k in order:
-                    if lives[k] and lives[k] * 2 >= levels[k].cap:
+                    due = dues[0] if k == 0 else lives[k]
+                    if due and due * 2 >= levels[k].cap:
                         if k > 0 and left is not None and left <= 0:
                             self.maintain_pending = True
                             continue  # deep compaction defers; l0 may not
@@ -874,6 +1004,13 @@ class CompiledHandle:
                     cap = cn.caps[cn.level_keys[k + 1]]
                     if recv.cap != cap:
                         continue  # growth pending; shapes would not match
+                    if k == 0 and getattr(cn, "_slot_cap", None):
+                        # slotted l0 drains consolidate the slot runs
+                        # first — warm that fold program (and the drain
+                        # over its tagged result) too
+                        slot = cn._slot_cap
+                        src = _copy_tree(src).tagged(
+                            (slot,) * (src.cap // slot)).consolidate()
                     _drain_pair(_copy_tree(recv), _copy_tree(src), cap)
                     if MAINTAIN_BUDGET_ROWS:
                         _drain_slice(_copy_tree(recv), _copy_tree(src),
@@ -986,6 +1123,8 @@ class CompiledHandle:
         """Restore a snapshot (copying again — the snapshot must survive
         the restored states being donated), re-padding trace states to the
         current capacities (no-op when capacities haven't changed)."""
+        from dbsp_tpu.circuit.runtime import Runtime
+
         states = _copy_tree(dict(snap))
         # the restored buffers are new objects at possibly new capacities;
         # drop the deep-level copy cache and advance every version so a
@@ -994,14 +1133,23 @@ class CompiledHandle:
         for vers in self._level_versions.values():
             for i in range(len(vers)):
                 vers[i] += 1
-        for cn in self.cnodes:
-            key = str(cn.node.index)
-            if key in states:
-                states[key] = cn.repad_state(states[key])
-            # cached live counts may UNDER-estimate the rewound state
-            # (drains moved rows since the snapshot) — maintain() must
-            # refetch exact counts or its drain could slice live rows
-            cn._live_cache = None
+        # repad may consolidate a slotted l0 (slot geometry can change with
+        # the grown capacities) — on sharded states that is an SPMD program
+        # needing this handle's runtime
+        prev_rt = Runtime._swap(self.runtime) if self.mesh is not None \
+            else None
+        try:
+            for cn in self.cnodes:
+                key = str(cn.node.index)
+                if key in states:
+                    states[key] = cn.repad_state(states[key])
+                # cached live counts may UNDER-estimate the rewound state
+                # (drains moved rows since the snapshot) — maintain() must
+                # refetch exact counts or its drain could slice live rows
+                cn._live_cache = None
+        finally:
+            if self.mesh is not None:
+                Runtime._swap(prev_rt)
         self.states = states
 
     # -- checkpointed run -----------------------------------------------------
@@ -1086,10 +1234,43 @@ class CompiledHandle:
                 reported = t
 
     # -- host views -----------------------------------------------------------
+    def canonicalize_sink(self, b):
+        """Canonical form of a (possibly deferred) sink batch: the ONE
+        deferred-to-sink consolidation policy shared by :meth:`output` and
+        the serving driver's flush. No-op for non-batches and for batches
+        already known-canonical (1 sorted run); sharded batches
+        canonicalize per worker under this handle's runtime."""
+        if not isinstance(b, Batch) or b.sorted_runs == 1:
+            return b
+        if b.sharded:
+            from dbsp_tpu.circuit.runtime import Runtime
+
+            prev = Runtime._swap(self.runtime)
+            try:
+                return b.consolidate()
+            finally:
+                Runtime._swap(prev)
+        return b.consolidate()
+
     def output(self, handle_or_op) -> Optional[Batch]:
-        """Latest output batch for an output handle (device; un-fetched)."""
+        """Latest output batch for an output handle (device; un-fetched).
+
+        Deferred-to-sink canonicalization: when the placement pass removed
+        a consolidation from the program, the sink batch arrives as a known
+        multi-run or raw batch — canonicalize it HERE, lazily, on actual
+        read (the hot loop never reads outputs, so the work only happens
+        when a consumer exists). Already-canonical batches (1 sorted run)
+        pass through untouched, so non-deferred pipelines see the identical
+        object."""
         op = getattr(handle_or_op, "_op", handle_or_op)
-        return self.last_outputs.get(self._op_to_index[id(op)])
+        idx = self._op_to_index[id(op)]
+        b = self.last_outputs.get(idx)
+        canon = self.canonicalize_sink(b)
+        if canon is not b:
+            # cache the canonical batch so repeat reads of the same tick's
+            # output (polling HTTP clients) don't re-consolidate
+            self.last_outputs[idx] = canon
+        return canon
 
 
 def compile_circuit(handle, gen_fn: Optional[Callable] = None,

@@ -88,7 +88,8 @@ def generate_tick(cfg: GeneratorConfig, e0: jnp.ndarray, epochs: int
               (r[2] % cfg.num_state_codes).astype(jnp.int32),
               (r[3] % cfg.num_name_codes).astype(jnp.int32),
               _timestamps(cfg, n_p)),
-        weights=jnp.ones((epochs,), WEIGHT_DTYPE))
+        weights=jnp.ones((epochs,), WEIGHT_DTYPE),
+        runs=(epochs,))
 
     # -- auctions: events n = 50*ep + 1 + i, i in 0..3 -----------------------
     epa = jnp.repeat(ep, M.AUCTION_PROPORTION)
@@ -114,7 +115,8 @@ def generate_tick(cfg: GeneratorConfig, e0: jnp.ndarray, epochs: int
               price0 + (r[2] >> 16) % 10_000,
               ts,
               ts + cfg.auction_expire_min_ms + r[0] % span),
-        weights=jnp.ones((epochs * M.AUCTION_PROPORTION,), WEIGHT_DTYPE))
+        weights=jnp.ones((epochs * M.AUCTION_PROPORTION,), WEIGHT_DTYPE),
+        runs=(epochs * M.AUCTION_PROPORTION,))
 
     # -- bids: events n = 50*ep + 4 + i, i in 0..46 --------------------------
     epb = jnp.repeat(ep, M.BID_PROPORTION)

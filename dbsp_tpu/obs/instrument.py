@@ -35,6 +35,33 @@ from dbsp_tpu.obs.tracing import SpanRecorder
 _EXCHANGE_OPS = ("shard", "unshard")
 
 
+def export_consolidate_paths(registry: MetricsRegistry) -> None:
+    """Register a collector mirroring the consolidation-regime counters
+    (``zset/kernels.py::CONSOLIDATE_COUNTS``) as
+    ``dbsp_tpu_zset_consolidate_total{path=sort|rank|native|skipped|deferred}``.
+
+    The counts are PROCESS-wide dispatch decisions (eager calls count per
+    eval, traced calls once per trace, deferrals once per placement pass) —
+    they attribute WHICH consolidation regimes fire, not per-tick volume."""
+    if getattr(registry, "_consolidate_paths_exported", False):
+        return  # one mirror per registry (both instrumentations may share)
+    registry._consolidate_paths_exported = True
+    counter = registry.counter(
+        "dbsp_tpu_zset_consolidate_total",
+        "Consolidation dispatch decisions by regime (process-wide; "
+        "skipped = metadata no-op, rank = sorted-run merge fold, "
+        "native = C++ argsort, sort = lax.sort, deferred = removed by "
+        "the compiled placement pass)", labels=("path",))
+
+    def _collect() -> None:
+        from dbsp_tpu.zset import kernels as zkernels
+
+        for path, n in zkernels.CONSOLIDATE_COUNTS.items():
+            counter.labels(path=path).set_total(n)
+
+    registry.register_collector(_collect)
+
+
 def _gid_str(gid: Tuple[int, ...]) -> str:
     return ".".join(map(str, gid))
 
@@ -63,6 +90,7 @@ class CircuitInstrumentation:
         self.steps_total = registry.counter(
             "dbsp_tpu_circuit_steps_total", "Root-circuit steps evaluated")
         registry.register_collector(self._collect_graph)
+        export_consolidate_paths(registry)
         circuit.register_scheduler_event_handler(self._on_event)
         # mark exchange operators so they accumulate rows/bytes moved —
         # this costs one scalar device->host sync per exchange per tick
@@ -226,6 +254,7 @@ class CompiledInstrumentation:
             "Rows moved between trace levels by bounded maintenance")
         self._overhead_seen: Dict[str, int] = {}
         registry.register_collector(self._collect)
+        export_consolidate_paths(registry)
         if spans is not None:
             driver.spans = spans  # driver records tick/validate spans
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -252,47 +252,54 @@ def _gather_level_impl(qkeys: Tuple[jnp.ndarray, ...], qlive: jnp.ndarray,
     return qrow, vals, w, total
 
 
-_gather_level = jax.jit(_gather_level_impl, static_argnames=("out_cap",))
+def _gather_ladder_factory(out_cap: int):
+    from dbsp_tpu.zset import cursor
+
+    return lambda qk, ql, levels: cursor.gather_ladder(qk, ql, levels,
+                                                       out_cap)
 
 
-def _gather_level_factory(out_cap: int):
-    return lambda qk, ql, lvl: _gather_level_impl(qk, ql, lvl, out_cap)
+@partial(jax.jit, static_argnames=("out_cap",))
+def _gather_ladder(qkeys, qlive, levels, out_cap: int):
+    from dbsp_tpu.zset import cursor
+
+    return cursor.gather_ladder(qkeys, qlive, levels, out_cap)
 
 
 class GroupGather:
-    """Host driver: gather the full groups of the query keys across all spine
-    levels, with per-level grow-on-demand output capacities. All levels
-    launch before one batched overflow check (a single host sync per eval,
-    not one per level)."""
+    """Host driver: gather the full groups of the query keys across ALL
+    spine levels in ONE fused launch (zset/cursor.py: one probe pair over
+    the ladder, one cross-level expansion, one shared buffer with one
+    monotone capacity — the per-level loop paid K probe kernels and K
+    grow-on-demand buffers). One batched overflow sync per eval.
+
+    With several levels the fused part may hold cross-level insert/retract
+    rows for one (qrow, vals) — reducers net them
+    (``_reduce_groups(..., net=len(levels) > 1)``)."""
 
     def __init__(self):
-        self.caps: Dict[int, int] = {}
+        self.out_cap = 0  # fused ladder output capacity (monotone)
 
     @staticmethod
-    def _launch(qkeys, qlive, level, cap):
+    def _launch(qkeys, qlive, levels, cap):
         if qlive.ndim > 1:  # sharded query set
-            return lifted(_gather_level_factory, cap)(qkeys, qlive, level)
-        return _gather_level(qkeys, qlive, level, cap)
+            return lifted(_gather_ladder_factory, cap)(qkeys, qlive, levels)
+        return _gather_ladder(qkeys, qlive, levels, cap)
 
     def __call__(self, qkeys, qlive, levels: Sequence[Batch], q_cap: int):
-        """Returns a list of per-level (qrow, val_cols, w) parts, or None."""
-        parts, totals, caps = [], [], []
-        for level in levels:
-            cap = self.caps.get(level.cap, max(64, q_cap))
-            qrow, v, w, total = self._launch(qkeys, qlive, level, cap)
-            parts.append((qrow, v, w))
-            totals.append(total)
-            caps.append(cap)
-        if not parts:
+        """Returns a 1-element list holding the fused (qrow, val_cols, w)
+        part, or None for an empty ladder."""
+        if not levels:
             return None
-        for i, t in enumerate(jax.device_get(totals)):  # ONE sync for all
-            t = int(np.max(t))  # per-worker totals for sharded runs
-            if t > caps[i]:
-                cap = bucket_cap(t)
-                self.caps[levels[i].cap] = cap
-                qrow, v, w, _ = self._launch(qkeys, qlive, levels[i], cap)
-                parts[i] = (qrow, v, w)
-        return parts
+        levels = tuple(levels)
+        if not self.out_cap:
+            self.out_cap = bucket_cap(max(64, q_cap))
+        part, total = self._launch(qkeys, qlive, levels, self.out_cap)
+        t = int(np.max(jax.device_get(total)))  # ONE sync; worst worker
+        if t > self.out_cap:
+            self.out_cap = bucket_cap(t)
+            part, _ = self._launch(qkeys, qlive, levels, self.out_cap)
+        return [part]
 
 
 def concat_parts(parts):
@@ -309,7 +316,7 @@ def concat_parts(parts):
 def _reduce_groups_impl(parts, agg: Aggregator, q_cap: int,
                         net: bool | None = None):
     """Net out cross-level duplicates (each part is sorted by (qrow, vals)
-    — see :func:`_gather_level`), then run the aggregator per q segment.
+    — see :func:`_gather_level_impl`), then run the aggregator per q segment.
 
     One gathered level needs no netting (its rows are unique); multiple
     levels combine with one sort-consolidation on CPU or a fold of
@@ -347,14 +354,14 @@ _reduce_groups_jit = jax.jit(_reduce_groups_impl,
                              static_argnames=("agg", "q_cap", "net"))
 
 
-def _reduce_groups_factory(agg: Aggregator, q_cap: int):
-    return lambda parts: _reduce_groups_impl(parts, agg, q_cap)
+def _reduce_groups_factory(agg: Aggregator, q_cap: int, net=None):
+    return lambda parts: _reduce_groups_impl(parts, agg, q_cap, net)
 
 
-def _reduce_groups(parts, agg: Aggregator, q_cap: int):
+def _reduce_groups(parts, agg: Aggregator, q_cap: int, net=None):
     if parts[0][2].ndim > 1:  # sharded gather parts
-        return lifted(_reduce_groups_factory, agg, q_cap)(parts)
-    return _reduce_groups_jit(parts, agg, q_cap)
+        return lifted(_reduce_groups_factory, agg, q_cap, net)(parts)
+    return _reduce_groups_jit(parts, agg, q_cap, net)
 
 
 def _diff_outputs_impl(qkeys, qlive, new_vals, new_present, old_vals,
@@ -422,8 +429,11 @@ class AggregateOp(UnaryOperator):
                 jnp.zeros(qlive.shape, d) for d in self.agg.out_dtypes)
             new_present = jnp.zeros(qlive.shape, jnp.bool_)
         else:
-            new_vals, new_present = _reduce_groups(tuple(gathered), self.agg,
-                                                   q_cap)
+            # the fused part holds cross-level rows when the spine has
+            # several levels — net them before reducing
+            new_vals, new_present = _reduce_groups(
+                tuple(gathered), self.agg, q_cap,
+                net=len(view.spine.batches) > 1)
 
         old = self._old_gather(qkeys, qlive, self.out_spine.batches, q_cap)
         if old is None:
@@ -434,13 +444,15 @@ class AggregateOp(UnaryOperator):
             # previous outputs are single rows per key; Max over net-positive
             # rows reconstructs the value, presence from net weight
             old_vals, old_present = _reduce_groups(
-                tuple(old), _TupleMax(len(self.agg.out_dtypes)), q_cap)
+                tuple(old), _TupleMax(len(self.agg.out_dtypes)), q_cap,
+                net=len(self.out_spine.batches) > 1)
 
         cols, w = _diff_outputs(qkeys, qlive, new_vals, new_present,
                                 old_vals, old_present)
         # re-bucket to live rows: the diff has 2*q_cap capacity but few live
         # rows, and downstream operators inherit whatever cap we emit
-        out = Batch(cols[:nk], cols[nk:], w).shrink_to_fit()
+        out = Batch(cols[:nk], cols[nk:], w,
+                    runs=(int(w.shape[-1]),)).shrink_to_fit()
         self.out_spine.insert(out)
         return out
 
@@ -537,7 +549,7 @@ def stream_aggregate(self: Stream, agg: Aggregator, name=None) -> Stream:
         w = jnp.where(qlive & new_present, 1, 0).astype(jnp.int64)
         cols, w = kernels.consolidate_cols(
             (*qkeys, *(v for v in new_vals)), w)
-        return Batch(cols[:nk], cols[nk:], w)
+        return Batch(cols[:nk], cols[nk:], w, runs=(int(w.shape[-1]),))
 
     from dbsp_tpu.operators.basic import Apply
 

@@ -216,7 +216,7 @@ def _combine_diff_impl(qkeys, qlive, acc_delta, cnt_delta, old_accs, old_cnt,
         w = jnp.concatenate([jnp.where(ins_mask, 1, 0),
                              jnp.where(ret_mask, -1, 0)]).astype(jnp.int64)
         cols, w = kernels.consolidate_cols((*keys, *vals), w)
-        return Batch(cols[:nk], cols[nk:], w)
+        return Batch(cols[:nk], cols[nk:], w, runs=(int(w.shape[-1]),))
 
     out = two_sided(fin_new, fin_old,
                     new_present & changed, old_present & changed)

@@ -49,19 +49,32 @@ def _distinct_delta_impl(delta: Batch, old_w: jnp.ndarray) -> Batch:
     out_w = jnp.where(live & became, 1,
                       jnp.where(live & ceased, -1, 0)).astype(delta.weights.dtype)
     cols, w = kernels.compact(delta.cols, out_w, out_w != 0)
-    return Batch(cols[: len(delta.keys)], cols[len(delta.keys):], w)
+    # a consolidated delta's row order survives the compaction
+    runs = (delta.cap,) if delta.sorted_runs == 1 else None
+    return Batch(cols[: len(delta.keys)], cols[len(delta.keys):], w, runs)
 
 
-_old_weights_level = jax.jit(_old_weights_level_impl)
 _distinct_delta = jax.jit(_distinct_delta_impl)
-
-
-def _old_weights_factory():
-    return _old_weights_level_impl
 
 
 def _distinct_delta_factory():
     return _distinct_delta_impl
+
+
+def _distinct_ladder_impl(delta: Batch, levels) -> Batch:
+    """Fused eval: one ladder probe for the old weights across every
+    pre-tick level (zset/cursor.py), then the delta comparison."""
+    from dbsp_tpu.zset import cursor
+
+    return _distinct_delta_impl(delta,
+                                cursor.old_weights_ladder(delta, levels))
+
+
+_distinct_ladder = jax.jit(_distinct_ladder_impl)
+
+
+def _distinct_ladder_factory():
+    return _distinct_ladder_impl
 
 
 class DistinctOp(UnaryOperator):
@@ -70,16 +83,15 @@ class DistinctOp(UnaryOperator):
     def eval(self, view: TraceView) -> Batch:
         delta = view.delta
         sharded = delta.sharded
-        old_w = None
-        for level in view.pre_levels:
-            w = lifted(_old_weights_factory)(delta, level) if sharded \
-                else _old_weights_level(delta, level)
-            old_w = w if old_w is None else old_w + w
-        if old_w is None:
+        if not view.pre_levels:
             old_w = jnp.zeros_like(delta.weights)
+            if sharded:
+                return lifted(_distinct_delta_factory)(delta, old_w)
+            return _distinct_delta(delta, old_w)
+        levels = tuple(view.pre_levels)
         if sharded:
-            return lifted(_distinct_delta_factory)(delta, old_w)
-        return _distinct_delta(delta, old_w)
+            return lifted(_distinct_ladder_factory)(delta, levels)
+        return _distinct_ladder(delta, levels)
 
 
 class StreamDistinct(UnaryOperator):
@@ -92,7 +104,8 @@ class StreamDistinct(UnaryOperator):
     def _kernel(batch: Batch) -> Batch:
         w = jnp.where(batch.weights > 0, 1, 0).astype(batch.weights.dtype)
         cols, w = kernels.compact(batch.cols, w, w != 0)
-        return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w)
+        runs = (batch.cap,) if batch.sorted_runs == 1 else None
+        return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w, runs)
 
     def eval(self, batch: Batch) -> Batch:
         return self._kernel(batch)

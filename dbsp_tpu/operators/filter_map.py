@@ -87,7 +87,24 @@ class MapOp(UnaryOperator):
             cols, w = kernels.compact(cols, w, w != 0)
         else:
             cols, w = kernels.consolidate_cols((*nk, *nv), batch.weights)
-        return Batch(cols[: len(nk)], cols[len(nk):], w)
+        # both paths emit a canonical batch: one sorted run
+        return Batch(cols[: len(nk)], cols[len(nk):], w,
+                     runs=(batch.cap,))
+
+    def _inner_raw(self, batch: Batch) -> Batch:
+        """Transform WITHOUT the trailing consolidation — the compiled
+        placement pass dispatches here when every consumer canonicalizes
+        anyway (row-wise transforms commute with netting). Dead rows keep
+        sentinel cols + 0 weight; output order is unknown (runs=None)."""
+        nk, nv = self.fn(batch.keys, batch.vals)
+        nk, nv = tuple(nk), tuple(nv)
+        if self.out_schema is not None:
+            nk, nv = _pin_schema(nk, nv, self.out_schema, self.name)
+        live = batch.weights != 0
+        cols = tuple(jnp.where(live, c, kernels.sentinel_for(c.dtype))
+                     for c in (*nk, *nv))
+        return Batch(cols[: len(nk)], cols[len(nk):],
+                     jnp.where(live, batch.weights, 0))
 
     def eval(self, batch: Batch) -> Batch:
         if batch.sharded:
@@ -106,8 +123,7 @@ class FilterOp(UnaryOperator):
 
     def _inner(self, batch: Batch) -> Batch:
         keep = self.pred(batch.keys, batch.vals) & (batch.weights != 0)
-        cols, w = kernels.compact(batch.cols, batch.weights, keep)
-        return Batch(cols[: len(batch.keys)], cols[len(batch.keys):], w)
+        return batch.compacted(keep)
 
     def eval(self, batch: Batch) -> Batch:
         if batch.sharded:
@@ -144,7 +160,24 @@ class FlatMapOp(UnaryOperator):
         flat_k = tuple(c.reshape(f * cap) for c in nk)
         flat_v = tuple(c.reshape(f * cap) for c in nv)
         cols, w = kernels.consolidate_cols((*flat_k, *flat_v), w)
-        return Batch(cols[: len(flat_k)], cols[len(flat_k):], w)
+        return Batch(cols[: len(flat_k)], cols[len(flat_k):], w,
+                     runs=(f * cap,))
+
+    def _inner_raw(self, batch: Batch) -> Batch:
+        """Expansion without the trailing consolidation (see MapOp)."""
+        nk, nv, keep = self.fn(batch.keys, batch.vals)
+        nk, nv = tuple(nk), tuple(nv)
+        if self.out_schema is not None:
+            nk, nv = _pin_schema(nk, nv, self.out_schema, self.name)
+        cap = batch.cap
+        f = self.fanout
+        w = jnp.broadcast_to(batch.weights, (f, cap))
+        w = jnp.where(keep, w, 0).reshape(f * cap)
+        live = w != 0
+        cols = tuple(jnp.where(live, c.reshape(f * cap),
+                               kernels.sentinel_for(c.dtype))
+                     for c in (*nk, *nv))
+        return Batch(cols[: len(nk)], cols[len(nk):], w)
 
     def eval(self, batch: Batch) -> Batch:
         if batch.sharded:

@@ -21,7 +21,7 @@ exchange.py) and the single-worker path needs none.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -75,53 +75,58 @@ def _join_level_impl(delta: Batch, level: Batch, nk: int, fn: JoinFn,
 _join_level = jax.jit(_join_level_impl, static_argnames=("nk", "fn", "out_cap"))
 
 
-def _join_level_factory(nk: int, fn: JoinFn, out_cap: int):
-    return lambda d, l: _join_level_impl(d, l, nk, fn, out_cap)
+def _join_ladder_factory(nk: int, fn: JoinFn, out_cap: int):
+    from dbsp_tpu.zset import cursor
+
+    return lambda d, levels: cursor.join_ladder(d, levels, nk, fn, out_cap)
+
+
+@partial(jax.jit, static_argnames=("nk", "fn", "out_cap"))
+def _join_ladder(delta: Batch, levels, nk: int, fn: JoinFn, out_cap: int):
+    from dbsp_tpu.zset import cursor
+
+    return cursor.join_ladder(delta, levels, nk, fn, out_cap)
 
 
 class JoinCore:
     """Grow-on-demand driver for joining deltas against spine levels.
 
-    Keeps a per-instance output-capacity estimate (monotone, power-of-two) so
-    the common case is one kernel launch per level — the TPU answer to the
-    reference's two-pass count/alloc/fill fan-out. All levels launch before
-    the single batched overflow check (one host sync per eval, not one per
-    level).
+    One FUSED launch for the whole level ladder (zset/cursor.py): a single
+    probe pair over every level, one cross-level expansion into one shared
+    buffer with ONE monotone output capacity — where the per-level loop
+    paid K probe kernels, K output buffers with K grow-on-demand caps, and
+    a K-buffer concat for the downstream consolidate. Still exactly one
+    host sync per eval (the batched overflow check).
     """
 
     def __init__(self, nk: int, fn: JoinFn, out_schema):
         self.nk = nk
         self.fn = fn
         self.out_schema = out_schema
-        self.caps: Dict[int, int] = {}  # level bucket -> out cap
+        self.out_cap = 0  # fused ladder output capacity (monotone)
 
-    def _launch(self, delta: Batch, level: Batch, cap: int):
+    def _launch(self, delta: Batch, levels, cap: int):
         if delta.sharded:
-            return lifted(_join_level_factory, self.nk, self.fn, cap)(
-                delta, level)
-        return _join_level(delta, level, self.nk, self.fn, cap)
+            return lifted(_join_ladder_factory, self.nk, self.fn, cap)(
+                delta, levels)
+        return _join_ladder(delta, levels, self.nk, self.fn, cap)
 
     def join_levels(self, delta: Batch, levels: Sequence[Batch]
                     ) -> List[Batch]:
-        """Launch every level's join; returns RAW per-level outputs."""
-        outs: List[Batch] = []
-        totals = []
-        caps = []
-        for level in levels:
-            cap = self.caps.get(level.cap, max(64, delta.cap))
-            out, total = self._launch(delta, level, cap)
-            outs.append(out)
-            totals.append(total)
-            caps.append(cap)
-        if not outs:
+        """Launch the fused ladder join; returns the RAW combined output
+        (a 1-element list — the concat-and-consolidate call sites are
+        shared with the empty/ladder cases)."""
+        if not levels:
             return []
-        for i, t in enumerate(jax.device_get(totals)):  # ONE sync for all
-            t = int(np.max(t))  # per-worker totals for sharded runs
-            if t > caps[i]:
-                cap = bucket_cap(t)
-                self.caps[levels[i].cap] = cap
-                outs[i], _ = self._launch(delta, levels[i], cap)
-        return outs
+        levels = tuple(levels)
+        if not self.out_cap:
+            self.out_cap = bucket_cap(max(64, delta.cap))
+        out, total = self._launch(delta, levels, self.out_cap)
+        t = int(np.max(jax.device_get(total)))  # ONE sync; worst worker
+        if t > self.out_cap:
+            self.out_cap = bucket_cap(t)
+            out, _ = self._launch(delta, levels, self.out_cap)
+        return [out]
 
 
 class JoinOp(BinaryOperator):

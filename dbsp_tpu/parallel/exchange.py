@@ -92,10 +92,14 @@ def exchange_local(batch: Batch, nworkers: int) -> Batch:
         return lax.all_to_all(x, WORKER_AXIS, split_axis=0, concat_axis=0,
                               tiled=True).reshape(nworkers * batch.cap)
 
-    cols, w = kernels.consolidate_cols(
-        tuple(a2a(c) for c in binned.cols), a2a(binned.weights))
     nk = len(batch.keys)
-    return Batch(cols[:nk], cols[nk:], w)
+    cols = tuple(a2a(c) for c in binned.cols)
+    w = a2a(binned.weights)
+    # a consolidated input arrives as nworkers sorted runs (each peer's bin
+    # keeps its relative order, live-packed with a sentinel tail) — the
+    # regime dispatch folds sorted merges instead of re-sorting
+    runs = (batch.cap,) * nworkers if batch.sorted_runs == 1 else None
+    return Batch(cols[:nk], cols[nk:], w, runs).consolidate()
 
 
 def gather_local(batch: Batch) -> Batch:
@@ -106,11 +110,15 @@ def gather_local(batch: Batch) -> Batch:
     def ag(x):
         return lax.all_gather(x, WORKER_AXIS, tiled=True)
 
+    nk = len(batch.keys)
     cols = tuple(ag(c) for c in batch.cols)
     w = ag(batch.weights)
-    cols, w = kernels.consolidate_cols(cols, w)
-    nk = len(batch.keys)
-    return Batch(cols[:nk], cols[nk:], w)
+    # the gather stacks every worker's consolidated slice: W sorted runs
+    # (W read off the gathered shape — no worker count to pass or get wrong)
+    runs = None
+    if batch.sorted_runs == 1 and w.shape[-1] % batch.cap == 0:
+        runs = (batch.cap,) * (w.shape[-1] // batch.cap)
+    return Batch(cols[:nk], cols[nk:], w, runs).consolidate()
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +165,13 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
 
 def unshard_batch(sharded: Batch) -> Batch:
     """Collapse a [W, cap_local] sharded batch to one consolidated 1-D batch
-    on the host driver (output-handle boundary)."""
+    on the host driver (output-handle boundary).
+
+    Run metadata must be RE-derived: tree-mapping the reshape would carry
+    the per-worker tag onto the flattened rows, where a 1-run sharded batch
+    is really W stacked per-worker runs (which is exactly the tag that lets
+    the consolidate fold merges instead of sorting)."""
     flat = jax.tree.map(lambda a: a.reshape(-1), sharded)
-    return flat.consolidate()
+    runs = (sharded.cap,) * sharded.weights.shape[0] \
+        if sharded.sorted_runs == 1 else None
+    return flat.tagged(runs).consolidate()

@@ -68,9 +68,14 @@ def lifted_op(op):
 
 def _consolidate_factory():
     def body(b: Batch) -> Batch:
-        cols, w = kernels.consolidate_cols(b.cols, b.weights)
-        nk = len(b.keys)
-        return Batch(cols[:nk], cols[nk:], w)
+        # per-worker slice: same regime dispatch (skip/rank-fold/sort) as
+        # the single-worker path — run metadata rides the pytree aux data
+        # through shard_map
+        from dbsp_tpu.zset.batch import consolidate_regime
+
+        if b.sorted_runs == 1:
+            return b
+        return consolidate_regime(b)
 
     return body
 
@@ -80,7 +85,7 @@ def _merge_factory():
         cols, w = kernels.merge_sorted_cols(a.cols, a.weights,
                                             b.cols, b.weights)
         nk = len(a.keys)
-        return Batch(cols[:nk], cols[nk:], w)
+        return Batch(cols[:nk], cols[nk:], w, runs=(w.shape[-1],))
 
     return body
 

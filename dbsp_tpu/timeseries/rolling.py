@@ -300,7 +300,8 @@ class RollingAggregateOp(UnaryOperator):
             old_present = jnp.zeros(alive.shape, jnp.bool_)
         else:
             old_vals, old_present = _reduce_groups(
-                tuple(old), _TupleMax(len(self.agg.out_dtypes)), a_cap)
+                tuple(old), _TupleMax(len(self.agg.out_dtypes)), a_cap,
+                net=len(self.out_spine.batches) > 1)
 
         cols, w = _diff_outputs((ap, at), alive, new_vals, new_present,
                                 old_vals, old_present)

@@ -52,7 +52,9 @@ def _slice_range_impl(level: Batch, a, b, out_cap: int):
         for c in level.cols)
     w = jnp.where(valid, level.weights[idx], 0)
     nk = len(level.keys)
-    return Batch(cols[:nk], cols[nk:], w), total
+    # a contiguous slice of a consolidated level, re-packed at the front
+    # with a sentinel tail, is itself one consolidated run
+    return Batch(cols[:nk], cols[nk:], w, runs=(out_cap,)), total
 
 
 _slice_range = jax.jit(_slice_range_impl, static_argnames=("out_cap",))
@@ -66,9 +68,7 @@ def _filter_window_impl(batch: Batch, a, b) -> Batch:
     k0 = batch.keys[0]
     keep = (batch.weights != 0) & (k0 >= jnp.asarray(a, k0.dtype)) & \
         (k0 < jnp.asarray(b, k0.dtype))
-    cols, w = kernels.compact(batch.cols, batch.weights, keep)
-    nk = len(batch.keys)
-    return Batch(cols[:nk], cols[nk:], w)
+    return batch.compacted(keep)
 
 
 _filter_window = jax.jit(_filter_window_impl)

@@ -24,7 +24,8 @@ compiled shapes stays logarithmic in state size (XLA static-shape discipline).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Sequence, Tuple
+import os
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,12 @@ from dbsp_tpu.zset import kernels
 WEIGHT_DTYPE = jnp.int64
 
 Row = Tuple  # host-side row: tuple of python scalars
+
+# consolidate() folds rank/native merges over a batch's sorted runs instead
+# of sorting when it carries at most this many runs (more runs than this and
+# the fold's N-1 sequential merges lose to one O(n log n) sort; 12 covers a
+# window delta's 1 + 2*K-level slide parts at the default K=4 ladder)
+RANK_FOLD_MAX_RUNS = int(os.environ.get("DBSP_TPU_RANK_FOLD_MAX_RUNS", "12"))
 
 
 def bucket_cap(n: int, minimum: int = 8) -> int:
@@ -48,20 +55,36 @@ def bucket_cap(n: int, minimum: int = 8) -> int:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class Batch:
-    """An immutable columnar Z-set batch (possibly un-consolidated)."""
+    """An immutable columnar Z-set batch (possibly un-consolidated).
+
+    ``runs`` is STATIC sorted-run metadata: a tuple of segment lengths
+    (summing to ``cap``, along the row axis) such that each segment is
+    itself a consolidated batch slice — sorted lexicographically, no two
+    equal live rows, live rows packed at the segment front, dead sentinel
+    tail. ``None`` means unknown/unordered (the conservative default every
+    bare constructor call keeps). The metadata is what lets
+    :meth:`consolidate` dispatch by regime: a 1-run batch is already
+    canonical (no-op), few runs fold with rank/native sorted merges, and
+    only genuinely unordered data pays a full sort. It lives in the pytree
+    AUX data, so it survives jit/shard_map boundaries and distinct run
+    structures compile separately (their consolidation programs differ).
+    """
 
     keys: Tuple[jnp.ndarray, ...]
     vals: Tuple[jnp.ndarray, ...]
     weights: jnp.ndarray
+    runs: Optional[Tuple[int, ...]] = None
 
     # -- pytree plumbing ----------------------------------------------------
     def tree_flatten(self):
-        return ((self.keys, self.vals, self.weights), (len(self.keys), len(self.vals)))
+        return ((self.keys, self.vals, self.weights),
+                (len(self.keys), len(self.vals), self.runs))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         keys, vals, weights = children
-        return cls(tuple(keys), tuple(vals), weights)
+        runs = aux[2] if len(aux) > 2 else None
+        return cls(tuple(keys), tuple(vals), weights, runs)
 
     # -- basic properties ---------------------------------------------------
     # Arrays are [cap] on a single worker, or [W, cap] for a batch sharded
@@ -70,6 +93,16 @@ class Batch:
     @property
     def cap(self) -> int:
         return int(self.weights.shape[-1])
+
+    @property
+    def sorted_runs(self) -> int:
+        """Number of known sorted-consolidated runs (0 = unknown/unordered)."""
+        return len(self.runs) if self.runs is not None else 0
+
+    def tagged(self, runs: Optional[Tuple[int, ...]]) -> "Batch":
+        """Same columns with different sorted-run metadata. Callers assert
+        the invariant; :func:`check_runs` (tests) verifies it."""
+        return Batch(self.keys, self.vals, self.weights, runs)
 
     @property
     def sharded(self) -> bool:
@@ -103,7 +136,8 @@ class Batch:
         """``lead=(W,)`` builds an empty sharded batch (worker axis first)."""
         keys = tuple(kernels.sentinel_fill((*lead, cap), d) for d in key_dtypes)
         vals = tuple(kernels.sentinel_fill((*lead, cap), d) for d in val_dtypes)
-        return Batch(keys, vals, jnp.zeros((*lead, cap), weight_dtype))
+        return Batch(keys, vals, jnp.zeros((*lead, cap), weight_dtype),
+                     runs=(cap,))
 
     @staticmethod
     def from_columns(keys: Sequence[jnp.ndarray], vals: Sequence[jnp.ndarray],
@@ -119,7 +153,7 @@ class Batch:
         vals = tuple(_pad_sentinel(jnp.asarray(v), cap) for v in vals)
         w = jnp.zeros((cap,), WEIGHT_DTYPE).at[:n].set(
             jnp.asarray(weights, WEIGHT_DTYPE))
-        b = Batch(keys, vals, w)
+        b = Batch(keys, vals, w, runs=(cap,) if consolidated else None)
         return b if consolidated else b.consolidate()
 
     @staticmethod
@@ -162,29 +196,47 @@ class Batch:
 
     # -- canonicalization ---------------------------------------------------
     def consolidate(self) -> "Batch":
+        """Canonicalize, dispatching by sorted-run regime (module doc of
+        :mod:`dbsp_tpu.zset.kernels` for the path accounting):
+
+        * 1 known run — the batch IS consolidated; free by construction.
+        * few runs — fold rank/native sorted merges over the run slices
+          (no sort of the combined rows); output capacity unchanged.
+        * unknown/many runs — full sort (or native argsort) consolidation.
+
+        Every path produces the identical canonical batch (sorted unique
+        live rows packed front, netted weights, sentinel dead tail)."""
+        if self.sorted_runs == 1:
+            kernels.count_consolidate_path("skipped")
+            return self
         if self.sharded:  # canonicalize each worker slice under the mesh
             from dbsp_tpu.parallel.lift import lifted_consolidate
 
             return lifted_consolidate(self)
-        cols, w = kernels.consolidate_cols(self.cols, self.weights)
-        nk = len(self.keys)
-        return Batch(cols[:nk], cols[nk:], w)
+        return consolidate_regime(self)
 
     def compacted(self, keep: jnp.ndarray) -> "Batch":
         """Rows where ``keep`` holds, packed to the front (dead-sentinel
-        tail), same capacity; preserves sort order."""
+        tail), same capacity; preserves sort order — so a consolidated
+        (1-run) input stays consolidated. Multi-run inputs lose their
+        boundaries (segments shift arbitrarily under global packing)."""
         cols, w = kernels.compact(self.cols, self.weights, keep)
         nk = len(self.keys)
-        return Batch(cols[:nk], cols[nk:], w)
+        runs = (self.cap,) if self.sorted_runs == 1 else None
+        return Batch(cols[:nk], cols[nk:], w, runs)
 
     def masked(self, cond) -> "Batch":
         """The whole batch where ``cond`` (broadcastable) holds, dead
         (sentinel cols, zero weight) where it doesn't — the traced analog of
-        'empty until X' host logic."""
+        'empty until X' host logic. A SCALAR cond is row-uniform (identity
+        or all-dead-sentinel), so run metadata survives; a per-row cond
+        interleaves sentinel rows with live ones and breaks sortedness."""
         cols = tuple(jnp.where(cond, c, kernels.sentinel_for(c.dtype))
                      for c in self.cols)
         nk = len(self.keys)
-        return Batch(cols[:nk], cols[nk:], jnp.where(cond, self.weights, 0))
+        runs = self.runs if jnp.ndim(cond) == 0 else None
+        return Batch(cols[:nk], cols[nk:], jnp.where(cond, self.weights, 0),
+                     runs)
 
     def with_cap(self, cap: int) -> "Batch":
         """Grow or shrink row capacity (last axis). Shrinking assumes live
@@ -193,21 +245,30 @@ class Batch:
         if cap == self.cap:
             return self
         if cap > self.cap:
+            # the sentinel pad extends the LAST run (all-dead tail keeps the
+            # segment consolidated)
+            runs = (*self.runs[:-1], self.runs[-1] + cap - self.cap) \
+                if self.runs else None
             keys = tuple(_pad_sentinel(k, cap) for k in self.keys)
             vals = tuple(_pad_sentinel(v, cap) for v in self.vals)
             w = jnp.zeros((*self.weights.shape[:-1], cap),
                           self.weights.dtype).at[..., : self.cap].set(self.weights)
-            return Batch(keys, vals, w)
+            return Batch(keys, vals, w, runs)
+        runs = (cap,) if self.sorted_runs == 1 else None
         return Batch(tuple(k[..., :cap] for k in self.keys),
                      tuple(v[..., :cap] for v in self.vals),
-                     self.weights[..., :cap])
+                     self.weights[..., :cap], runs)
 
     # -- algebra (reference: crates/dbsp/src/algebra) -----------------------
     def neg(self) -> "Batch":
-        """Z-set group inverse: negate all weights."""
-        return Batch(self.keys, self.vals, -self.weights)
+        """Z-set group inverse: negate all weights (order and zero-ness are
+        untouched, so run metadata survives)."""
+        return Batch(self.keys, self.vals, -self.weights, self.runs)
 
     def scale(self, c) -> "Batch":
+        # c == 0 zeroes weights of rows still carrying live keys, which
+        # breaks the packed-live-prefix part of the run invariant for the
+        # native merge walk — drop the metadata rather than special-case it
         return Batch(self.keys, self.vals, self.weights * c)
 
     def add(self, other: "Batch") -> "Batch":
@@ -271,7 +332,35 @@ class Batch:
 def _merge_kernel(a: Batch, b: Batch) -> Batch:
     cols, w = kernels.merge_sorted_cols(a.cols, a.weights, b.cols, b.weights)
     nk = len(a.keys)
-    return Batch(cols[:nk], cols[nk:], w)
+    return Batch(cols[:nk], cols[nk:], w, runs=(w.shape[-1],))
+
+
+def consolidate_regime(batch: Batch) -> Batch:
+    """Single-worker regime dispatch behind :meth:`Batch.consolidate` (also
+    the per-worker body of the lifted sharded consolidate — arrays are 1-D
+    here). The 1-run no-op short-circuits in the caller."""
+    nk = len(batch.keys)
+    runs = batch.runs
+    if runs is not None and 2 <= len(runs) <= RANK_FOLD_MAX_RUNS:
+        kernels.count_consolidate_path("rank")
+        # fold sorted merges over the run slices, smallest runs first so
+        # each merge probes the smaller side into the accumulator
+        bounds = []
+        off = 0
+        for r in runs:
+            bounds.append((off, off + r))
+            off += r
+        parts = sorted(bounds, key=lambda se: se[1] - se[0])
+        cols = batch.cols
+        acc = tuple(c[..., parts[0][0]:parts[0][1]] for c in cols)
+        acc_w = batch.weights[..., parts[0][0]:parts[0][1]]
+        for s, e in parts[1:]:
+            acc, acc_w = kernels.merge_sorted_cols(
+                acc, acc_w, tuple(c[..., s:e] for c in cols),
+                batch.weights[..., s:e])
+        return Batch(acc[:nk], acc[nk:], acc_w, runs=(batch.cap,))
+    cols, w = kernels.consolidate_cols(batch.cols, batch.weights)
+    return Batch(cols[:nk], cols[nk:], w, runs=(batch.cap,))
 
 
 def _pad_sentinel(col: jnp.ndarray, cap: int) -> jnp.ndarray:
@@ -285,7 +374,11 @@ def _pad_sentinel(col: jnp.ndarray, cap: int) -> jnp.ndarray:
 
 def concat_batches(batches: Sequence[Batch]) -> Batch:
     """Stack batches into one (un-consolidated) batch of summed capacity
-    (row axis = last axis, so sharded batches concat per worker)."""
+    (row axis = last axis, so sharded batches concat per worker).
+
+    Sorted-run metadata concatenates: stacking consolidated inputs yields a
+    known multi-run batch, whose ``consolidate()`` folds sorted merges
+    instead of re-sorting (unknown inputs poison the result to unknown)."""
     assert batches
     first = batches[0]
     keys = tuple(
@@ -295,4 +388,10 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
         jnp.concatenate([b.vals[i] for b in batches], axis=-1)
         for i in range(len(first.vals)))
     w = jnp.concatenate([b.weights for b in batches], axis=-1)
-    return Batch(keys, vals, w)
+    runs: Optional[Tuple[int, ...]] = ()
+    for b in batches:
+        if b.runs is None:
+            runs = None
+            break
+        runs = (*runs, *b.runs)
+    return Batch(keys, vals, w, runs)

@@ -14,11 +14,35 @@ keys (max value) so that a single ascending sort moves them to the end.
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# ---------------------------------------------------------------------------
+# Consolidation-path accounting
+# ---------------------------------------------------------------------------
+
+# Dispatch decisions per consolidation regime, exported by obs as
+# ``dbsp_tpu_zset_consolidate_total{path=...}`` and reported in bench JSON.
+#   skipped  — sorted-run metadata proved the batch already consolidated
+#              (consolidate() was a no-op);
+#   rank     — few sorted runs, folded with rank/native sorted merges
+#              (no sort of the combined rows);
+#   native   — full consolidation via the C++ argsort custom call;
+#   sort     — full multi-operand ``lax.sort`` consolidation;
+#   deferred — the compiled placement pass removed the consolidation from
+#              the program entirely (its consumers canonicalize anyway).
+# Eager host-path calls count once per eval; calls under an XLA trace count
+# once per TRACE — the counter attributes which regimes fire where, not
+# per-tick kernel volume.
+CONSOLIDATE_COUNTS: Dict[str, int] = {
+    "sort": 0, "rank": 0, "native": 0, "skipped": 0, "deferred": 0}
+
+
+def count_consolidate_path(path: str) -> None:
+    CONSOLIDATE_COUNTS[path] = CONSOLIDATE_COUNTS.get(path, 0) + 1
 
 # ---------------------------------------------------------------------------
 # Sentinels
@@ -95,17 +119,22 @@ def compact(cols: Sequence[jnp.ndarray], weights: jnp.ndarray,
             keep: jnp.ndarray) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
     """Move rows with ``keep`` to the front (order preserved); rest is dead.
 
-    Equivalent of the reference's in-place ``retain`` on batch vectors, as a
-    cumsum + scatter-with-drop so the shape stays static.
+    Equivalent of the reference's in-place ``retain`` on batch vectors.
+    GATHER formulation: output slot j reads the (j+1)-th kept row, found by
+    one searchsorted over the inclusive keep-prefix-sums — a scatter
+    formulation measured ~40ns/element on XLA:CPU (scatters lower to a
+    sequential update loop; a 16k-row x 7-col filter cost ~5ms/tick), while
+    searchsorted + gathers vectorize. Bit-identical output either way.
     """
     cap = weights.shape[0]
-    dest = jnp.cumsum(keep) - 1
-    idx = jnp.where(keep, dest, cap)  # cap is out of bounds -> dropped
-    out_cols = []
-    for c in cols:
-        buf = sentinel_fill((cap,), c.dtype)
-        out_cols.append(buf.at[idx].set(c, mode="drop"))
-    w = jnp.zeros((cap,), weights.dtype).at[idx].set(weights, mode="drop")
+    csum = jnp.cumsum(keep.astype(jnp.int32))
+    total = csum[-1]
+    j = jnp.arange(cap, dtype=jnp.int32)
+    src = jnp.minimum(searchsorted1(csum, j + 1, side="left"), cap - 1)
+    valid = j < total
+    out_cols = tuple(
+        jnp.where(valid, c[src], sentinel_for(c.dtype)) for c in cols)
+    w = jnp.where(valid, weights[src], 0)
     return tuple(out_cols), w
 
 
@@ -126,7 +155,9 @@ def consolidate_cols(cols: Sequence[jnp.ndarray], weights: jnp.ndarray
         from dbsp_tpu.zset import native_merge
 
         if native_merge.supports(c.dtype for c in cols):
+            count_consolidate_path("native")
             return native_merge.consolidate_cols_native(cols, weights)
+    count_consolidate_path("sort")
     cap = weights.shape[0]
     cols, (weights,) = sort_rows(cols, (weights,))
     dup = rows_equal_prev(cols, n=cap)
@@ -245,7 +276,8 @@ def lex_searchsorted(table_cols: Tuple[jnp.ndarray, ...],
         [jnp.zeros((n,), jnp.int32), jnp.arange(m, dtype=jnp.int32)]
     )
     cols = tuple(
-        jnp.concatenate([t, q.astype(t.dtype)])
+        jnp.concatenate([t.astype(jnp.promote_types(t.dtype, q.dtype)),
+                         q.astype(jnp.promote_types(t.dtype, q.dtype))])
         for t, q in zip(table_cols, query_cols)
     )
     *_, sflags, spos = lax.sort((*cols, flags, pos), num_keys=len(cols) + 1,
@@ -260,21 +292,38 @@ def lex_searchsorted(table_cols: Tuple[jnp.ndarray, ...],
 
 def searchsorted1(table: jnp.ndarray, query: jnp.ndarray,
                   side: str = "left") -> jnp.ndarray:
-    """Single-column fast path (jnp.searchsorted lowers to a vectorized scan)."""
-    return jnp.searchsorted(table, query.astype(table.dtype), side=side
+    """Single-column searchsorted.
+
+    Both operands widen to their COMMON dtype: casting the query down to the
+    table dtype (the old behavior) silently truncates a wider query — an
+    int64 query of 2^40 against an int32 table wrapped negative and probed
+    the wrong end of the table.
+
+    (A native-FFI dispatch was tried here and measured ~25% SLOWER at the
+    q4 tick: the custom call breaks XLA fusion with the surrounding
+    expansion arithmetic and pays an int64-widening copy per operand —
+    the vectorized scan lowering stays.)"""
+    dt = jnp.promote_types(table.dtype, query.dtype)
+    return jnp.searchsorted(table.astype(dt), query.astype(dt), side=side
                             ).astype(jnp.int32)
 
 
 def _lex_le_rows(table_cols, idx, query_cols, strict: bool):
     """Per-query compare: table[idx] < query (strict) or <= query, under the
-    same total order lax.sort uses (NaN ranks greatest, NaN == NaN)."""
+    same total order lax.sort uses (NaN ranks greatest, NaN == NaN).
+
+    Both sides widen to their COMMON dtype — casting the query down to the
+    table dtype silently truncates a wider query (the same hazard class
+    :func:`searchsorted1` fixes; a no-op when dtypes already match, which
+    the schema-pinned engine paths guarantee)."""
     lt = jnp.zeros(idx.shape, jnp.bool_)
     all_eq = jnp.ones(idx.shape, jnp.bool_)
     for t, q in zip(table_cols, query_cols):
-        tv = t[idx]
-        qv = q.astype(t.dtype)
+        dt = jnp.promote_types(t.dtype, q.dtype)
+        tv = t[idx].astype(dt)
+        qv = q.astype(dt)
         col_lt = tv < qv
-        if jnp.issubdtype(t.dtype, jnp.floating):
+        if jnp.issubdtype(dt, jnp.floating):
             col_lt = col_lt | (jnp.isnan(qv) & ~jnp.isnan(tv))
         lt = lt | (all_eq & col_lt)
         all_eq = all_eq & _col_eq(tv, qv)
@@ -343,7 +392,7 @@ def expand_ranges(lo: jnp.ndarray, hi: jnp.ndarray, out_cap: int
     """
     counts = jnp.maximum(hi - lo, 0)
     starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
-    total = jnp.sum(counts)
+    total = jnp.sum(counts, dtype=jnp.int64)  # 64-bit: see expand_ladder
     j = jnp.arange(out_cap, dtype=jnp.int32)
     row = searchsorted1(starts, jnp.minimum(j, total - 1), side="right") - 1
     row = jnp.clip(row, 0, lo.shape[0] - 1)

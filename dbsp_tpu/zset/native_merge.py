@@ -35,6 +35,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+
+def _ffi_module():
+    """The XLA FFI surface for this jax version.
+
+    ``jax.ffi`` (>= 0.5) and ``jax.extend.ffi`` (0.4.35-0.4.38) expose the
+    SAME API (``ffi_call`` returning a callable, ``register_ffi_target``,
+    ``pycapsule``, ``include_dir``); only the module moved. Anything older
+    has a different registration ABI and stays gated off."""
+    if hasattr(jax, "ffi"):
+        return jax.ffi
+    try:
+        from jax.extend import ffi as xffi
+    except ImportError:
+        return None
+    # the modern API landed in jax.extend.ffi before moving to jax.ffi;
+    # require the exact entry points this module drives
+    if all(hasattr(xffi, n) for n in (
+            "ffi_call", "register_ffi_target", "pycapsule", "include_dir")):
+        return xffi
+    return None
+
+
+_FFI = _ffi_module()
+
+
+def _vma_of(x):
+    """Varying-manual-axes tag of a traced value (None before jax grew vma
+    tracking — there is nothing to re-tag on those versions)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "zset_merge.cpp")
@@ -56,15 +89,15 @@ def _build() -> str:
     global _build_error
     if _build_error is not None:
         raise RuntimeError(_build_error)
-    if not hasattr(jax, "ffi"):
-        # older jax exposes the FFI under jax.extend.ffi with a different
-        # registration ABI; gate the whole native route off rather than
-        # drive an untested bridge (kernels fall back to the XLA sort path)
-        _build_error = "jax.ffi unavailable in this jax version"
+    if _FFI is None:
+        # pre-0.4.35 jax has a different registration ABI; gate the whole
+        # native route off rather than drive an untested bridge (kernels
+        # fall back to the XLA sort path)
+        _build_error = "XLA FFI API unavailable in this jax version"
         raise RuntimeError(_build_error)
     if not os.path.exists(_SO) or (
             os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-        include = jax.ffi.include_dir()
+        include = _FFI.include_dir()
         try:
             subprocess.run(
                 ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
@@ -95,15 +128,15 @@ def _load() -> ctypes.CDLL:
             ]
             _lib = lib
         if not _registered:
-            jax.ffi.register_ffi_target(
-                FFI_TARGET, jax.ffi.pycapsule(_lib.ZsetMergeFfi),
+            _FFI.register_ffi_target(
+                FFI_TARGET, _FFI.pycapsule(_lib.ZsetMergeFfi),
                 platform="cpu")
-            jax.ffi.register_ffi_target(
-                PROBE_TARGET, jax.ffi.pycapsule(_lib.ZsetProbeFfi),
+            _FFI.register_ffi_target(
+                PROBE_TARGET, _FFI.pycapsule(_lib.ZsetProbeFfi),
                 platform="cpu")
-            jax.ffi.register_ffi_target(
+            _FFI.register_ffi_target(
                 CONSOLIDATE_TARGET,
-                jax.ffi.pycapsule(_lib.ZsetConsolidateFfi),
+                _FFI.pycapsule(_lib.ZsetConsolidateFfi),
                 platform="cpu")
             _registered = True
     return _lib
@@ -180,13 +213,13 @@ def merge_consolidated_cols(cols_a: Sequence[jnp.ndarray], w_a: jnp.ndarray,
     b64 = tuple(c.astype(jnp.int64) for c in cols_b)
     result = tuple(jax.ShapeDtypeStruct((cap,), jnp.int64)
                    for _ in range(ncols + 1))
-    out = jax.ffi.ffi_call(FFI_TARGET, result, vmap_method="sequential")(
+    out = _FFI.ffi_call(FFI_TARGET, result, vmap_method="sequential")(
         *a64, w_a.astype(jnp.int64), *b64, w_b.astype(jnp.int64),
         jnp.asarray(sentinels, jnp.int64))
     # inside a shard_map the inputs carry varying-manual-axes (vma) types;
     # custom-call results come back untagged, which breaks scan carries —
     # re-tag them to match the inputs
-    vma = getattr(jax.typeof(w_a), "vma", None)
+    vma = _vma_of(w_a)
     if vma:
         out = tuple(jax.lax.pcast(o, tuple(vma), to="varying") for o in out)
     out_cols = tuple(c.astype(d) for c, d in zip(out[:ncols], dtypes))
@@ -209,11 +242,11 @@ def consolidate_cols_native(cols: Sequence[jnp.ndarray], weights: jnp.ndarray
     c64 = tuple(c.astype(jnp.int64) for c in cols)
     result = tuple(jax.ShapeDtypeStruct((cap,), jnp.int64)
                    for _ in range(ncols + 1))
-    out = jax.ffi.ffi_call(CONSOLIDATE_TARGET, result,
-                           vmap_method="sequential")(
+    out = _FFI.ffi_call(CONSOLIDATE_TARGET, result,
+                        vmap_method="sequential")(
         *c64, weights.astype(jnp.int64),
         jnp.asarray(sentinels, jnp.int64))
-    vma = getattr(jax.typeof(weights), "vma", None)
+    vma = _vma_of(weights)
     if vma:
         out = tuple(jax.lax.pcast(o, tuple(vma), to="varying") for o in out)
     out_cols = tuple(c.astype(d) for c, d in zip(out[:ncols], dtypes))
@@ -233,11 +266,11 @@ def lex_probe_native(table_cols: Sequence[jnp.ndarray],
     q64 = tuple(c.astype(jnp.int64) for c in query_cols)
     m = q64[0].shape[-1]
     result = (jax.ShapeDtypeStruct((m,), jnp.int32),)
-    out = jax.ffi.ffi_call(PROBE_TARGET, result, vmap_method="sequential")(
+    out = _FFI.ffi_call(PROBE_TARGET, result, vmap_method="sequential")(
         *t64, *q64,
         jnp.asarray([1 if side == "right" else 0], jnp.int64))
     pos = out[0]
-    vma = getattr(jax.typeof(q64[0]), "vma", None)
+    vma = _vma_of(q64[0])
     if vma:
         pos = jax.lax.pcast(pos, tuple(vma), to="varying")
     return pos

@@ -1,0 +1,459 @@
+"""Bit-identity of the fused trace cursors and the consolidation regimes.
+
+The perf tentpole (fused ladder probes + sortedness propagation) is only
+legal because every new regime produces the IDENTICAL batches as the code
+it replaced:
+
+* ``cursor.join_ladder`` / ``cursor.gather_ladder`` /
+  ``cursor.old_weights_ladder`` vs the per-level kernel loops, on
+  adversarial ladders (duplicate rows across levels, sentinel tails,
+  zero-net weights, dead query rows);
+* ``Batch.consolidate()``'s rank-merge fold (sorted-run metadata) vs the
+  full sort path;
+* run-metadata propagation invariants under every tagging operator;
+* ``kernels.searchsorted1`` with queries WIDER than the table dtype
+  (the silent-narrowing regression);
+* the same checks per worker slice on the 8-way virtual mesh
+  (the dryrun_multichip path) via the sharded host join.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dbsp_tpu.zset import cursor, kernels
+from dbsp_tpu.zset.batch import Batch, concat_batches
+
+pytestmark = pytest.mark.fast
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _consolidated(rng, n_live, cap, nk=2, nv=1, key_range=40,
+                  allow_neg=True):
+    """A consolidated Batch with ``n_live`` random rows at capacity ``cap``
+    (duplicates collapse, so live count may come out lower)."""
+    lo = -3 if allow_neg else 1
+    rows = []
+    for _ in range(n_live):
+        key = tuple(int(rng.integers(0, key_range)) for _ in range(nk + nv))
+        w = int(rng.integers(lo, 4)) or 1
+        rows.append((key, w))
+    cols = [np.array([r[0][i] for r in rows], dtype=np.int64)
+            for i in range(nk + nv)]
+    ws = np.array([r[1] for r in rows], dtype=np.int64)
+    return Batch.from_columns(cols[:nk], cols[nk:], ws, cap=cap)
+
+
+def _batch_arrays(b: Batch):
+    return tuple(np.asarray(c) for c in (*b.cols, b.weights))
+
+
+def assert_batches_bitequal(a: Batch, b: Batch, msg=""):
+    for x, y in zip(_batch_arrays(a), _batch_arrays(b)):
+        np.testing.assert_array_equal(x, y, err_msg=msg)
+
+
+def check_runs(b: Batch, context: str = "") -> None:
+    """Verify the sorted-run metadata invariant: each tagged segment is a
+    consolidated slice (sorted lex, unique live rows, live-packed, dead
+    rows sentinel-keyed at weight 0)."""
+    if b.runs is None:
+        return
+    assert sum(b.runs) == b.cap, f"{context}: runs {b.runs} != cap {b.cap}"
+    cols = [np.asarray(c).reshape(-1, np.asarray(c).shape[-1])
+            for c in b.cols]
+    ws = np.asarray(b.weights).reshape(-1, np.asarray(b.weights).shape[-1])
+    for wslice in range(ws.shape[0]):  # per worker slice, if sharded
+        off = 0
+        for r in b.runs:
+            w = ws[wslice, off:off + r]
+            seg = [c[wslice, off:off + r] for c in cols]
+            live = w != 0
+            nlive = int(live.sum())
+            assert live[:nlive].all(), \
+                f"{context}: run at {off} not live-packed"
+            rows = list(zip(*[c[:nlive].tolist() for c in seg])) \
+                if seg else [()] * nlive
+            assert rows == sorted(rows), f"{context}: run at {off} unsorted"
+            assert len(set(rows)) == len(rows), \
+                f"{context}: duplicate live rows in run at {off}"
+            for c in seg:
+                dead = c[nlive:]
+                if dead.size:
+                    sent = np.asarray(kernels.sentinel_for(c.dtype))
+                    assert (dead == sent).all(), \
+                        f"{context}: dead rows not sentinel in run at {off}"
+            off += r
+
+
+def _ladder(rng, caps=(256, 64, 32, 16), **kw):
+    """Adversarial spine ladder: overlapping key ranges so rows repeat
+    across levels (some with cancelling weights)."""
+    return tuple(_consolidated(rng, max(2, c // 3), c, **kw) for c in caps)
+
+
+# ---------------------------------------------------------------------------
+# searchsorted1 regression (satellite): wide query vs narrow table
+# ---------------------------------------------------------------------------
+
+
+def test_searchsorted1_wide_query_not_truncated():
+    table = jnp.asarray(np.array([10, 20, 30, 40], np.int32))
+    # 2^33 + 5 truncates to 5 under an int32 cast -> would insert at 0
+    q = jnp.asarray(np.array([(1 << 33) + 5, -(1 << 33), 25], np.int64))
+    got = np.asarray(kernels.searchsorted1(table, q))
+    np.testing.assert_array_equal(got, [4, 0, 2])
+    # and the common-dtype widening keeps the narrow fast path exact
+    qs = jnp.asarray(np.array([5, 25, 45], np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(kernels.searchsorted1(table, qs)), [0, 2, 4])
+
+
+# ---------------------------------------------------------------------------
+# fused ladder probes vs per-level loops
+# ---------------------------------------------------------------------------
+
+
+def test_lex_probe_ladder_matches_per_level():
+    rng = np.random.default_rng(0)
+    levels = _ladder(rng)
+    delta = _consolidated(rng, 20, 32)
+    for side in ("left", "right"):
+        fused = np.asarray(cursor.lex_probe_ladder(
+            [lvl.keys for lvl in levels], delta.keys, side))
+        for k, lvl in enumerate(levels):
+            ref = np.asarray(kernels.lex_probe(lvl.keys, delta.keys, side))
+            np.testing.assert_array_equal(fused[k], ref, err_msg=side)
+
+
+def test_join_ladder_matches_per_level_loop():
+    from dbsp_tpu.operators.join import _join_level_impl
+
+    rng = np.random.default_rng(1)
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    for trial in range(5):
+        levels = _ladder(rng, allow_neg=trial % 2 == 0)
+        delta = _consolidated(rng, 10 + trial * 7, 64)
+        out_cap = 2048
+        fused, total = cursor.join_ladder(delta, levels, 2, fn, out_cap)
+        ref_parts, ref_total = [], 0
+        for lvl in levels:
+            part, t = _join_level_impl(delta, lvl, 2, fn, out_cap)
+            ref_parts.append(part)
+            ref_total += int(t)
+        assert int(total) == ref_total
+        assert ref_total <= out_cap, "test shapes must not overflow"
+        assert_batches_bitequal(
+            fused.consolidate(),
+            concat_batches(ref_parts).consolidate().with_cap(out_cap),
+            "fused join != per-level join")
+
+
+def test_gather_ladder_matches_per_level_loop():
+    from dbsp_tpu.operators.aggregate import _gather_level_impl
+
+    rng = np.random.default_rng(2)
+    levels = _ladder(rng)
+    delta = _consolidated(rng, 24, 32)
+    qkeys = delta.keys
+    qlive = np.asarray(delta.weights) != 0
+    qlive[-3:] = False  # some dead query rows
+    qlive = jnp.asarray(qlive)
+    out_cap = 2048
+    (qrow, vals, w), total = cursor.gather_ladder(qkeys, qlive, levels,
+                                                  out_cap)
+    ref_rows, ref_total = [], 0
+    for lvl in levels:
+        rq, rv, rw, t = _gather_level_impl(qkeys, qlive, lvl, out_cap)
+        ref_total += int(t)
+        for i in range(out_cap):
+            if int(rw[i]) != 0 or int(rq[i]) < qlive.shape[0]:
+                if int(rq[i]) < qlive.shape[0]:
+                    ref_rows.append((int(rq[i]),
+                                     tuple(int(c[i]) for c in rv),
+                                     int(rw[i])))
+    got_rows = [(int(qrow[i]), tuple(int(c[i]) for c in vals), int(w[i]))
+                for i in range(out_cap) if int(qrow[i]) < qlive.shape[0]]
+    assert int(total) == ref_total
+    assert sorted(got_rows) == sorted(ref_rows)
+
+
+def test_old_weights_ladder_matches_per_level_sum():
+    from dbsp_tpu.operators.distinct import _old_weights_level_impl
+
+    rng = np.random.default_rng(3)
+    levels = _ladder(rng, nk=1, nv=1)
+    delta = _consolidated(rng, 16, 32, nk=1, nv=1)
+    fused = np.asarray(cursor.old_weights_ladder(delta, levels))
+    ref = sum(np.asarray(_old_weights_level_impl(delta, lvl))
+              for lvl in levels)
+    np.testing.assert_array_equal(fused, ref)
+
+
+# ---------------------------------------------------------------------------
+# consolidation regimes
+# ---------------------------------------------------------------------------
+
+
+def test_rank_fold_bitidentical_to_sort():
+    rng = np.random.default_rng(4)
+    for nruns in (2, 3, 5, 8):
+        parts = [_consolidated(rng, 12, 32, key_range=10) for _ in
+                 range(nruns)]
+        # adversarial: a part that exactly cancels another
+        parts.append(parts[0].neg())
+        cat = concat_batches(parts)
+        assert cat.sorted_runs == nruns + 1
+        folded = cat.consolidate()
+        sorted_ref = cat.tagged(None).consolidate()
+        assert folded.sorted_runs == 1
+        assert_batches_bitequal(folded, sorted_ref,
+                                f"rank fold != sort ({nruns} runs)")
+        check_runs(folded, "rank fold output")
+
+
+def test_consolidate_skip_is_noop():
+    rng = np.random.default_rng(5)
+    b = _consolidated(rng, 20, 32)
+    assert b.sorted_runs == 1
+    assert b.consolidate() is b  # free by construction
+
+
+def test_consolidate_counts_paths():
+    rng = np.random.default_rng(6)
+    before = dict(kernels.CONSOLIDATE_COUNTS)
+    b = _consolidated(rng, 20, 32)
+    b.consolidate()  # skipped
+    concat_batches([b, b.neg()]).consolidate()  # rank fold
+    concat_batches([b, b]).tagged(None).consolidate()  # sort or native
+    delta = {k: v - before.get(k, 0)
+             for k, v in kernels.CONSOLIDATE_COUNTS.items()}
+    assert delta["skipped"] >= 1
+    assert delta["rank"] >= 1
+    assert delta["native"] + delta["sort"] >= 1
+
+
+def test_runs_metadata_invariants_under_operators():
+    rng = np.random.default_rng(7)
+    b = _consolidated(rng, 24, 64)
+    check_runs(b, "consolidated")
+    assert b.sorted_runs == 1
+
+    # weight ops preserve; scale drops (documented conservative choice)
+    check_runs(b.neg(), "neg")
+    assert b.neg().sorted_runs == 1
+    assert b.scale(2).sorted_runs == 0
+
+    # compaction preserves one run
+    keep = jnp.asarray(rng.integers(0, 2, b.cap).astype(bool))
+    c = b.compacted(keep & (b.weights != 0))
+    assert c.sorted_runs == 1
+    check_runs(c, "compacted")
+
+    # masked: scalar cond preserves, per-row cond drops
+    assert b.masked(jnp.asarray(True)).sorted_runs == 1
+    assert b.masked(jnp.asarray(False)).sorted_runs == 1
+    check_runs(b.masked(jnp.asarray(False)), "masked-false")
+    assert b.masked(b.weights > 0).sorted_runs == 0
+
+    # with_cap: grow extends the tail run, shrink keeps a single run
+    g = b.with_cap(128)
+    assert g.sorted_runs == 1
+    check_runs(g, "grown")
+    s = b.consolidate().shrink_to_fit()
+    assert s.sorted_runs == 1
+    check_runs(s, "shrunk")
+
+    # concat accumulates runs; unknown input poisons
+    cat = concat_batches([b, c])
+    assert cat.runs == (b.cap, c.cap)
+    check_runs(cat, "concat")
+    assert concat_batches([b, b.scale(2)]).sorted_runs == 0
+
+    # merge emits one canonical run
+    m = b.merge_with(c)
+    assert m.sorted_runs == 1
+    check_runs(m, "merged")
+
+
+def test_operator_kernels_tag_outputs():
+    """Filter / map / stream-distinct outputs carry (and honor) run tags."""
+    from dbsp_tpu.operators.distinct import StreamDistinct
+    from dbsp_tpu.operators.filter_map import FilterOp, MapOp
+
+    rng = np.random.default_rng(8)
+    b = _consolidated(rng, 24, 64, allow_neg=True)
+    f = FilterOp(lambda k, v: k[0] % 2 == 0)._inner(b)
+    assert f.sorted_runs == 1
+    check_runs(f, "filter")
+    m = MapOp(lambda k, v: ((k[0] // 3,), (v[0],)))._inner(b)
+    assert m.sorted_runs == 1
+    check_runs(m, "map")
+    d = StreamDistinct._kernel(b)
+    assert d.sorted_runs == 1
+    check_runs(d, "stream_distinct")
+    # raw (deferred) map: unordered, but canonicalizes to the same Z-set
+    raw = MapOp(lambda k, v: ((k[0] // 3,), (v[0],)))._inner_raw(b)
+    assert raw.sorted_runs == 0
+    assert raw.consolidate().to_dict() == m.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# compiled placement pass
+# ---------------------------------------------------------------------------
+
+
+def test_placement_pass_defers_join_before_canonicalizing_consumers():
+    """join -> filter -> map -> output: the join's consolidation leaves the
+    program (deferred); outputs stay identical to the host path."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import cnodes, compile_circuit
+    from dbsp_tpu.nexmark import GeneratorConfig, NexmarkGenerator, \
+        build_inputs, device_gen, queries
+
+    cfg = GeneratorConfig(seed=5)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * 20, 20)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    assert ch.deferred_consolidations >= 1
+    joins = [cn for cn in ch.cnodes if isinstance(cn, cnodes.CJoin)]
+    assert joins and all(getattr(cn, "defer_consolidate", False)
+                         for cn in joins)
+
+    outs = {}
+
+    def capture(next_tick):
+        b = ch.output(out)
+        outs[next_tick - 1] = b.to_dict() if b is not None else {}
+
+    ch.run_ticks(0, 3, validate_every=1, on_validated=capture)
+
+    gen = NexmarkGenerator(cfg)
+    handle2, (handles2, out2) = Runtime.init_circuit(1, build)
+    n = 0
+    for t in range(3):
+        gen.feed(handles2, n, n + 1000)
+        handle2.step()
+        b = out2.take()
+        assert outs[t] == (b.to_dict() if b is not None else {}), \
+            f"tick {t} diverged under deferred consolidation"
+        n += 1000
+
+
+def test_placement_pass_keeps_consolidation_before_stateful_consumers():
+    """join -> distinct (via trace): the join output feeds a spine insert,
+    so its consolidation must NOT defer (q8 shape)."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import cnodes, compile_circuit
+    from dbsp_tpu.nexmark import GeneratorConfig, build_inputs, device_gen, \
+        queries
+
+    cfg = GeneratorConfig(seed=6)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q8(*streams).output()
+
+    handle, _ = Runtime.init_circuit(1, build)
+    h = compile_circuit(handle, gen_fn=None)
+    joins = [cn for cn in h.cnodes if isinstance(cn, cnodes.CJoin)]
+    assert joins and not any(getattr(cn, "defer_consolidate", False)
+                             for cn in joins)
+
+
+def test_slotted_l0_survives_varying_delta_capacity():
+    """Regression: the slotted level-0 geometry is PINNED per trace. A tick
+    whose delta capacity differs from the pin (feeds mode buckets each
+    tick's rows independently) must not reinterpret existing slots at a
+    new slot size — distinct would silently re-emit rows already present."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import compile_circuit
+    from dbsp_tpu.operators import add_input_zset
+
+    def run(pad_tick2: int):
+        def build(c):
+            s, h = add_input_zset(c, (jnp.int64,), ())
+            return h, s.distinct().output()
+
+        handle, (t, out) = Runtime.init_circuit(1, build)
+        ch = compile_circuit(handle)
+        feeds = [
+            [((k,), 1) for k in range(10, 16)],           # cap 8
+            [((k,), 1) for k in range(0, 6)],             # cap 8
+            # tick 2 re-feeds 10..15 among enough rows to force a BIGGER
+            # delta capacity (retrace) — distinct must emit only the new
+            [((k,), 1) for k in range(100, 100 + pad_tick2)] +
+            [((k,), 1) for k in range(10, 16)],
+        ]
+        outs = []
+        for tick, rows in enumerate(feeds):
+            b = Batch.from_tuples(rows, [jnp.int64], [])
+            ch.step(tick=tick, feeds={t: b})
+            ch.validate()
+            ch.maintain()
+            o = ch.output(out)
+            outs.append(o.to_dict() if o is not None else {})
+        return outs
+
+    grown = run(pad_tick2=20)    # tick-2 cap 32 != pinned slot 8
+    stable = run(pad_tick2=2)    # tick-2 cap 8 == pinned slot
+    for k in range(10, 16):
+        assert (k,) not in grown[2], \
+            f"distinct re-emitted {(k,)} after a delta-capacity change"
+        assert (k,) not in stable[2]
+    assert all((k,) in grown[2] for k in range(100, 120))
+
+
+# ---------------------------------------------------------------------------
+# 8-way mesh (the dryrun_multichip path): fused cursors per worker slice
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sharded_host_join_fused_ladder_8_equals_1():
+    """The sharded host join (lifted fused ladder) over 8 virtual workers
+    equals the single-worker evaluation — exchange + per-worker fused
+    probes + output union, through the public Stream API."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.nexmark import GeneratorConfig, NexmarkGenerator, \
+        build_inputs, queries
+
+    def run(workers):
+        gen = NexmarkGenerator(GeneratorConfig(seed=9))
+
+        def build(c):
+            streams, handles = build_inputs(c)
+            return handles, queries.q4(*streams).output()
+
+        handle, (handles, out) = Runtime.init_circuit(workers, build)
+        integral = {}
+        n = 0
+        for _ in range(2):
+            gen.feed(handles, n, n + 1200)
+            handle.step()
+            b = out.take()
+            if b is not None:
+                for r, w in b.to_dict().items():
+                    integral[r] = integral.get(r, 0) + w
+                    if integral[r] == 0:
+                        del integral[r]
+            n += 1200
+        return integral
+
+    want = run(1)
+    assert want and run(8) == want

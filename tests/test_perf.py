@@ -110,3 +110,38 @@ def test_throughput_within_band(qname):
         "BETWEEN-tick host work (validate/maintain/snapshot), which p50 "
         "cannot see. If deliberate, re-record with tools/record_perf.py "
         "and say so in the commit.")
+
+
+# Per-kernel floor band: wider than the query band — single kernels at
+# microbench shapes have more scheduler/cache jitter than a 16-tick run.
+KERNEL_BAND = float(os.environ.get("PERF_KERNEL_BAND", 2 * PERF_BAND))
+
+
+def test_kernel_microbench_floor():
+    """Coarse per-kernel floor (tools/microbench_kernels.py): a kernel that
+    got KERNEL_BAND-times slower than its recorded baseline fails here
+    with the kernel named — a query-level regression then starts from a
+    suspect instead of a bisect. Recorded by tools/record_perf.py."""
+    base = _baseline().get("kernels")
+    if not base:
+        pytest.skip("perf_baseline.json has no kernels section — record "
+                    "with `python tools/record_perf.py`")
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import microbench_kernels
+
+    got = microbench_kernels.run(reps=3)
+    slow = []
+    for name, rec in base.items():
+        if name == "meta" or name not in got:
+            continue
+        ceiling = rec["ms"] * KERNEL_BAND
+        if got[name]["ms"] > ceiling:
+            slow.append(f"{name}: {got[name]['ms']:.2f}ms vs recorded "
+                        f"{rec['ms']:.2f}ms (ceiling {ceiling:.2f}ms)")
+    assert not slow, (
+        "kernel microbench regressed (band "
+        f"{KERNEL_BAND}x): {'; '.join(slow)}. If deliberate, re-record "
+        "with tools/record_perf.py and say so in the commit.")

@@ -38,6 +38,13 @@ tests/test_analysis.py, and bundled into tools/lint_all.py):
    depth-1 pipeline wait on tick t-1) carries a ``# hotpath: ok`` waiver
    stating why.
 
+Related hot-path discipline this lint does NOT need to police:
+``Batch.consolidate()`` on an already-consolidated batch is free BY
+CONSTRUCTION since the sorted-run metadata landed (zset/batch.py — a
+1-run batch returns ``self``, counted as ``path="skipped"`` in
+``dbsp_tpu_zset_consolidate_total``), so defensive consolidate calls on
+canonical batches cost nothing and need no waiver or caller-side guard.
+
 Usage: ``python tools/check_hotpath.py [root]`` — prints violations and
 exits 1 when any are found.
 """

@@ -1,0 +1,153 @@
+"""Per-kernel microbenchmark of the engine's hot Z-set kernels.
+
+Times each kernel the roofline model reasons about (tools/roofline.py §1),
+at the SAME q4-steady-state shapes, on the active backend — the measured
+complement of the analytic cost table: when a bench regression appears,
+this pins it to a kernel instead of a query.
+
+Kernels & shapes (ROOFLINE §1):
+  * consolidate      — full consolidation of an unsorted run, 16k x 6 cols
+                       (dispatches native argsort / lax.sort per backend);
+  * rank_fold        — consolidate() of 4 stacked sorted runs (the
+                       sorted-run regime), 4 x 16k x 6 cols;
+  * lex_probe        — 16k queries x 1M-row 2-col sorted table;
+  * lex_probe_ladder — the same queries fused over a 4-level ladder
+                       (1M/256k/64k/16k rows — zset/cursor.py);
+  * merge_sorted_cols— spine tail-class merge, 1M + 64k rows x 7 cols;
+  * expand_ranges    — 16k ranges expanded into a 64k slot buffer.
+
+Run:  python tools/microbench_kernels.py            (JSON to stdout)
+      python tools/microbench_kernels.py --reps 9   (more samples)
+
+Output: one JSON object {kernel: {shape, ms, ...}, meta: {...}} — consumed
+by tools/record_perf.py (which records the floors tests/test_perf.py
+gates on) and by humans bisecting a bench regression (README §Performance).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _force_cpu() -> None:
+    """CLI runs pin the CPU backend (recordings must match the backend the
+    perf gate measures on). Import-time mutation would flip the platform
+    under an already-initialized pytest session — main() only."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _cols(n, k, sort_first=True, seed=0):
+    rng = np.random.default_rng(seed)
+    first = np.sort(rng.integers(0, 1 << 40, n)) if sort_first else \
+        rng.integers(0, 1 << 40, n)
+    cols = [jnp.asarray(first)]
+    for _ in range(k - 1):
+        cols.append(jnp.asarray(rng.integers(0, 1000, n)))
+    return tuple(cols)
+
+
+def _time(fn, *args, reps: int = 5) -> float:
+    """Median wall ms of a jitted call (compile excluded by a warmup call)."""
+    jitted = jax.jit(fn)
+    jax.block_until_ready(jitted(*args))  # compile + warm
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jitted(*args))
+        samples.append((time.perf_counter() - t0) * 1e3)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def run(reps: int = 5) -> dict:
+    from dbsp_tpu.zset import cursor, kernels
+    from dbsp_tpu.zset.batch import Batch, concat_batches
+
+    out: dict = {}
+
+    # 1) full consolidation of an unsorted run (every operator output)
+    n, k6 = 16_384, 6
+    cols = _cols(n, k6, sort_first=False, seed=4)
+    w = jnp.ones((n,), jnp.int64)
+    out["consolidate"] = {
+        "shape": f"{n} rows x {k6} cols (unsorted)",
+        "strategy": kernels.merge_strategy(),
+        "ms": _time(kernels.consolidate_cols, cols, w, reps=reps)}
+
+    # 2) sorted-run regime: consolidate() of 4 stacked consolidated runs
+    def _consolidated(seed):
+        c, ww = kernels.consolidate_cols(
+            _cols(n, k6, sort_first=False, seed=seed),
+            jnp.ones((n,), jnp.int64))
+        return Batch(c[:1], c[1:], ww, runs=(n,))
+
+    stacked = concat_batches([_consolidated(s) for s in range(4)])
+    out["rank_fold"] = {
+        "shape": f"4 runs x {n} rows x {k6} cols",
+        "ms": _time(lambda b: b.consolidate(), stacked, reps=reps)}
+
+    # 3) trace probe: delta keys into the tail (binary search)
+    big = 1_048_576
+    q = 16_384
+    table2 = _cols(big, 2, seed=3)
+    query2 = _cols(q, 2, seed=2)
+    out["lex_probe"] = {
+        "shape": f"{q} queries x {big} rows x 2 cols",
+        "ms": _time(lambda t, qq: kernels.lex_probe(t, qq), table2, query2,
+                    reps=reps)}
+
+    # 4) the same probe fused over a 4-level ladder (K geometric levels)
+    ladder = [table2] + [_cols(big >> (2 * i), 2, seed=6 + i)
+                         for i in (1, 2, 3)]
+    out["lex_probe_ladder"] = {
+        "shape": f"{q} queries x 4 levels ({big}..{big >> 6} rows)",
+        "ms": _time(lambda tabs, qq: cursor.lex_probe_ladder(tabs, qq),
+                    tuple(ladder), query2, reps=reps)}
+
+    # 5) spine tail-class sorted merge
+    na, nb, k7 = 1_048_576, 65_536, 7
+    a, b = _cols(na, k7), _cols(nb, k7, seed=1)
+    wa = jnp.ones((na,), jnp.int64)
+    wb = jnp.ones((nb,), jnp.int64)
+    out["merge_sorted_cols"] = {
+        "shape": f"{na}+{nb} rows x {k7} cols",
+        "strategy": kernels.merge_strategy(),
+        "ms": _time(kernels.merge_sorted_cols, a, wa, b, wb, reps=reps)}
+
+    # 6) range expansion (join fan-out allocation)
+    rng = np.random.default_rng(9)
+    lo = jnp.asarray(np.sort(rng.integers(0, big - 8, q)).astype(np.int32))
+    hi = lo + jnp.asarray(rng.integers(0, 4, q).astype(np.int32))
+    out["expand_ranges"] = {
+        "shape": f"{q} ranges -> 65536 slots",
+        "ms": _time(lambda l, h: kernels.expand_ranges(l, h, 65_536),
+                    lo, hi, reps=reps)}
+
+    out["meta"] = {"backend": jax.default_backend(),
+                   "strategy": kernels.merge_strategy(), "reps": reps}
+    return out
+
+
+def main() -> None:
+    _force_cpu()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+    print(json.dumps(run(reps=args.reps), indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

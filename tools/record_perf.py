@@ -30,6 +30,17 @@ def main() -> None:
     for q in ("q3", "q4", "q8"):
         out[q] = measure_query(q)
         print(q, out[q], flush=True)
+
+    # per-kernel floors (tools/microbench_kernels.py; gated by
+    # test_perf.test_kernel_microbench_floor)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import microbench_kernels
+
+    kernels = microbench_kernels.run(reps=5)
+    out["kernels"] = {k: {"ms": round(v["ms"], 3), "shape": v["shape"]}
+                      for k, v in kernels.items() if k != "meta"}
+    print("kernels", out["kernels"], flush=True)
+
     with open(BASELINE_PATH, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
         f.write("\n")
