@@ -5,7 +5,11 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...detail}
 and ALWAYS exits 0 — on any failure (wedged TPU tunnel, backend init crash,
 mid-run exception) it still emits the line, with the error in "detail" and
 whatever partial measurement exists. The driver's capture must never come
-back empty.
+back empty. ONE deliberate exception: ``--slo`` (or env BENCH_SLO=1)
+evaluates the obs SLO watchdog (dbsp_tpu.obs.slo, env-configured via
+DBSP_TPU_SLO_*) over each query's flight-recorded tick stream, embeds an
+"slo" summary (status/breaches/incidents) in the JSON, and exits NONZERO
+on any breach — the CI gate form of the serving stack's watchdog.
 
 Protocol (BASELINE.md): the reference measures elapsed wall-clock ->
 events/sec on Nexmark; its CI config streams 100M events at a 10M/s
@@ -53,7 +57,9 @@ at the CPU batch — 2_000_000 on TPU), BENCH_BATCH (events/tick, default
 7_500 on CPU / 100_000 on TPU), BENCH_QUERIES, BENCH_QUERY (headline
 override), BENCH_WARM_TICKS (default 4), BENCH_PLATFORM (cpu|tpu|probe,
 default probe), BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE
-(compiled|host), BENCH_VALIDATE_EVERY (default 8).
+(compiled|host), BENCH_VALIDATE_EVERY (default 8), BENCH_SLO / --slo (SLO
+gate; thresholds from DBSP_TPU_SLO_P99_TICK_MS / _TICK_P50_MULTIPLE /
+_WATERMARK_LAG / _OVERFLOW_REPLAYS).
 """
 
 import json
@@ -216,7 +222,7 @@ def _supervise() -> int:
     if line and measured(parsed):
         print(line)
         sys.stdout.flush()
-        return 0
+        return _slo_exit_code(parsed)
     if line and parsed is None:
         notes.append(f"accel: unparseable line {line[:160]!r}")
     if parsed and parsed.get("detail", {}).get("error"):
@@ -244,7 +250,7 @@ def _supervise() -> int:
                 d["cpu_fallback_value"] = cpu_parsed.get("value")
             print(json.dumps(parsed))
             sys.stdout.flush()
-            return 0
+            return _slo_exit_code(parsed)
         if line and parsed is None:
             # child produced output that fails to parse: surface the raw
             # line in the notes instead of dropping it silently
@@ -255,18 +261,54 @@ def _supervise() -> int:
     if cpu_line:
         print(cpu_line)
         sys.stdout.flush()
-        return 0
+        return _slo_exit_code(cpu_line)
     if partial_accel:
         # a crashed-mid-run accel measurement still beats a synthetic zero
         print(partial_accel)
         sys.stdout.flush()
-        return 0
+        return _slo_exit_code(partial_accel)
     # no child produced a line — emit one here so the driver never sees
     # empty output
     qname = os.environ.get("BENCH_QUERY", "q4")
     _emit(f"nexmark_{qname}_throughput", 0.0,
           {"error": "all backend attempts failed", "attempts": notes})
     return 0
+
+
+def _eval_slo(rec) -> dict:
+    """Evaluate the env-configured SLOs (DBSP_TPU_SLO_*) over a flight
+    recorder's event stream; returns the embeddable summary."""
+    from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
+
+    wd = SLOWatchdog(rec, SLOConfig.from_env())
+    wd.evaluate()
+    incs = wd.incidents(with_window=False)
+    return {"status": wd.status(), "breaches": len(incs),
+            "config": wd.config.enabled(),
+            "incidents": [{k: i[k] for k in ("slo", "cause", "causes",
+                                             "observed", "threshold",
+                                             "breach_count")}
+                          for i in incs]}
+
+
+def _slo_exit_code(obj) -> int:
+    """Nonzero when --slo/BENCH_SLO is armed and any query breached.
+    ``obj`` is the emitted JSON object (or its line)."""
+    if not os.environ.get("BENCH_SLO"):
+        return 0
+    try:
+        if isinstance(obj, (str, bytes)):
+            obj = json.loads(obj)
+    except ValueError:
+        return 0
+    d = (obj or {}).get("detail", {})
+    qs = d.get("queries")
+    if qs:  # per-query summaries (the headline copy would double count)
+        n = sum((q.get("slo") or {}).get("breaches", 0)
+                for q in qs.values())
+    else:
+        n = (d.get("slo") or {}).get("breaches", 0)
+    return 1 if n else 0
 
 
 def _knobs(platform: str):
@@ -435,19 +477,26 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         # Tail attribution: a spike (> 3x p50) tick is explained by the
         # causes the handle annotated against its sample index (maintain
         # drain / snapshot copy / program retrace) — BENCH_r06 can show
-        # the tail is attributed, not guessed. Raw samples are CHUNK times
-        # in scan mode while p50_ns is per-tick: scale the threshold back
-        # to chunk units there.
-        ann: dict = {}
-        for idx, cause in ch.tick_causes:
-            ann.setdefault(idx, set()).add(cause)
+        # the tail is attributed, not guessed. The bookkeeping is the
+        # flight recorder's (dbsp_tpu.obs.flight — the same machinery the
+        # serving stack's /flight and /incidents run on), not a private
+        # copy. Raw samples are CHUNK times in scan mode while p50_ns is
+        # per-tick: scale the threshold back to chunk units there.
+        from dbsp_tpu.obs.flight import (CompiledFlightSource,
+                                         FlightRecorder, spike_causes)
+
+        # one poll emits ticks PLUS every phase sample (validate/maintain/
+        # snapshot), replay, maintain, and consolidate event — size the
+        # ring for all of them or the deque evicts the earliest ticks
+        # before spike_causes/_eval_slo read them
+        n_phase = sum(len(v) for v in ch.host_overhead_ns.values())
+        rec = FlightRecorder(capacity=2 * (len(samples) + n_phase) + 256)
+        CompiledFlightSource(ch, rec).poll()
         spike_ns = 3 * p50_ns * (validate_every if scan else 1)
-        spike_causes: dict = {}
-        for i, s in enumerate(samples):
-            if s > spike_ns:
-                for cause in (ann.get(i) or {"unattributed"}):
-                    spike_causes[cause] = spike_causes.get(cause, 0) + 1
-        detail["spike_causes"] = spike_causes
+        detail["spike_causes"] = spike_causes(
+            rec.events(kinds=("tick",)), spike_ns)
+        if os.environ.get("BENCH_SLO"):
+            detail["slo"] = _eval_slo(rec)
         detail["host_overhead_ms"] = {
             phase: round(sum(v) / 1e6, 2)
             for phase, v in ch.host_overhead_ns.items()}
@@ -573,6 +622,13 @@ def run(platform: str, detail: dict) -> float:
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] / 1e6
     detail.update(elapsed_s=round(elapsed, 3), p50_step_ms=round(p50, 2),
                   p99_step_ms=round(p99, 2), ticks=len(lat))
+    if os.environ.get("BENCH_SLO"):
+        # host path has no cause annotations; the latency SLOs still apply
+        from dbsp_tpu.obs.flight import FlightRecorder, ticks_from_samples
+
+        rec = FlightRecorder(capacity=2 * len(handle.step_times_ns) + 64)
+        ticks_from_samples(rec, handle.step_times_ns)
+        detail["slo"] = _eval_slo(rec)
     return eps
 
 
@@ -603,6 +659,8 @@ def _child_platform() -> tuple[str, dict]:
 
 
 def main() -> int:
+    if "--slo" in sys.argv:  # env form so child processes inherit it
+        os.environ["BENCH_SLO"] = "1"
     inline_cpu = (os.environ.get("BENCH_PLATFORM") == "cpu" or
                   "xla_force_host_platform_device_count"
                   in os.environ.get("XLA_FLAGS", ""))
@@ -622,7 +680,8 @@ def main() -> int:
         partial = detail.get("events", 0) / detail["elapsed_s"] \
             if detail.get("elapsed_s") else 0.0
         _emit(metric, partial, detail)
-    return 0
+        return 0
+    return _slo_exit_code({"detail": detail})
 
 
 if __name__ == "__main__":

@@ -91,6 +91,10 @@ class CircuitHandle:
     driving thread, synchronously.
     """
 
+    # execution-surface tag (CompiledCircuitDriver says "compiled"); the
+    # server's /status and the manager's describe() report it
+    mode = "host"
+
     def __init__(self, circuit: Circuit, runtime: Runtime):
         self.circuit = circuit
         self.runtime = runtime

@@ -47,6 +47,29 @@ class PipelineHandle:
         see README §Observability)."""
         return _req(self.base + "/trace")
 
+    def mode(self) -> str:
+        """Execution surface this pipeline runs on: ``compiled`` (one XLA
+        program per tick) or ``host`` (the per-operator scheduler — check
+        :meth:`status`'s ``fallback_reason`` when this says host for a
+        pipeline you expected to compile)."""
+        return self.status()["mode"]
+
+    def flight(self, n: Optional[int] = None) -> dict:
+        """The pipeline's flight-recorder ring (README §Observability):
+        {"capacity", "dropped", "events": [...]} — per-tick latency with
+        cause, host phases, drains, replays, fallbacks. ``n`` caps to the
+        most recent events."""
+        q = f"?n={n}" if n is not None else ""
+        return _req(f"{self.base}/flight{q}")
+
+    def incidents(self, with_window: bool = True) -> dict:
+        """SLO status + captured incidents: {"status": {...},
+        "incidents": [{slo, cause, observed, threshold, window, trace,
+        ...}]}. ``with_window=False`` drops the frozen event windows and
+        trace slices (summaries only)."""
+        q = "" if with_window else "?window=0"
+        return _req(f"{self.base}/incidents{q}")
+
     def profile(self) -> dict:
         return _req(self.base + "/dump_profile")
 
@@ -169,6 +192,11 @@ class Connection:
         registry under a ``pipeline="<name>"`` label."""
         with urllib.request.urlopen(self.base + "/metrics", timeout=30) as r:
             return r.read().decode()
+
+    def health(self) -> dict:
+        """Fleet health: worst per-pipeline SLO state plus per-pipeline
+        {health, status, mode, fallback_reason} detail."""
+        return _req(self.base + "/health")
 
     def shutdown_pipeline(self, name: str) -> None:
         _req(f"{self.base}/pipelines/{name}/shutdown", data=b"",

@@ -35,6 +35,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 from dbsp_tpu.compiled.compiler import (CompiledHandle, CompiledOverflow,
@@ -119,7 +120,13 @@ class CompiledCircuitDriver:
         if spans is not None:
             spans.begin(f"tick[{self._tick}]", cat="step")
         if not self._retained:
-            self._snap = self.ch.snapshot()  # interval-start checkpoint
+            # interval-start checkpoint; timed into host_overhead_ns like
+            # run_ticks does, so serving pipelines feed the same phase
+            # observability (obs histogram + flight recorder) as bench runs
+            h0 = time.perf_counter_ns()
+            self._snap = self.ch.snapshot()
+            self.ch.host_overhead_ns["snapshot"].append(
+                time.perf_counter_ns() - h0)
         self._retained.append((self._tick, feeds))
         with (spans.span("compiled_step", cat="compiled") if spans
               is not None else contextlib.nullcontext()):
@@ -138,6 +145,7 @@ class CompiledCircuitDriver:
         retained feeds from the interval-start snapshot (exact); then run
         a bounded maintenance slice and deliver outputs in tick order."""
         spans = self.spans
+        h0 = time.perf_counter_ns()
         while True:
             try:
                 self.ch.validate()
@@ -152,7 +160,12 @@ class CompiledCircuitDriver:
                 for tick, feeds in self._retained:
                     self.ch.step(tick=tick, feeds=feeds)
                     self._out_buffer.append(dict(self.ch.last_outputs))
+        self.ch.host_overhead_ns["validate"].append(
+            time.perf_counter_ns() - h0)
+        h0 = time.perf_counter_ns()
         self.ch.maintain()  # spine drains; dispatch-free when nothing due
+        self.ch.host_overhead_ns["maintain"].append(
+            time.perf_counter_ns() - h0)
         for outputs in self._out_buffer:
             for idx, out_op in self._outputs:
                 batch = outputs.get(idx)
@@ -180,7 +193,7 @@ class CompiledCircuitDriver:
             self._flush()
 
 
-def try_compiled_driver(handle, registry=None, verified=False):
+def try_compiled_driver(handle, registry=None, verified=False, flight=None):
     """Compile the circuit if every operator has a compiled equivalent;
     None when it must stay on the host-driven path (the caller records
     which mode the pipeline runs — facade.rs's feature gate).
@@ -192,7 +205,13 @@ def try_compiled_driver(handle, registry=None, verified=False):
     pipeline, an unexpected compile error must degrade to the host
     scheduler that previously ran the circuit, not kill the deploy. The
     failure is logged and, when ``registry`` (obs.MetricsRegistry) is
-    given, counted as ``dbsp_tpu_compiled_fallback_total{reason=...}``."""
+    given, counted as ``dbsp_tpu_compiled_fallback_total{reason=...}``.
+
+    ``flight`` (obs.FlightRecorder) additionally records the fallback as a
+    structured event carrying the reason AND its human-readable detail —
+    the host fallback is an order-of-magnitude perf cliff, so it must be
+    SLO-visible (the watchdog latches it into a degraded state and an
+    incident), not just a counter a dashboard may or may not chart."""
     from dbsp_tpu.analysis import AnalysisError
 
     try:
@@ -218,4 +237,6 @@ def try_compiled_driver(handle, registry=None, verified=False):
                 "Circuits that failed to compile and fell back to the "
                 "host-driven path", labels=("reason",)).labels(
                     reason=reason).inc()
+        if flight is not None:
+            flight.record("fallback", reason=reason, detail=str(e)[:200])
         return None

@@ -7,7 +7,12 @@ program (SQL views over declared tables), request compiles and watch the
 state machine, delete programs/pipelines (conflict errors surface inline),
 start/stop pipelines, push rows into a running pipeline's input
 collections, and peek output views — all over the existing REST surfaces
-(manager + per-pipeline circuit servers)."""
+(manager + per-pipeline circuit servers). Each pipeline row also renders
+its execution mode (``host`` rows carry the recorded compiled->host
+fallback reason as a tooltip), its SLO health (ok/degraded/unhealthy from
+the flight-recorder watchdog, obs/slo.py), and the latest incident's
+attributed cause; the Incidents/Flight buttons fetch the corresponding
+pipeline-server routes."""
 
 CONSOLE_HTML = r"""<!doctype html>
 <html>
@@ -38,6 +43,8 @@ CONSOLE_HTML = r"""<!doctype html>
            text-align: left; }
   .status-running { color: #9ece6a; } .status-failed { color: #f7768e; }
   .status-stopped { color: #9aa5b1; }
+  .health-ok { color: #9ece6a; } .health-degraded { color: #e0af68; }
+  .health-unhealthy { color: #f7768e; }
   pre { background: #0f1115; padding: 8px; border-radius: 6px;
         overflow: auto; max-height: 240px; }
   label { font-size: 12px; color: #9aa5b1; display: block; margin: 8px 0 3px; }
@@ -61,8 +68,8 @@ CONSOLE_HTML = r"""<!doctype html>
   </section>
   <section>
     <h2>Pipelines</h2>
-    <table id="pipelines"><tr><th>name</th><th>status</th><th>port</th>
-      <th>steps</th><th></th></tr></table>
+    <table id="pipelines"><tr><th>name</th><th>status</th><th>mode</th>
+      <th>slo</th><th>last incident</th><th>port</th><th></th></tr></table>
     <h2 style="margin-top:16px">Interact</h2>
     <label>pipeline port</label><input id="ioport"/>
     <label>input collection + rows (JSON list of lists)</label>
@@ -74,6 +81,9 @@ CONSOLE_HTML = r"""<!doctype html>
     <button onclick="readStats()">Stats</button>
     <button onclick="readMetrics()">Metrics</button>
     <button onclick="readFleetMetrics()">Fleet metrics</button>
+    <button onclick="readIncidents()">Incidents</button>
+    <button onclick="readFlight()">Flight</button>
+    <button onclick="readFleetHealth()">Fleet health</button>
     <pre id="io">-</pre>
   </section>
 </main>
@@ -118,14 +128,26 @@ async function refresh() {
   }
   const ps = await j('/pipelines');
   const tbl = document.getElementById('pipelines');
-  tbl.innerHTML = '<tr><th>name</th><th>status</th><th>port</th>' +
-                  '<th>steps</th><th></th></tr>';
+  tbl.innerHTML = '<tr><th>name</th><th>status</th><th>mode</th>' +
+                  '<th>slo</th><th>last incident</th><th>port</th>' +
+                  '<th></th></tr>';
   for (const p of ps) {
     const tr = document.createElement('tr');
     cell(tr, `${p.name} (v${p.program_version ?? '?'})`);
     cell(tr, p.status + (p.error ? ' — ' + p.error : ''),
          `status-${p.status}`);
-    cell(tr, p.port ?? ''); cell(tr, p.steps ?? '');
+    // mode=host on a compiled-default deploy is the fallback perf cliff:
+    // show it, with the recorded reason as the tooltip
+    cell(tr, p.mode ?? '',
+         p.mode === 'host' && p.fallback_reason ? 'health-degraded' : '',
+         p.fallback_reason ?? '');
+    cell(tr, p.health ?? '', `health-${p.health}`,
+         (p.slo && p.slo.active && p.slo.active.length)
+             ? 'breached: ' + p.slo.active.join(', ') : '');
+    const li = p.slo && p.slo.last_incident;
+    cell(tr, li ? `${li.slo}: ${li.cause}${li.resolved ? '' : ' (open)'}`
+               : '', li && !li.resolved ? 'health-unhealthy' : '');
+    cell(tr, p.port ?? '');
     const td = cell(tr, '');
     btn(td, 'stop', 'warn', () => stopPipeline(p.name));
     btn(td, 'delete', 'warn', () => deletePipeline(p.name));
@@ -186,6 +208,17 @@ async function readMetrics() {
 }
 async function readFleetMetrics() {
   show(await fetch('/metrics').then(r => r.text()));
+}
+// flight recorder + SLO watchdog (dbsp_tpu.obs.flight / .slo): the raw
+// event ring and the captured incidents with their attributed causes
+async function readIncidents() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/incidents?window=0`));
+}
+async function readFlight() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/flight?n=64`));
+}
+async function readFleetHealth() {
+  show(await j('/health'));
 }
 const val = id => document.getElementById(id).value;
 const post = b => ({ method: 'POST', body: JSON.stringify(b) });

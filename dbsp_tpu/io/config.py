@@ -92,7 +92,8 @@ def controller_config(cfg: dict) -> ControllerConfig:
     top-level scalar keys are REJECTED (a typo'd tuning knob silently
     applied as the default is worse than an error)."""
     fields = {f.name for f in dataclasses.fields(ControllerConfig)}
-    known_sections = {"inputs", "outputs", "name", "workers", "description"}
+    known_sections = {"inputs", "outputs", "name", "workers", "description",
+                      "slo"}  # slo: watchdog objectives (obs/slo.py)
     unknown = set(cfg) - fields - known_sections
     if unknown:
         raise ConfigError(

@@ -116,6 +116,9 @@ class Controller:
         self._running = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._step_lock = threading.Lock()
+        # monitor hooks (the SLO watchdog's evaluation site): run after
+        # every step and on idle loop passes, on the circuit thread
+        self._monitors: List = []
 
     # -- endpoint wiring ----------------------------------------------------
     def add_input_endpoint(self, name: str, collection: str,
@@ -133,6 +136,20 @@ class Controller:
         col = self.catalog.output(collection)
         self.outputs[name] = _OutputEndpoint(name, col, transport,
                                              OUTPUT_FORMATS[fmt]())
+
+    def add_monitor(self, fn) -> None:
+        """Register a zero-arg callable run by the circuit loop after each
+        step and while idling (obs.PipelineObs.watch registers here — the
+        controller loop is where SLOs evaluate). Exceptions are swallowed:
+        a watchdog must never take the pipeline down."""
+        self._monitors.append(fn)
+
+    def _run_monitors(self) -> None:
+        for fn in self._monitors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — monitoring is best-effort
+                pass
 
     # push-style input (HTTP endpoints on the server use this)
     def push(self, collection: str, rows) -> int:
@@ -230,6 +247,7 @@ class Controller:
             if not stepped:
                 with self._step_lock:
                     self._flush_driver_locked()
+                self._run_monitors()
                 time.sleep(0.005)
             self._backpressure()
 
@@ -248,6 +266,7 @@ class Controller:
         self.handle.step()
         self.steps += 1
         self._emit_outputs()
+        self._run_monitors()
 
     def _emit_outputs(self) -> None:
         for out in self.outputs.values():

@@ -79,7 +79,43 @@ class CircuitServer:
                 route = url.path.rstrip("/")
                 c = server.controller
                 if route == "/status":
-                    self._json({"state": c.state})
+                    # mode + SLO health ride along so one poll answers
+                    # "is this pipeline serving, on which path, within
+                    # its objectives" (the compiled->host fallback cliff
+                    # must be visible here, not only in a counter)
+                    out = {"state": c.state,
+                           "mode": getattr(c.handle, "mode", "host")}
+                    if server.obs is not None:
+                        server.obs.watch()
+                        out["slo"] = server.obs.slo.status_dict()
+                        # the watchdog's latched copy, NOT a ring scan: the
+                        # one-shot deploy-time event ages out of the ring
+                        # on a long-running pipeline
+                        fb = server.obs.slo.fallback_reason
+                        if fb is not None:
+                            out["fallback_reason"] = fb
+                    self._json(out)
+                elif route == "/flight":
+                    if server.obs is None:
+                        self._json({"error": "flight recorder not "
+                                             "enabled"}, 400)
+                    else:
+                        server.obs.watch()
+                        qs = parse_qs(url.query)
+                        limit = int(qs["n"][0]) if "n" in qs else None
+                        self._json(server.obs.flight.to_dict(limit=limit))
+                elif route == "/incidents":
+                    if server.obs is None:
+                        self._json({"error": "SLO watchdog not enabled"},
+                                   400)
+                    else:
+                        server.obs.watch()
+                        qs = parse_qs(url.query)
+                        full = qs.get("window", ["1"])[0] != "0"
+                        self._json({
+                            "status": server.obs.slo.status_dict(),
+                            "incidents": server.obs.slo.incidents(
+                                with_window=full)})
                 elif route == "/stats":
                     self._json(c.stats())
                 elif route == "/metrics":

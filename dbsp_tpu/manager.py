@@ -95,6 +95,10 @@ class Pipeline:
         self.error: Optional[str] = None
         self.mode: Optional[str] = None  # compiled | host (set at deploy)
         self.obs = None  # obs.PipelineObs (set at deploy)
+        # when compiled mode was requested but the deploy fell back to the
+        # host scheduler: the recorded reason (the fallback perf cliff must
+        # be visible in deploy status, not buried in a counter)
+        self.fallback_reason: Optional[str] = None
 
     def compile_and_start(self) -> None:
         from dbsp_tpu.circuit import Runtime
@@ -103,7 +107,10 @@ class Pipeline:
         from dbsp_tpu.profile import CPUProfiler
 
         self.status = "compiling"
-        self.obs = PipelineObs(name=self.name)
+        # the pipeline config's `slo` section configures this pipeline's
+        # watchdog objectives (obs/slo.py); omitted = fallback-only SLOs
+        self.obs = PipelineObs(name=self.name,
+                               slo=(self.config or {}).get("slo"))
         # "workers" was already an accepted pipeline-config key
         # (io/config.py known_sections) but never honored: deploy over an
         # SPMD worker mesh when requested so managed pipelines shard
@@ -135,10 +142,17 @@ class Pipeline:
 
             compiled = try_compiled_driver(handle,
                                            registry=self.obs.registry,
-                                           verified=True)
+                                           verified=True,
+                                           flight=self.obs.flight)
             if compiled is not None:
                 driver = compiled
                 self.mode = "compiled"
+            else:
+                fb = self.obs.flight.events(kinds=("fallback",))
+                if fb:
+                    self.fallback_reason = fb[-1].get("reason")
+                    if fb[-1].get("detail"):
+                        self.fallback_reason += f": {fb[-1]['detail']}"
         if self.mode == "compiled":
             from dbsp_tpu.profile import CompiledProfiler
 
@@ -165,10 +179,32 @@ class Pipeline:
         if self.status != "failed":
             self.status = "shutdown"
 
+    def health(self) -> str:
+        """SLO health of this pipeline: ok | degraded | unhealthy (plus
+        the lifecycle states failed/shutdown when it is not running)."""
+        if self.status == "failed":
+            return "unhealthy"
+        if self.status != "running" or self.obs is None:
+            return "ok" if self.status in ("created", "compiling") \
+                else "shutdown"
+        try:
+            self.obs.watch()  # fresh SLO evaluation (cheap, incremental)
+            return self.obs.slo.status()
+        except Exception:  # noqa: BLE001 — health polling is best-effort
+            return "unknown"
+
     def describe(self) -> dict:
-        return {"name": self.name, "status": self.status, "port": self.port,
-                "error": self.error, "mode": self.mode,
-                "program_version": self.program.get("version")}
+        out = {"name": self.name, "status": self.status, "port": self.port,
+               "error": self.error, "mode": self.mode,
+               "fallback_reason": self.fallback_reason,
+               "program_version": self.program.get("version")}
+        out["health"] = self.health()
+        if self.obs is not None:
+            s = self.obs.slo.status_dict()
+            out["slo"] = {"status": s["status"], "active": s["active"],
+                          "incidents": s["incidents"],
+                          "last_incident": s["last_incident"]}
+        return out
 
 
 class _CompilerService:
@@ -284,6 +320,8 @@ class PipelineManager:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.rstrip("/") == "/health":
+                    self._json(mgr.fleet_health())
                 elif self.path.rstrip("/") == "/programs":
                     with mgr.lock:
                         self._json(sorted(mgr.programs))
@@ -455,6 +493,25 @@ class PipelineManager:
                                  "down first"}, 409
             del self.pipelines[name]
             return {"deleted": name}, 200
+
+    def fleet_health(self) -> dict:
+        """Aggregate per-pipeline SLO status into one fleet state: the
+        worst running pipeline wins (unhealthy > degraded > ok). Served at
+        ``GET /health`` — the one poll a load balancer or pager needs."""
+        rank = {"ok": 0, "shutdown": 0, "unknown": 1, "degraded": 1,
+                "unhealthy": 2}
+        with self.lock:
+            pipes = list(self.pipelines.values())
+        detail = {}
+        worst_rank = 0
+        for p in pipes:
+            h = p.health()
+            detail[p.name] = {"health": h, "status": p.status,
+                              "mode": p.mode,
+                              "fallback_reason": p.fallback_reason}
+            worst_rank = max(worst_rank, rank.get(h, 1))
+        worst = {0: "ok", 1: "degraded", 2: "unhealthy"}[worst_rank]
+        return {"health": worst, "pipelines": detail}
 
     # -- persistence / serving -----------------------------------------------
     def _persist(self):

@@ -6,11 +6,15 @@ unbalanced start/end, events for unknown nodes), used as a test oracle inside
 engine tests; plus ``visualize_circuit`` (:167) rendering the circuit graph
 to graphviz.
 
-Relationship to ``dbsp_tpu.obs``: the monitor is a *correctness oracle*
-over the event streams (it validates protocol, stores no timings), while
-``obs.CircuitInstrumentation`` is the production *measurement* consumer of
-the same streams (histograms, gauges, Chrome-trace spans). They attach via
-the same ``register_*_event_handler`` API and compose freely.
+Relationship to ``dbsp_tpu.obs``: three consumers share the event streams
+with distinct jobs — the monitor is the *correctness oracle* (validates
+protocol, stores no timings), ``obs.CircuitInstrumentation`` is the
+production *measurement* consumer (histograms, gauges, Chrome-trace
+spans), and ``obs.flight.HostFlightSource`` + ``obs.slo.SLOWatchdog`` are
+the *incident-capture* layer (per-tick events with attributed causes in a
+bounded ring; SLO breaches freeze windows into ``/incidents`` reports).
+All attach via the same ``register_*_event_handler`` API and compose
+freely.
 """
 
 from __future__ import annotations

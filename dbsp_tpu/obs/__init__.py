@@ -15,7 +15,13 @@ the pipeline-manager's per-pipeline stats, ``dbsp_handle.rs:256-268``):
 * :mod:`dbsp_tpu.obs.instrument` — hooks subscribing to the circuit's
   ``SchedulerEvent`` stream (host path) or polling a compiled driver
   (compiled path), publishing per-operator eval histograms, step latency,
-  spine residency gauges, exchange counters, watermark lag.
+  spine residency gauges, exchange counters, watermark lag;
+* :mod:`dbsp_tpu.obs.flight` — the always-on flight recorder: a bounded
+  ring of structured engine events (per-tick latency with cause, host
+  phases, drains, replays, fallbacks) served at ``/flight``;
+* :mod:`dbsp_tpu.obs.slo` — the SLO watchdog: configurable objectives
+  evaluated in the controller loop; breaches freeze ring windows into
+  cause-attributed incidents served at ``/incidents``.
 
 Metric names follow ``dbsp_tpu_<subsystem>_<name>_<unit>`` (see
 ``registry.validate_metric_name``); the catalog lives in README.md
@@ -24,19 +30,21 @@ Metric names follow ``dbsp_tpu_<subsystem>_<name>_<unit>`` (see
 
 from dbsp_tpu.obs.export import (legacy_controller_lines, prometheus_text,
                                  prometheus_text_many)
+from dbsp_tpu.obs.flight import FlightRecorder
 from dbsp_tpu.obs.instrument import (CircuitInstrumentation,
                                      CompiledInstrumentation,
                                      ControllerInstrumentation, PipelineObs)
 from dbsp_tpu.obs.registry import (Counter, Gauge, Histogram,
                                    MetricNameError, MetricsRegistry, Summary,
                                    validate_metric_name)
+from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
 from dbsp_tpu.obs.tracing import SpanRecorder
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Summary",
     "MetricNameError", "validate_metric_name",
     "prometheus_text", "prometheus_text_many", "legacy_controller_lines",
-    "SpanRecorder",
+    "SpanRecorder", "FlightRecorder", "SLOConfig", "SLOWatchdog",
     "CircuitInstrumentation", "CompiledInstrumentation",
     "ControllerInstrumentation", "PipelineObs",
 ]

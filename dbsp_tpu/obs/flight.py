@@ -1,0 +1,409 @@
+"""Flight recorder: an always-on, bounded ring of structured engine events.
+
+The bench harness (PR 3/4) could already attribute tail ticks —
+``tick_causes``, ``host_overhead_ns``, ``spike_causes`` — but only as
+post-hoc JSON from a bench run. A serving pipeline's latency spikes,
+drains, overflow replays, and compiled->host fallbacks were "surfaced
+nowhere a user would see them". This module promotes that attribution
+machinery into a queryable subsystem: every pipeline keeps a small ring
+buffer of structured events, fed from the same places ``instrument.py``
+reads, and ``GET /flight`` dumps it on demand. "The Tail at Scale"
+(Dean & Barroso, CACM 2013) is the design pressure: tail behavior is the
+product metric, so the evidence for any tail sample must already be in
+memory when someone asks.
+
+Event kinds (one flat dict each; every event carries ``seq`` — a
+monotone id — wall-clock ``ts`` and monotonic ``t_ns``):
+
+  ``tick``            latency_ns, tick index, causes (maintain/snapshot/
+                      retrace annotations — the spike-attribution channel)
+  ``phase``           one between-tick host phase: phase=validate|
+                      maintain|snapshot, ns
+  ``maintain``        drain moves: rows_moved (+ drains/partial_drains on
+                      the compiled path; merges/forced on the host path)
+  ``overflow_replay`` one grow-and-replay cycle
+  ``consolidate``     consolidation-regime dispatch deltas {path: n}
+  ``exchange``        rows/bytes moved through shard/unshard this tick
+  ``watermark``       event-time lag sample of a watermark operator
+  ``compile``         a step-program (re)trace was observed
+  ``fallback``        compiled->host fallback, with the recorded reason
+
+Overhead discipline: ``record()`` is one dict build + deque append under a
+lock — no device syncs, no formatting; tests/test_flight.py gates it at
+< 2% of the q3 p50 tick time. The ring is bounded (default 2048 events),
+so a serving pipeline can run forever with the recorder on.
+
+Consumers: :class:`~dbsp_tpu.obs.slo.SLOWatchdog` evaluates SLOs over the
+stream and freezes ring windows into incidents; ``bench.py`` replays the
+same attribution (``spike_causes``) instead of private bookkeeping;
+``/flight`` serves the raw ring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FlightRecorder", "CompiledFlightSource", "HostFlightSource",
+    "spike_causes", "dominant_cause", "trace_slice", "ticks_from_samples",
+]
+
+
+class FlightRecorder:
+    """Bounded ring of structured events; thread-safe, append-mostly."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = int(capacity)
+        self._ring: Deque[dict] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.dropped = 0  # events aged out of the ring
+
+    def record(self, kind: str, t_ns: Optional[int] = None, **fields) -> int:
+        """Append one event; returns its ``seq``. The hot-path cost budget
+        is one dict + one deque append under the lock."""
+        ev = {"kind": kind, "ts": time.time(),
+              "t_ns": t_ns if t_ns is not None else time.perf_counter_ns()}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(ev)
+            return self._seq
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def events(self, kinds: Optional[Sequence[str]] = None,
+               since_seq: int = 0,
+               limit: Optional[int] = None) -> List[dict]:
+        """Snapshot of ring events (oldest first), optionally filtered by
+        kind, by ``seq > since_seq`` (incremental consumers), and capped to
+        the most recent ``limit``."""
+        with self._lock:
+            out = list(self._ring)
+        if since_seq:
+            out = [e for e in out if e["seq"] > since_seq]
+        if kinds is not None:
+            ks = set(kinds)
+            out = [e for e in out if e["kind"] in ks]
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def window(self, n: int = 128) -> List[dict]:
+        """The most recent ``n`` events — what an incident freezes."""
+        return self.events(limit=n)
+
+    def to_dict(self, limit: Optional[int] = None) -> dict:
+        return {"capacity": self.capacity, "dropped": self.dropped,
+                "last_seq": self.last_seq,
+                "events": self.events(limit=limit)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# attribution helpers (shared by SLO incidents and bench.py)
+# ---------------------------------------------------------------------------
+
+
+def spike_causes(tick_events: Iterable[dict], spike_ns: float) -> Dict[str, int]:
+    """Per-cause counts over spike ticks (latency above ``spike_ns``);
+    unannotated spikes count as ``unattributed`` — the exact bookkeeping
+    bench.py used to keep privately."""
+    out: Dict[str, int] = {}
+    for ev in tick_events:
+        if ev.get("latency_ns", 0) > spike_ns:
+            for cause in (ev.get("causes") or ("unattributed",)):
+                out[cause] = out.get(cause, 0) + 1
+    return out
+
+
+def dominant_cause(tick_events: Sequence[dict],
+                   p50_ns: Optional[float] = None
+                   ) -> Tuple[str, Dict[str, int]]:
+    """(dominant cause, per-cause counts) for a window of tick events.
+
+    Preference order: causes annotated on SPIKE ticks (> 3x p50) when any
+    exist — the ticks an SLO breach is actually about — otherwise causes on
+    any annotated tick (timing noise must not flip attribution to
+    ``unattributed`` when the window's only recorded activity is e.g. a
+    maintain drain). Ties break toward the most recently seen cause."""
+    ticks = list(tick_events)
+    if p50_ns is None and ticks:
+        lats = sorted(t.get("latency_ns", 0) for t in ticks)
+        p50_ns = lats[len(lats) // 2]
+    spikes = [t for t in ticks
+              if p50_ns and t.get("latency_ns", 0) > 3 * p50_ns
+              and t.get("causes")]
+    pool = spikes or [t for t in ticks if t.get("causes")]
+    counts: Dict[str, int] = {}
+    last_seen: Dict[str, int] = {}
+    for i, t in enumerate(pool):
+        for c in t["causes"]:
+            counts[c] = counts.get(c, 0) + 1
+            last_seen[c] = i
+    if not counts:
+        return "unattributed", {}
+    best = max(counts, key=lambda c: (counts[c], last_seen[c]))
+    return best, counts
+
+
+def trace_slice(events: Sequence[dict], pid: str = "dbsp_tpu") -> dict:
+    """A Perfetto-loadable Chrome-trace rendering of a flight window.
+
+    Ticks render as complete ("X") duration events on tid 0 — anchored at
+    ``t_ns - latency_ns``, so batched compiled samples lay out back to
+    back — host phases as "X" on tid 1, everything else as instant
+    markers. Self-contained: an incident's ``trace`` key can be saved to a
+    file and dropped into https://ui.perfetto.dev as-is."""
+    tes: List[dict] = []
+    for ev in events:
+        t_us = ev["t_ns"] / 1e3
+        if ev["kind"] == "tick":
+            dur = ev.get("latency_ns", 0) / 1e3
+            name = f"tick[{ev.get('tick', '?')}]"
+            causes = ev.get("causes") or []
+            tes.append({"name": name, "cat": "tick", "ph": "X",
+                        "ts": t_us - dur, "dur": dur, "pid": pid, "tid": 0,
+                        "args": {"causes": list(causes)}})
+        elif ev["kind"] == "phase":
+            dur = ev.get("ns", 0) / 1e3
+            tes.append({"name": ev.get("phase", "phase"), "cat": "phase",
+                        "ph": "X", "ts": t_us - dur, "dur": dur,
+                        "pid": pid, "tid": 1})
+        else:
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "ts", "t_ns", "seq")}
+            tes.append({"name": ev["kind"], "cat": "event", "ph": "i",
+                        "ts": t_us, "pid": pid, "tid": 2, "s": "t",
+                        "args": args})
+    return {"traceEvents": tes, "displayTimeUnit": "ms"}
+
+
+def ticks_from_samples(flight: FlightRecorder, samples_ns: Sequence[int],
+                       causes: Sequence[Tuple[int, str]] = ()) -> None:
+    """Backfill tick events from a raw latency-sample list (host-mode
+    bench runs, which have no live source attached)."""
+    ann: Dict[int, List[str]] = {}
+    for idx, cause in causes:
+        ann.setdefault(idx, []).append(cause)
+    now = time.perf_counter_ns()
+    clock = now - sum(int(s) for s in samples_ns)
+    for i, ns in enumerate(samples_ns):
+        clock += int(ns)
+        flight.record("tick", t_ns=clock, tick=i, latency_ns=int(ns),
+                      causes=ann.get(i, []))
+
+
+# ---------------------------------------------------------------------------
+# sources: engine state -> ring events
+# ---------------------------------------------------------------------------
+
+
+class CompiledFlightSource:
+    """Unseen-tail poller over a compiled driver (or bare CompiledHandle).
+
+    Mirrors the scrape protocol of ``CompiledInstrumentation`` with its own
+    cursors: ``step_times_ns``/``tick_causes`` become ``tick`` events,
+    ``host_overhead_ns`` becomes ``phase`` events, ``overflow_replays`` and
+    ``maintain_stats['rows_moved']`` deltas become ``overflow_replay`` /
+    ``maintain`` events, and a ``retrace`` annotation also emits a
+    ``compile`` marker. Poll sites: the controller's monitor hook (via
+    ``PipelineObs.watch``) and any ``/flight``/``/incidents`` read."""
+
+    def __init__(self, driver, flight: FlightRecorder):
+        # bench holds a CompiledHandle directly; the serving path holds a
+        # CompiledCircuitDriver whose .ch is the handle
+        self.ch = getattr(driver, "ch", driver)
+        self.flight = flight
+        self._lock = threading.Lock()
+        self._lat_seen = 0
+        self._cause_seen = 0
+        self._overhead_seen: Dict[str, int] = {}
+        self._replays_seen = 0
+        self._rows_moved_seen = 0
+        self._consolidate_seen: Dict[str, int] = {}
+        # synthetic wall anchors for batched samples (see trace_slice)
+        self._clock_ns: Optional[int] = None
+
+    def poll(self) -> None:
+        ch = self.ch
+        with self._lock:
+            lat = getattr(ch, "step_times_ns", ())
+            n = len(lat)
+            if self._lat_seen > n:  # reset_timing() cleared the lists
+                self._lat_seen = 0
+                self._cause_seen = 0
+                self._overhead_seen.clear()
+                self._rows_moved_seen = 0  # maintain_stats zeroed too
+            tail = list(lat[self._lat_seen:n])
+            base_idx = self._lat_seen
+            self._lat_seen = n
+            causes = getattr(ch, "tick_causes", ())
+            nc = len(causes)
+            new_causes = list(causes[min(self._cause_seen, nc):nc])
+            self._cause_seen = nc
+            ann: Dict[int, List[str]] = {}
+            for idx, cause in new_causes:
+                ann.setdefault(idx, []).append(cause)
+            if tail:
+                now = time.perf_counter_ns()
+                total = sum(int(s) for s in tail)
+                clock = self._clock_ns if self._clock_ns is not None else 0
+                clock = max(clock, now - total)
+                for i, ns in enumerate(tail):
+                    idx = base_idx + i
+                    clock += int(ns)
+                    cs = ann.pop(idx, [])
+                    if "retrace" in cs:
+                        self.flight.record("compile", t_ns=clock, tick=idx)
+                    self.flight.record("tick", t_ns=clock, tick=idx,
+                                       latency_ns=int(ns), causes=cs)
+                self._clock_ns = clock
+            # a concurrent scrape can observe a sample before its cause
+            # annotation lands (_append_sample is not atomic across the two
+            # lists) — late causes amend the already-emitted tick via a
+            # tick_cause event the SLO watchdog folds back in
+            for idx, cs in ann.items():
+                self.flight.record("tick_cause", tick=idx, causes=cs)
+            overhead = getattr(ch, "host_overhead_ns", None) or {}
+            for phase, samples in overhead.items():
+                np_ = len(samples)
+                for ns in samples[self._overhead_seen.get(phase, 0):np_]:
+                    self.flight.record("phase", phase=phase, ns=int(ns))
+                self._overhead_seen[phase] = np_
+            replays = getattr(ch, "overflow_replays", 0)
+            for _ in range(replays - self._replays_seen):
+                self.flight.record("overflow_replay")
+            self._replays_seen = max(self._replays_seen, replays)
+            stats = getattr(ch, "maintain_stats", None) or {}
+            moved = stats.get("rows_moved", 0)
+            if moved > self._rows_moved_seen:
+                self.flight.record(
+                    "maintain", rows_moved=moved - self._rows_moved_seen,
+                    drains=stats.get("drains", 0),
+                    partial_drains=stats.get("partial_drains", 0))
+            self._rows_moved_seen = max(self._rows_moved_seen, moved)
+            self._poll_consolidate()
+
+    def _poll_consolidate(self) -> None:
+        from dbsp_tpu.zset import kernels as zkernels
+
+        delta = {}
+        for path, count in zkernels.CONSOLIDATE_COUNTS.items():
+            d = count - self._consolidate_seen.get(path, 0)
+            if d > 0:
+                delta[path] = d
+            self._consolidate_seen[path] = count
+        if delta:
+            self.flight.record("consolidate", paths=delta)
+
+
+class HostFlightSource:
+    """Host-path feeder: scheduler step events -> tick events with causes.
+
+    Subscribes to the same ``SchedulerEvent`` stream as
+    ``CircuitInstrumentation``. At each root-step end it records one tick
+    event whose causes come from engine-state deltas gathered during the
+    step: spine maintenance (``maintain_stats['merged_rows']`` across every
+    spine in the graph) maps to cause ``maintain``, and exchange/watermark
+    deltas become their own events. The graph walk is done ONCE at attach
+    (operator sets are static post-build); per-tick cost is a handful of
+    int reads."""
+
+    def __init__(self, circuit, flight: FlightRecorder):
+        from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
+
+        self.circuit = circuit
+        self.flight = flight
+        self._depth = 0
+        self._step_t0: Optional[int] = None
+        self._tick = 0
+        self._spines: List[object] = []
+        self._exchanges: List[object] = []
+        self._wm_ops: List[object] = []
+        for node in self._walk(circuit):
+            op = node.operator
+            sp = getattr(op, "spine", None)
+            if sp is not None and hasattr(sp, "maintain_stats"):
+                self._spines.append(sp)
+            if op.name in ("shard", "unshard"):
+                self._exchanges.append(op)
+            if isinstance(op, WatermarkMonotonic):
+                self._wm_ops.append(op)
+        self._merged_seen = self._merged_rows()
+        self._exch_seen = self._exchange_totals()
+        self._wm_lag_seen: Dict[int, float] = {}
+        circuit.register_scheduler_event_handler(self._on_event)
+
+    @staticmethod
+    def _walk(circuit):
+        for node in circuit.nodes:
+            yield node
+            if node.child is not None:
+                yield from HostFlightSource._walk(node.child)
+
+    def _merged_rows(self) -> int:
+        return sum(sp.maintain_stats.get("merged_rows", 0)
+                   for sp in self._spines)
+
+    def _exchange_totals(self) -> Tuple[int, int]:
+        return (sum(getattr(op, "rows_moved", 0) for op in self._exchanges),
+                sum(getattr(op, "bytes_moved", 0) for op in self._exchanges))
+
+    def _on_event(self, ev) -> None:
+        if ev.kind == "step_start":
+            if self._depth == 0:
+                self._step_t0 = ev.time_ns or time.perf_counter_ns()
+            self._depth += 1
+        elif ev.kind == "step_end":
+            if self._depth == 0:
+                return  # attached mid-step
+            self._depth -= 1
+            if self._depth or self._step_t0 is None:
+                return
+            t1 = ev.time_ns or time.perf_counter_ns()
+            latency = t1 - self._step_t0
+            self._step_t0 = None
+            causes = []
+            try:
+                merged = self._merged_rows()
+                if merged > self._merged_seen:
+                    causes.append("maintain")
+                    self.flight.record(
+                        "maintain", t_ns=t1,
+                        rows_moved=merged - self._merged_seen)
+                self._merged_seen = merged
+                rows, nbytes = self._exchange_totals()
+                if rows > self._exch_seen[0] or nbytes > self._exch_seen[1]:
+                    self.flight.record(
+                        "exchange", t_ns=t1,
+                        rows=rows - self._exch_seen[0],
+                        bytes=nbytes - self._exch_seen[1])
+                    self._exch_seen = (rows, nbytes)
+                for i, op in enumerate(self._wm_ops):
+                    if op._max_ts is None or op._last_batch_max is None:
+                        continue
+                    lag = op._max_ts - op._last_batch_max
+                    if lag != self._wm_lag_seen.get(i):
+                        self._wm_lag_seen[i] = lag
+                        self.flight.record("watermark", t_ns=t1, lag=lag)
+            except Exception:
+                pass  # a mid-step race must not kill the circuit thread
+            self.flight.record("tick", t_ns=t1, tick=self._tick,
+                               latency_ns=latency, causes=causes)
+            self._tick += 1
+
+    def poll(self) -> None:
+        """No-op: the host source is push-driven by scheduler events."""

@@ -353,25 +353,60 @@ class ControllerInstrumentation:
 
 
 class PipelineObs:
-    """Per-pipeline observability bundle: one registry + one span window.
+    """Per-pipeline observability bundle: one registry + one span window +
+    one flight recorder + one SLO watchdog.
 
     Construction wires nothing; call the ``attach_*`` helpers for the
     surfaces the pipeline actually runs (host circuit, compiled driver,
     controller). The manager aggregates ``(labels, registry)`` pairs from
-    every deployed pipeline into the fleet-wide exposition."""
+    every deployed pipeline into the fleet-wide exposition and the
+    per-pipeline SLO states into fleet health.
 
-    def __init__(self, name: str = "", max_trace_steps: int = 64):
+    ``slo`` is the pipeline config's ``slo`` section (obs/slo.py config
+    keys); the watchdog runs with every key disabled except the
+    host-fallback one when omitted. :meth:`watch` — one poll of every
+    flight source plus one SLO evaluation — is registered as a scrape-time
+    collector and as a controller monitor, so SLO state is fresh on both
+    paths without a dedicated thread."""
+
+    def __init__(self, name: str = "", max_trace_steps: int = 64,
+                 flight_capacity: int = 2048, slo=None):
+        from dbsp_tpu.obs.flight import FlightRecorder
+        from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
+
         self.name = name
         self.registry = MetricsRegistry()
         self.spans = SpanRecorder(max_steps=max_trace_steps)
+        self.flight = FlightRecorder(capacity=flight_capacity)
+        self.slo = SLOWatchdog(self.flight, SLOConfig.from_dict(slo),
+                               registry=self.registry, pipeline=name)
+        self._flight_sources = []
+        self.registry.register_collector(self.watch)
+
+    def watch(self):
+        """One watchdog pass: poll flight sources, evaluate SLOs. Returns
+        the incidents opened by this pass."""
+        for src in self._flight_sources:
+            src.poll()
+        return self.slo.evaluate()
 
     def attach_circuit(self, circuit) -> CircuitInstrumentation:
+        from dbsp_tpu.obs.flight import HostFlightSource
+
+        self._flight_sources.append(HostFlightSource(circuit, self.flight))
         return CircuitInstrumentation(circuit, self.registry,
                                       spans=self.spans)
 
     def attach_compiled(self, driver) -> CompiledInstrumentation:
+        from dbsp_tpu.obs.flight import CompiledFlightSource
+
+        self._flight_sources.append(CompiledFlightSource(driver,
+                                                         self.flight))
         return CompiledInstrumentation(driver, self.registry,
                                        spans=self.spans)
 
     def attach_controller(self, controller) -> ControllerInstrumentation:
+        add_monitor = getattr(controller, "add_monitor", None)
+        if add_monitor is not None:
+            add_monitor(self.watch)
         return ControllerInstrumentation(controller, self.registry)

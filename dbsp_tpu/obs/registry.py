@@ -43,6 +43,19 @@ ALLOWED_UNITS = ("total", "seconds", "rows", "bytes", "count", "ratio",
 _NAME_RE = re.compile(r"^dbsp_tpu_[a-z0-9]+(_[a-z0-9]+)+$")
 _LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 
+# The closed label-name allowlist for engine metrics. Label VALUES drive
+# time-series cardinality, so label names are restricted to dimensions
+# with enumerable value sets (operators, nodes, phases, causes, ...) —
+# never per-key, per-row, or per-tick identities. tools/check_metrics.py
+# lints every in-tree registration against this list (tier-1 via
+# tests/test_obs.py); grow it deliberately, with the value set in mind.
+# ("le"/"quantile" are exposition-internal, reserved for obs/export.py.)
+ALLOWED_LABEL_NAMES = frozenset((
+    "operator", "node", "endpoint", "phase", "cause", "reason", "path",
+    "rule", "severity", "slo", "pipeline", "worker", "mode", "state",
+    "query", "kind",
+))
+
 
 class MetricNameError(ValueError):
     pass

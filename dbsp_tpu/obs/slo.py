@@ -1,0 +1,344 @@
+"""SLO watchdog: breach detection + incident capture over a flight ring.
+
+The serving promise of a DBSP pipeline is cost-proportional-to-delta; the
+failure modes that break it — drain/re-trace latency spikes, compiled->host
+fallbacks (an order-of-magnitude perf cliff), watermark lag, overflow
+replays — each have a configured objective here. The watchdog evaluates in
+the controller/monitor loop (``Controller.add_monitor`` ->
+``PipelineObs.watch``) and at every scrape; on breach it freezes the
+surrounding :class:`~dbsp_tpu.obs.flight.FlightRecorder` window into a
+self-contained **incident**: a JSON report carrying the attributed dominant
+cause (the same ``tick_causes`` channel bench.py reports) plus a
+Perfetto-loadable trace slice of the window.
+
+Config keys (``SLOConfig``; pipeline config section ``slo`` or env
+``DBSP_TPU_SLO_*`` for harnesses):
+
+  ``p99_tick_seconds``     rolling-window p99 tick latency bound
+  ``tick_p50_multiple``    absolute per-tick bound as k x rolling p50
+                           (the tail-amplification objective: p99/p50 was
+                           the PR-3 headline metric)
+  ``watermark_lag``        max event-time lag of the latest batch behind
+                           the frontier (host pipelines)
+  ``fallback_to_host``     bool: a compiled->host fallback is an SLO event
+                           (default on — the perf cliff must be visible)
+  ``overflow_replays``     max grow-and-replay cycles inside ``window_s``
+  ``window_ticks``/``window_s``  rolling-window extents
+
+Incident lifecycle: one incident per breach EPISODE — it opens on the
+first breaching evaluation, accumulates evidence (breach count, worst
+observed value, causes of breaching ticks) while the SLO stays in breach,
+and closes (``resolved_ts``) when the objective recovers. Hysteresis, not
+dedup: a steady violation produces exactly one incident, a flap produces
+one per episode.
+
+Health states: ``unhealthy`` while a latency/watermark/replay SLO is in
+active breach, ``degraded`` when the only active condition is the latched
+host-fallback (the pipeline serves, at host speed), ``ok`` otherwise. The
+manager aggregates these per-pipeline states into fleet health and the
+registry exports ``dbsp_tpu_slo_breaches_total{slo}`` (fleet scrapes add
+the ``pipeline`` label).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dbsp_tpu.obs.flight import FlightRecorder, dominant_cause, trace_slice
+
+__all__ = ["SLOConfig", "SLOWatchdog", "SLO_KEYS"]
+
+# the closed set of objectives (also the only values the `slo` metric
+# label ever takes — tools/check_metrics.py keeps label NAMES closed; this
+# keeps the value set enumerable too)
+SLO_KEYS = ("p99_tick", "tick_abs", "watermark_lag", "fallback_to_host",
+            "overflow_replays")
+
+# SLOs whose active breach means the pipeline still serves, just degraded
+_DEGRADED_ONLY = ("fallback_to_host",)
+
+
+class SLOConfig:
+    """Parsed SLO objectives; ``None`` disables a check."""
+
+    _FIELDS = ("p99_tick_seconds", "tick_p50_multiple", "watermark_lag",
+               "fallback_to_host", "overflow_replays", "window_ticks",
+               "window_s")
+
+    def __init__(self, p99_tick_seconds: Optional[float] = None,
+                 tick_p50_multiple: Optional[float] = None,
+                 watermark_lag: Optional[float] = None,
+                 fallback_to_host: bool = True,
+                 overflow_replays: Optional[int] = None,
+                 window_ticks: int = 256, window_s: float = 300.0):
+        self.p99_tick_seconds = p99_tick_seconds
+        self.tick_p50_multiple = tick_p50_multiple
+        self.watermark_lag = watermark_lag
+        self.fallback_to_host = bool(fallback_to_host)
+        self.overflow_replays = overflow_replays
+        self.window_ticks = int(window_ticks)
+        self.window_s = float(window_s)
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SLOConfig":
+        d = dict(d or {})
+        unknown = set(d) - set(cls._FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown slo config keys {sorted(unknown)} "
+                f"(known: {list(cls._FIELDS)})")
+        return cls(**d)
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "SLOConfig":
+        """Env-variable form for harnesses (bench.py --slo):
+        DBSP_TPU_SLO_P99_TICK_MS, DBSP_TPU_SLO_TICK_P50_MULTIPLE,
+        DBSP_TPU_SLO_WATERMARK_LAG, DBSP_TPU_SLO_OVERFLOW_REPLAYS."""
+        def f(name):
+            v = env.get(name)
+            return float(v) if v not in (None, "") else None
+
+        p99_ms = f("DBSP_TPU_SLO_P99_TICK_MS")
+        replays = f("DBSP_TPU_SLO_OVERFLOW_REPLAYS")
+        return cls(
+            p99_tick_seconds=p99_ms / 1e3 if p99_ms is not None else None,
+            tick_p50_multiple=f("DBSP_TPU_SLO_TICK_P50_MULTIPLE"),
+            watermark_lag=f("DBSP_TPU_SLO_WATERMARK_LAG"),
+            overflow_replays=int(replays) if replays is not None else None)
+
+    def enabled(self) -> Dict[str, object]:
+        out = {}
+        for k in self._FIELDS[:5]:
+            v = getattr(self, k)
+            if v is not None and v is not False:
+                out[k] = v
+        return out
+
+
+class SLOWatchdog:
+    """Consumes a flight ring incrementally; opens/updates/closes
+    incidents; exports breach metrics. ``evaluate()`` is cheap enough to
+    run per controller-loop pass AND per scrape (both call it)."""
+
+    def __init__(self, flight: FlightRecorder, config: SLOConfig,
+                 registry=None, pipeline: str = "",
+                 max_incidents: int = 16, freeze_window: int = 128):
+        self.flight = flight
+        self.config = config
+        self.pipeline = pipeline
+        self.freeze_window = freeze_window
+        self._lock = threading.Lock()
+        self._seen_seq = 0
+        self._ticks: Deque[dict] = deque(maxlen=config.window_ticks)
+        self._replay_ts: Deque[float] = deque(maxlen=1024)
+        self._wm_lag: Optional[float] = None
+        self._fallback: Optional[dict] = None
+        self._active: Dict[str, dict] = {}  # slo -> open incident
+        self._incidents: Deque[dict] = deque(maxlen=max_incidents)
+        self._ids = 0
+        self._breach_counter = None
+        if registry is not None:
+            self._breach_counter = registry.counter(
+                "dbsp_tpu_slo_breaches_total",
+                "SLO breach episodes opened, by objective (an episode "
+                "counts once however long the breach lasts)",
+                labels=("slo",))
+            self._incidents_counter = registry.counter(
+                "dbsp_tpu_obs_incidents_total",
+                "Incidents captured by the SLO watchdog")
+            active_g = registry.gauge(
+                "dbsp_tpu_slo_active_breaches_count",
+                "Objectives currently in breach (0 = meeting all SLOs)")
+            dropped_c = registry.counter(
+                "dbsp_tpu_obs_flight_dropped_total",
+                "Flight-recorder events aged out of the bounded ring")
+            registry.register_collector(
+                lambda: (active_g.set(len(self._active)),
+                         dropped_c.set_total(self.flight.dropped)))
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self) -> List[dict]:
+        """One watchdog pass: fold new flight events into the rolling
+        window, check every configured objective, open/update/close
+        incidents. Returns incidents OPENED by this pass."""
+        with self._lock:
+            return self._evaluate_locked()
+
+    def _evaluate_locked(self) -> List[dict]:
+        cfg = self.config
+        new = self.flight.events(since_seq=self._seen_seq)
+        if new:
+            self._seen_seq = new[-1]["seq"]
+        new_ticks = []
+        for ev in new:
+            k = ev["kind"]
+            if k == "tick":
+                self._ticks.append(ev)
+                new_ticks.append(ev)
+            elif k == "tick_cause":
+                # late cause amendment for an already-seen tick
+                for t in reversed(self._ticks):
+                    if t.get("tick") == ev.get("tick"):
+                        t.setdefault("causes", [])
+                        t["causes"] = list(t["causes"]) + list(
+                            ev.get("causes") or [])
+                        break
+            elif k == "overflow_replay":
+                self._replay_ts.append(ev["ts"])
+            elif k == "watermark":
+                self._wm_lag = ev.get("lag")
+            elif k == "fallback":
+                self._fallback = ev
+        lats = sorted(t.get("latency_ns", 0) for t in self._ticks)
+        p50 = lats[len(lats) // 2] if lats else 0
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else 0
+        now = time.time()
+        while self._replay_ts and now - self._replay_ts[0] > cfg.window_s:
+            self._replay_ts.popleft()
+
+        checks: List[Tuple[str, bool, float, float, Optional[str]]] = []
+        if cfg.p99_tick_seconds is not None and lats:
+            checks.append(("p99_tick", p99 / 1e9 > cfg.p99_tick_seconds,
+                           p99 / 1e9, cfg.p99_tick_seconds, None))
+        if cfg.tick_p50_multiple is not None and new_ticks:
+            worst = max(t.get("latency_ns", 0) for t in new_ticks)
+            bound = cfg.tick_p50_multiple * p50
+            checks.append(("tick_abs", worst > bound, worst / 1e9,
+                           bound / 1e9, None))
+        if cfg.watermark_lag is not None and self._wm_lag is not None:
+            checks.append(("watermark_lag",
+                           self._wm_lag > cfg.watermark_lag,
+                           float(self._wm_lag), float(cfg.watermark_lag),
+                           "watermark"))
+        if cfg.overflow_replays is not None:
+            n = len(self._replay_ts)
+            checks.append(("overflow_replays", n > cfg.overflow_replays,
+                           float(n), float(cfg.overflow_replays),
+                           "overflow"))
+        if cfg.fallback_to_host and self._fallback is not None:
+            checks.append(("fallback_to_host", True, 1.0, 0.0, "fallback"))
+
+        opened: List[dict] = []
+        breaching_ticks = [t for t in new_ticks if t.get("causes")]
+        for slo, breached, observed, threshold, fixed_cause in checks:
+            inc = self._active.get(slo)
+            if breached and inc is None:
+                inc = self._open_incident(slo, observed, threshold,
+                                          fixed_cause, breaching_ticks, p50)
+                opened.append(inc)
+            elif breached and inc is not None:
+                # latched conditions (fallback) never resolve: their
+                # evidence is static, so re-freezing the window + trace on
+                # every pass would be permanent per-tick overhead
+                if new and slo not in _DEGRADED_ONLY:
+                    self._update_incident(inc, observed, fixed_cause,
+                                          breaching_ticks, p50)
+            elif not breached and inc is not None:
+                inc["resolved_ts"] = now
+                del self._active[slo]
+        return opened
+
+    # -- incidents -----------------------------------------------------------
+    def _attribute(self, inc: dict, fixed_cause: Optional[str],
+                   breaching_ticks: List[dict], p50: float) -> None:
+        if fixed_cause is not None:
+            inc["cause"], inc["causes"] = fixed_cause, {fixed_cause: 1}
+            return
+        causes = dict(inc.get("causes") or {})
+        for t in breaching_ticks:
+            for c in t.get("causes") or ():
+                causes[c] = causes.get(c, 0) + 1
+        if causes:
+            inc["causes"] = causes
+            inc["cause"] = max(causes, key=causes.get)
+        else:  # nothing accumulated yet: attribute from the window
+            cause, counts = dominant_cause(
+                [t for t in self._ticks], p50)
+            inc["cause"], inc["causes"] = cause, counts
+
+    def _freeze(self, inc: dict) -> None:
+        window = self.flight.window(self.freeze_window)
+        inc["window"] = window
+        inc["trace"] = trace_slice(window)
+
+    def _open_incident(self, slo: str, observed: float, threshold: float,
+                       fixed_cause: Optional[str],
+                       breaching_ticks: List[dict], p50: float) -> dict:
+        self._ids += 1
+        inc = {"id": self._ids, "slo": slo, "pipeline": self.pipeline,
+               "opened_ts": time.time(), "last_ts": time.time(),
+               "resolved_ts": None, "breach_count": 1,
+               "observed": observed, "threshold": threshold,
+               "cause": "unattributed", "causes": {}}
+        if slo == "fallback_to_host" and self._fallback is not None:
+            inc["fallback_reason"] = self._fallback.get("reason")
+        self._attribute(inc, fixed_cause, breaching_ticks, p50)
+        self._freeze(inc)
+        self._active[slo] = inc
+        self._incidents.append(inc)
+        if self._breach_counter is not None:
+            self._breach_counter.labels(slo=slo).inc()
+            self._incidents_counter.inc()
+        return inc
+
+    def _update_incident(self, inc: dict, observed: float,
+                         fixed_cause: Optional[str],
+                         breaching_ticks: List[dict], p50: float) -> None:
+        inc["last_ts"] = time.time()
+        inc["breach_count"] += 1
+        inc["observed"] = max(inc["observed"], observed)
+        self._attribute(inc, fixed_cause, breaching_ticks, p50)
+        self._freeze(inc)  # episode still open: keep the freshest window
+
+    # -- reporting -----------------------------------------------------------
+    def incidents(self, with_window: bool = True) -> List[dict]:
+        with self._lock:
+            out = []
+            for inc in self._incidents:
+                d = dict(inc)
+                if not with_window:
+                    d.pop("window", None)
+                    d.pop("trace", None)
+                out.append(d)
+            return out
+
+    def status(self) -> str:
+        with self._lock:
+            active = set(self._active)
+        if active - set(_DEGRADED_ONLY):
+            return "unhealthy"
+        if active or self._fallback is not None:
+            return "degraded"
+        return "ok"
+
+    @property
+    def fallback_reason(self) -> Optional[str]:
+        """The latched compiled->host fallback reason, if any — DURABLE:
+        the watchdog retains it after the one-shot flight event ages out
+        of the bounded ring (consumers must read it here, not rescan the
+        ring)."""
+        fb = self._fallback
+        return fb.get("reason") if fb is not None else None
+
+    def status_dict(self) -> dict:
+        with self._lock:
+            active = sorted(self._active)
+            last = self._incidents[-1] if self._incidents else None
+            n = len(self._incidents)
+        return {
+            "status": self.status(),
+            "fallback_reason": self.fallback_reason,
+            "active": active,
+            "incidents": n,
+            "last_incident": None if last is None else {
+                "id": last["id"], "slo": last["slo"],
+                "cause": last["cause"],
+                "observed": last["observed"],
+                "threshold": last["threshold"],
+                "resolved": last["resolved_ts"] is not None},
+            "config": self.config.enabled(),
+        }

@@ -17,8 +17,13 @@ this profiler is the one-shot *report* surface (``/dump_profile`` — full
 per-operator totals and graphviz dumps for a human, on demand), while
 ``obs.CircuitInstrumentation`` consumes the SAME scheduler-event stream to
 maintain continuously-scraped histograms/gauges (``/metrics``) and the
-Chrome-trace span window (``/trace``). Both can be attached to one circuit
-simultaneously; neither depends on the other.
+Chrome-trace span window (``/trace``), and ``obs.flight``/``obs.slo`` are
+the *incident capture* layer: the flight recorder keeps the recent tick
+stream with attributed causes always in memory (``/flight``) and the SLO
+watchdog freezes breach windows into self-contained ``/incidents``
+reports. Oracle (monitor.py), measurement (this file + instrument.py),
+and incident capture are separable concerns; all can attach to one
+circuit simultaneously and none depends on another.
 """
 
 from __future__ import annotations

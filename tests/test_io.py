@@ -100,7 +100,9 @@ def test_server_endpoints(tmp_path):
         with urllib.request.urlopen(req, timeout=5) as r:
             return r.status, r.read()
 
-    assert json.loads(get("/status")[1]) == {"state": "initializing"}
+    # /status now rides the execution mode along (obs-less server: no slo)
+    assert json.loads(get("/status")[1]) == {"state": "initializing",
+                                             "mode": "host"}
     # push rows over HTTP, step explicitly, read the output endpoint
     st, body = post("/input_endpoint/events?format=json",
                     b'{"insert": [7, 1]}\n{"insert": [7, 2]}\n')
@@ -120,7 +122,8 @@ def test_server_endpoints(tmp_path):
     with pytest.raises(urllib.error.HTTPError):
         get("/nope")
     st, _ = post("/pause")
-    assert json.loads(get("/status")[1]) == {"state": "paused"}
+    assert json.loads(get("/status")[1]) == {"state": "paused",
+                                             "mode": "host"}
     server.stop()
 
 

@@ -530,12 +530,35 @@ def test_metrics_lint_catches_violations(tmp_path):
         'TEXT = "# TYPE my_metric counter"\n'
         'LINE = f\'dbsp_steps{{endpoint="{0}"}} 1\'\n'
         'NAME = "dbsp_tpu_foo_frobs"\n'
-        'reg.counter("dbsp_tpu_io_records")\n')
+        'reg.counter("dbsp_tpu_io_records")\n'
+        'reg.gauge("dbsp_tpu_trace_level_count", "x", labels=("tick_id",))\n')
     got = check_tree(str(bad))
     # line 1 (# TYPE header), line 2 (f-string label rendering — the ast
     # constant holds ONE brace after {{ unescaping), line 3 (bad unit),
-    # line 4 twice (counter-kind _total rule + bare-literal unit rule)
-    assert len(got) == 5, got
+    # line 4 twice (counter-kind _total rule + bare-literal unit rule),
+    # line 5 (label name outside the closed allowlist — cardinality lint)
+    assert len(got) == 6, got
     assert sum("exposition formatting" in v for v in got) == 2
     assert any("unit suffix" in v for v in got)
     assert any("_total" in v for v in got)
+    assert any("allowlist" in v for v in got)
+
+
+def test_metrics_lint_label_allowlist_positional(tmp_path):
+    """The cardinality lint also sees positional labels args, and
+    allowlisted labels pass."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from tools.check_metrics import check_tree
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text(
+        'reg.counter("dbsp_tpu_slo_breaches_total", "x", ("slo",))\n')
+    assert check_tree(str(pkg)) == []
+    (pkg / "bad.py").write_text(
+        'reg.counter("dbsp_tpu_io_rows_total", "x", ("row_key",))\n')
+    got = check_tree(str(pkg))
+    assert len(got) == 1 and "allowlist" in got[0], got

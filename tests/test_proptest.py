@@ -226,3 +226,73 @@ def test_spmd_8_equals_1(ticks_a, ticks_b):
         return {name: out.to_dict() for name, out in outs.items()}
 
     assert run(8) == run(1)
+
+
+# ---------------------------------------------------------------------------
+# 4) the 7-term nested-timestamp join (operators/nested_ops.py):
+#    incremental across epochs == full recomputation from scratch
+# ---------------------------------------------------------------------------
+
+# Small node-id domain so random edge streams form cycles, diamonds, and
+# re-derivable paths — the shapes that exercise every corner term of the
+# nested join's D_e D_i expansion (deletion propagation through PX(i)
+# especially). Edges carry set semantics: an op toggles presence.
+_edge = st.tuples(st.integers(0, 5), st.integers(0, 5))
+_epoch = st.lists(_edge, max_size=6)
+_epochs = st.lists(_epoch, min_size=1, max_size=5)
+
+
+def _build_tc(c):
+    """Transitive closure via recurse(): the child's extend join is the
+    NestedJoinOp under test (7 delta-proportional terms over the (epoch,
+    iteration) product lattice — see nested_ops.py module doc)."""
+    from dbsp_tpu.operators import add_input_zset
+
+    edges, h = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+
+    def f(child, R):
+        e = child.import_stream(edges)
+        r_by_dst = R.index_by(
+            lambda k, v: (v[0],), (jnp.int64,),
+            val_fn=lambda k, v: (k[0],), val_dtypes=(jnp.int64,),
+            name="paths-by-dst")
+        return r_by_dst.join_index(
+            e, lambda k, rv, ev: ((rv[0],), (ev[0],)),
+            (jnp.int64,), (jnp.int64,), name="extend")
+
+    return h, edges.recurse(f).integrate().output()
+
+
+@SETTINGS
+@given(epochs=_epochs)
+@example(epochs=[[(0, 1), (1, 2)], [(1, 2)], [(1, 2)]])   # insert/del/re-add
+@example(epochs=[[(0, 1), (1, 0)], [(0, 1)]])             # cycle then cut
+@example(epochs=[[(0, 1), (1, 2), (2, 3)], [(0, 3)], [(0, 3), (1, 2)]])
+def test_nested_join_incremental_equals_recompute(epochs):
+    """VERDICT weak #8: the 7-term nested-timestamp join's cross-epoch
+    incrementality, property-tested. Each epoch toggles a random edge set
+    (insert/retract streams); after every parent tick the incrementally
+    maintained closure must equal a FULL RECOMPUTATION — a fresh circuit
+    fed the accumulated edges in one epoch. Divergence means one of the
+    seven delta terms (or the a2/b2 corner slices) mis-derives facts from
+    state the feedback hadn't produced at that iteration."""
+    from dbsp_tpu.circuit import RootCircuit
+
+    circuit, (h, out) = RootCircuit.build(_build_tc)
+    live: set = set()
+    for epoch in epochs:
+        for e in epoch:  # toggle: present edges retract, absent insert
+            if e in live:
+                live.discard(e)
+                h.push(e, -1)
+            else:
+                live.add(e)
+                h.push(e, 1)
+        circuit.step()
+        got = out.to_dict()
+
+        fresh, (h2, out2) = RootCircuit.build(_build_tc)
+        h2.extend([(e, 1) for e in live])
+        fresh.step()
+        want = out2.to_dict()
+        assert got == want, (sorted(live), got, want)

@@ -16,6 +16,14 @@ tests/test_obs.py::test_metrics_lint):
    ``dbsp_tpu_<subsystem>_<name>_<unit>`` (registry.validate_metric_name):
    counters end in ``_total``, the final segment is a known unit.
 
+3. **Label cardinality.** Label names on registration calls must come
+   from the closed allowlist ``registry.ALLOWED_LABEL_NAMES`` — the
+   dimensions whose VALUE sets are enumerable (operator, node, phase,
+   cause, slo, ...). A label like ``key``/``tick``/``row`` would turn the
+   exposition into one time series per datum; adding a genuinely new
+   dimension means growing the allowlist deliberately, with its value set
+   in mind.
+
 Usage: ``python tools/check_metrics.py [root]`` — prints violations and
 exits 1 when any are found.
 """
@@ -31,8 +39,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
 
-from dbsp_tpu.obs.registry import (MetricNameError,  # noqa: E402
-                                   validate_metric_name)
+from dbsp_tpu.obs.registry import (ALLOWED_LABEL_NAMES,  # noqa: E402
+                                   MetricNameError, validate_metric_name)
 
 # string-literal patterns that mean "this file formats Prometheus text"
 # (the label pattern uses a SINGLE brace: ast has already unescaped the
@@ -48,6 +56,22 @@ _METRIC_LITERAL = re.compile(r"^dbsp_tpu_[a-z0-9_]+$")
 
 _REGISTER_METHODS = {"counter": "counter", "gauge": "gauge",
                      "histogram": "histogram", "summary": "summary"}
+
+
+def _label_literals(call: ast.Call):
+    """The label-name string literals of a registration call, from the
+    ``labels=`` kwarg or the third positional arg. Non-literal label
+    expressions yield nothing (the runtime name check still applies)."""
+    node = None
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            node = kw.value
+    if node is None and len(call.args) >= 3:
+        node = call.args[2]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value
 
 
 def _iter_py(root: str):
@@ -99,6 +123,16 @@ def check_tree(pkg_root: str) -> list:
                             name, _REGISTER_METHODS[node.func.attr])
                     except MetricNameError as e:
                         violations.append(f"{rel}:{node.lineno}: {e}")
+                    # (3) closed label-name allowlist (cardinality lint)
+                    for ln in _label_literals(node):
+                        if ln not in ALLOWED_LABEL_NAMES:
+                            violations.append(
+                                f"{rel}:{node.lineno}: label {ln!r} on "
+                                f"{name!r} is not in the closed allowlist "
+                                "(obs.registry.ALLOWED_LABEL_NAMES) — "
+                                "per-key/per-tick label values are "
+                                "forbidden; grow the allowlist only for "
+                                "enumerable dimensions")
             # (2b) any metric-shaped literal: convention minus the kind rule
             elif isinstance(node, ast.Constant) and \
                     isinstance(node.value, str) and \

@@ -136,6 +136,26 @@ def run(reps: int = 5) -> dict:
         "ms": _time(lambda l, h: kernels.expand_ranges(l, h, 65_536),
                     lo, hi, reps=reps)}
 
+    # 7) flight-recorder steady-state overhead: one tick event recorded
+    #    into the bounded ring (dbsp_tpu/obs/flight.py) — pure host work,
+    #    no device dispatch. Reported as ms per 1000 events; the tier-1
+    #    gate (tests/test_flight.py) bounds the per-event cost at < 2% of
+    #    the recorded q3 p50 tick time.
+    from dbsp_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=2048)
+    n_ev = 10_000
+    samples = []
+    for _ in range(max(3, reps)):
+        t0 = time.perf_counter()
+        for i in range(n_ev):
+            rec.record("tick", tick=i, latency_ns=1000, causes=())
+        samples.append((time.perf_counter() - t0) * 1e3)
+    samples.sort()
+    out["flight_record"] = {
+        "shape": f"{n_ev} tick events into a 2048-slot ring",
+        "ms": samples[len(samples) // 2] / (n_ev / 1000)}
+
     out["meta"] = {"backend": jax.default_backend(),
                    "strategy": kernels.merge_strategy(), "reps": reps}
     return out
