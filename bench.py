@@ -46,6 +46,12 @@ overhead, so there the run keeps the scanned-chunk mode (one dispatch per
 validation interval) and latency granularity degrades to chunk-level —
 reported as such.
 
+Durability: each compiled query measurement also times one cold and one
+warm (incremental) checkpoint save of the final engine state and embeds
+``checkpoint_overhead`` — the fraction of elapsed a periodic checkpoint at
+``DBSP_TPU_CHECKPOINT_EVERY_TICKS`` (default 64) would cost (README
+§Durability; gated < 10% by tests/test_checkpoint.py).
+
 Multi-query: BENCH_QUERIES (default "q3,q4,q8" — the north-star set) runs
 each query through its own circuit; the headline metric/value is q4's (or
 the first measured query's), with every query's numbers under
@@ -502,6 +508,46 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
             for phase, v in ch.host_overhead_ns.items()}
         detail["maintain"] = {
             k: int(v) for k, v in ch.maintain_stats.items()}
+    # Durability cost (README §Durability): measure one cold (full) and a
+    # few warm (incremental, hard-linked deep levels) checkpoint saves of
+    # the final state and report the steady-state overhead fraction at the
+    # default periodic cadence — the quantity the <10%-of-elapsed bound in
+    # tests/test_checkpoint.py gates on the mini protocol.
+    if samples:
+        import shutil as _sh
+        import tempfile as _tf
+
+        from dbsp_tpu import checkpoint as _ckpt
+
+        every = int(os.environ.get("DBSP_TPU_CHECKPOINT_EVERY_TICKS",
+                                   str(_ckpt.DEFAULT_EVERY_TICKS)))
+        ckdir = _tf.mkdtemp(prefix="bench-ckpt-")
+        try:
+            t0 = _time.perf_counter()
+            _ckpt.save(ch, ckdir, tick=ticks)
+            cold_s = _time.perf_counter() - t0
+            warm = []
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                info = _ckpt.save(ch, ckdir, tick=ticks)
+                warm.append(_time.perf_counter() - t0)
+            warm_s = sorted(warm)[1]
+            per_tick_s = elapsed / ticks
+            detail["checkpoint_overhead"] = {
+                "every_ticks": every,
+                "save_cold_ms": round(cold_s * 1e3, 2),
+                "save_warm_ms": round(warm_s * 1e3, 2),
+                "linked_arrays": info["linked_arrays"],
+                "arrays": info["arrays"],
+                "bytes": info["bytes"],
+                "fraction_of_elapsed": round(
+                    warm_s / (warm_s + every * per_tick_s), 4),
+            }
+        except Exception as e:  # noqa: BLE001 — overhead is best-effort
+            detail["checkpoint_overhead"] = {"error": f"{type(e).__name__}:"
+                                                      f" {e}"[:200]}
+        finally:
+            _sh.rmtree(ckdir, ignore_errors=True)
     expected = (ticks // validate_every + (1 if ticks % validate_every else 0)
                 ) if scan else ticks
     # consolidation-regime dispatch decisions this query exercised (see

@@ -124,6 +124,14 @@ class PipelineHandle:
     def pause(self) -> None:
         _req(self.base + "/pause", data=b"", method="POST")
 
+    def checkpoint(self) -> dict:
+        """Write one durable checkpoint generation now (quiesced at a tick
+        boundary). Returns {"tick", "generation", ...}; raises
+        RuntimeError when the pipeline has no checkpoint directory
+        configured (``checkpoint_dir`` / DBSP_TPU_CHECKPOINT_DIR). The
+        restore position also rides ``status()["last_checkpoint_tick"]``."""
+        return _req(self.base + "/checkpoint", data=b"", method="POST")
+
 
 class Connection:
     """Manager-level API (reference: DBSPConnection)."""
@@ -197,6 +205,13 @@ class Connection:
         """Fleet health: worst per-pipeline SLO state plus per-pipeline
         {health, status, mode, fallback_reason} detail."""
         return _req(self.base + "/health")
+
+    def checkpoint_pipeline(self, name: str) -> dict:
+        """Manager-side checkpoint trigger: POST
+        /pipelines/<name>/checkpoint (same semantics as
+        :meth:`PipelineHandle.checkpoint`)."""
+        return _req(f"{self.base}/pipelines/{name}/checkpoint", data=b"",
+                    method="POST")
 
     def shutdown_pipeline(self, name: str) -> None:
         _req(f"{self.base}/pipelines/{name}/shutdown", data=b"",

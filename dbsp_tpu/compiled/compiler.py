@@ -313,6 +313,10 @@ class CompiledHandle:
         self.maintain_pending = False
         self._level_versions: Dict[str, List[int]] = {}
         self._snap_levels: Dict[str, List[Optional[Tuple[int, Batch]]]] = {}
+        # hard-link scope marker for incremental checkpoints: assigned by
+        # dbsp_tpu.checkpoint on first save, regenerated on restore (two
+        # handles sharing a directory must never alias each other's blobs)
+        self._ckpt_salt: Optional[str] = None
 
     # -- consolidate placement ----------------------------------------------
     def _place_consolidations(self) -> int:

@@ -192,6 +192,32 @@ class CompiledCircuitDriver:
         if self._retained:
             self._flush()
 
+    def restore_checkpoint(self, tick: int, retained) -> None:
+        """Resume from a restored checkpoint (dbsp_tpu.checkpoint): the
+        engine states were already applied to ``self.ch`` at the
+        checkpoint's validated tick; this replays the checkpoint's
+        retained-feed window — the inputs of the open (not yet validated)
+        interval — so the driver lands exactly where the checkpointed one
+        stood, with the same buffered outputs awaiting validation.
+        Exactly-once: retained ticks were never delivered pre-crash
+        (delivery happens at validation), so the replay re-delivers
+        nothing and re-runs everything, deterministically."""
+        self._snap = None
+        self._retained = []
+        self._out_buffer = []
+        self._tick = int(tick)
+        for t, feeds_by_idx in retained:
+            feeds = {self.ch.by_index[i].op: b
+                     for i, b in feeds_by_idx.items()}
+            if not self._retained:
+                self._snap = self.ch.snapshot()
+            self._retained.append((t, feeds))
+            self.ch.step(tick=t, feeds=feeds)
+            self._out_buffer.append(dict(self.ch.last_outputs))
+            self._tick = t + 1
+        if len(self._retained) >= self.validate_every:
+            self._flush()
+
 
 def try_compiled_driver(handle, registry=None, verified=False, flight=None):
     """Compile the circuit if every operator has a compiled equivalent;

@@ -149,6 +149,7 @@ async function refresh() {
                : '', li && !li.resolved ? 'health-unhealthy' : '');
     cell(tr, p.port ?? '');
     const td = cell(tr, '');
+    btn(td, 'ckpt', '', () => checkpointPipeline(p.name));
     btn(td, 'stop', 'warn', () => stopPipeline(p.name));
     btn(td, 'delete', 'warn', () => deletePipeline(p.name));
     tbl.appendChild(tr);
@@ -183,6 +184,13 @@ async function startPipeline() {
 }
 async function stopPipeline(name) {
   show(await j(`/pipelines/${encodeURIComponent(name)}/shutdown`, post({})));
+  refresh();
+}
+// durability (dbsp_tpu.checkpoint): write one generation now; the reply
+// carries the checkpointed tick + generation (or the config error)
+async function checkpointPipeline(name) {
+  show(await j(`/pipelines/${encodeURIComponent(name)}/checkpoint`,
+               post({})));
   refresh();
 }
 async function pushRows() {

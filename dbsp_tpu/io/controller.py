@@ -16,6 +16,7 @@ transitions happen at drain points), which keeps the protocol identical
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -33,6 +34,17 @@ class ControllerConfig:
     min_batch_records: int = 1_000     # step as soon as this many buffered
     max_buffered_records: int = 100_000  # pause endpoint above this
     flush_interval_s: float = 0.25     # step at least this often when idle
+    # durability (dbsp_tpu.checkpoint): directory for periodic checkpoint
+    # generations and the cadence in controller ticks. 0/None defer to the
+    # env knobs DBSP_TPU_CHECKPOINT_EVERY_TICKS / DBSP_TPU_CHECKPOINT_DIR;
+    # a configured directory with no interval uses the default cadence.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_ticks: int = 0
+    # transport hardening (io/minikafka.py): connect/read timeout, retry
+    # attempts, and exponential-backoff base for broker-backed endpoints
+    transport_timeout_s: float = 10.0
+    transport_retries: int = 5
+    transport_backoff_s: float = 0.05
 
 
 class _InputEndpoint:
@@ -49,6 +61,11 @@ class _InputEndpoint:
         self.error = None
         self.total_records = 0
         self.total_bytes = 0
+        # rows to DROP before feeding the circuit: restore-on-deploy sets
+        # this to the checkpointed consumed count for transports that
+        # replay their stream from the beginning, so replayed rows the
+        # restored state already contains are not double-applied
+        self.skip_rows = 0
 
     def on_chunk(self, chunk: bytes) -> None:
         with self.lock:
@@ -76,6 +93,10 @@ class _InputEndpoint:
     def drain(self) -> List:
         with self.lock:
             rows, self.rows = self.rows, []
+            if self.skip_rows:
+                k = min(self.skip_rows, len(rows))
+                self.skip_rows -= k
+                rows = rows[k:]  # already counted in the restored totals
             self.total_records += len(rows)
             return rows
 
@@ -93,6 +114,8 @@ class _OutputEndpoint:
         self.encoder = encoder
         self.total_records = 0
         self.total_bytes = 0
+        self.error = None  # terminal sink failure (dead output broker)
+        self.pending = None  # batch whose write failed, awaiting retry
         # private delta queue: endpoints never race other handle consumers
         self.cursor = collection.handle.register_consumer()
 
@@ -116,9 +139,29 @@ class Controller:
         self._running = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._step_lock = threading.Lock()
+        self._lifecycle_lock = threading.Lock()  # stop()/pause() idempotency
         # monitor hooks (the SLO watchdog's evaluation site): run after
         # every step and on idle loop passes, on the circuit thread
         self._monitors: List = []
+        # durability: periodic checkpointing into a generation store
+        # (dbsp_tpu.checkpoint). Enabled when a directory is configured
+        # (config field or DBSP_TPU_CHECKPOINT_DIR); the cadence defaults
+        # to checkpoint.DEFAULT_EVERY_TICKS when unset.
+        from dbsp_tpu import checkpoint as _ckpt
+
+        self.checkpoint_dir = config.checkpoint_dir or \
+            os.environ.get("DBSP_TPU_CHECKPOINT_DIR") or None
+        every = config.checkpoint_every_ticks or \
+            int(os.environ.get("DBSP_TPU_CHECKPOINT_EVERY_TICKS", "0"))
+        self.checkpoint_every = every or (
+            _ckpt.DEFAULT_EVERY_TICKS if self.checkpoint_dir else 0)
+        self.last_checkpoint_tick: Optional[int] = None
+        self.checkpoints = 0
+        self.checkpoint_error: Optional[str] = None
+        self._last_ckpt_step = 0
+        # optional obs.FlightRecorder (PipelineObs.attach_controller wires
+        # it) — checkpoint/restore events become SLO-visible through it
+        self.flight = None
 
     # -- endpoint wiring ----------------------------------------------------
     def add_input_endpoint(self, name: str, collection: str,
@@ -128,12 +171,25 @@ class Controller:
         parser = INPUT_FORMATS[fmt](col.dtypes)
         ep = _InputEndpoint(name, col, transport, parser)
         self.inputs[name] = ep
+        configure = getattr(transport, "configure_retry", None)
+        if configure is not None:  # broker-backed transports honor the
+            configure(timeout_s=self.config.transport_timeout_s,  # knobs
+                      retries=self.config.transport_retries,
+                      backoff_s=self.config.transport_backoff_s)
         transport.start(ep.on_chunk, ep.on_eoi)
 
     def add_output_endpoint(self, name: str, collection: str,
                             transport: OutputTransport,
                             fmt: str = "csv") -> None:
         col = self.catalog.output(collection)
+        configure = getattr(transport, "configure_retry", None)
+        if configure is not None:
+            # sinks retry SYNCHRONOUSLY on the circuit thread (the parked
+            # pending batch re-sends next step), so the retry budget here
+            # bounds per-step stall time under a dead output broker
+            configure(timeout_s=self.config.transport_timeout_s,
+                      retries=self.config.transport_retries,
+                      backoff_s=self.config.transport_backoff_s)
         self.outputs[name] = _OutputEndpoint(name, col, transport,
                                              OUTPUT_FORMATS[fmt]())
 
@@ -166,6 +222,109 @@ class Controller:
             self._pushed += int(n)
             self.total_pushed += int(n)
 
+    # -- durability (dbsp_tpu.checkpoint) -----------------------------------
+    def _controller_state(self) -> dict:
+        """The controller-side section of a checkpoint manifest: the step
+        counter plus each input endpoint's consumed high-water mark — the
+        replay position recovery resumes feeds from (exactly-once: rows
+        counted here were fully stepped; rows past them must be re-fed)."""
+        return {
+            "steps": self.steps,
+            "pushed_records": self.total_pushed,
+            "inputs": {name: {"total_records": ep.total_records,
+                              "total_bytes": ep.total_bytes}
+                       for name, ep in self.inputs.items()},
+        }
+
+    def checkpoint(self, path: Optional[str] = None) -> dict:
+        """Write one checkpoint generation (quiesced under the step lock).
+        Uses the configured directory when ``path`` is omitted."""
+        with self._step_lock:
+            return self._checkpoint_locked(path)
+
+    def _checkpoint_locked(self, path: Optional[str] = None) -> dict:
+        from dbsp_tpu import checkpoint as _ckpt
+
+        path = path or self.checkpoint_dir
+        if not path:
+            raise ValueError(
+                "no checkpoint directory configured (set checkpoint_dir "
+                "in the pipeline config or DBSP_TPU_CHECKPOINT_DIR)")
+        tick = getattr(self.handle, "_tick", None)
+        info = _ckpt.save(self.handle, path,
+                          controller=self._controller_state(),
+                          tick=self.steps if tick is None else None,
+                          output_pending={
+                              name: out.pending
+                              for name, out in self.outputs.items()
+                              if out.pending is not None})
+        self.checkpoints += 1
+        self.last_checkpoint_tick = info["tick"]
+        self.checkpoint_error = None
+        self._last_ckpt_step = self.steps
+        if self.flight is not None:
+            self.flight.record("checkpoint", tick=info["tick"],
+                               generation=info["generation"],
+                               linked=info["linked_arrays"],
+                               bytes=info["bytes"])
+        return info
+
+    def _maybe_checkpoint_locked(self) -> None:
+        """Periodic-cadence hook on the circuit thread: a checkpoint
+        failure is recorded (flight + stats) but never takes the pipeline
+        down — serving continues at reduced durability."""
+        if not self.checkpoint_every or not self.checkpoint_dir:
+            return
+        if self.steps - self._last_ckpt_step < self.checkpoint_every:
+            return
+        try:
+            self._checkpoint_locked()
+        except Exception as e:  # noqa: BLE001 — durability is best-effort
+            self.checkpoint_error = f"{type(e).__name__}: {e}"
+            self._last_ckpt_step = self.steps  # back off a full interval
+            if self.flight is not None:
+                self.flight.record("checkpoint",
+                                   error=self.checkpoint_error[:200])
+
+    def restore_from(self, path: Optional[str] = None) -> dict:
+        """Restore the newest valid generation into this controller's
+        driver and adopt the checkpointed controller counters. Call before
+        :meth:`start` (deploy-time recovery).
+
+        Input replay position: each endpoint's checkpointed consumed-row
+        count becomes its SKIP prefix when the transport replays its
+        stream from the beginning (``transport.replays_from_start`` —
+        file inputs), so replayed rows the restored state already
+        contains are dropped, not double-applied. Broker-backed inputs
+        own their position server-side (consumer-group offsets) and
+        resume there; rows fetched-but-unstepped at a crash follow the
+        transport's own at-most-once auto-commit contract."""
+        from dbsp_tpu import checkpoint as _ckpt
+
+        path = path or self.checkpoint_dir
+        if not path:
+            raise ValueError("no checkpoint directory configured")
+        with self._step_lock:
+            info = _ckpt.restore(self.handle, path)
+            c = info.get("controller") or {}
+            self.steps = int(c.get("steps", info["tick"]))
+            self.total_pushed = int(c.get("pushed_records", 0))
+            for name, d in (c.get("inputs") or {}).items():
+                ep = self.inputs.get(name)
+                if ep is not None:
+                    ep.total_records = int(d.get("total_records", 0))
+                    ep.total_bytes = int(d.get("total_bytes", 0))
+                    if getattr(ep.transport, "replays_from_start", False):
+                        with ep.lock:
+                            ep.skip_rows = ep.total_records
+            for name, batch in (info.get("output_pending") or {}).items():
+                out = self.outputs.get(name)
+                if out is not None:  # undelivered sink deltas re-send on
+                    out.pending = batch  # the first post-restore emission
+            self.last_checkpoint_tick = info["tick"]
+            self._last_ckpt_step = self.steps
+        return info
+
     # -- lifecycle (reference: start/pause/stop, controller/mod.rs:196-246) -
     def start(self) -> None:
         self.state = "running"
@@ -176,21 +335,46 @@ class Controller:
             self._thread.start()
 
     def pause(self) -> None:
-        self.state = "paused"
+        with self._lifecycle_lock:
+            if self.state in ("paused", "shutdown"):
+                return  # idempotent under double-call
+            self.state = "paused"
         self._running.clear()
         with self._step_lock:  # quiesce: wait out any in-flight step
             self._flush_driver_locked()
 
     def stop(self) -> None:
-        self.state = "shutdown"
-        self._stop.set()
-        self._running.set()  # unblock
+        with self._lifecycle_lock:
+            already = self.state == "shutdown"
+            self.state = "shutdown"
+            self._stop.set()
+            self._running.set()  # unblock
+            if already:
+                # second stop(): the first one owns teardown — just wait
+                # it out instead of racing the circuit thread join and
+                # re-running the flush/checkpoint sequence
+                if self._thread:
+                    self._thread.join(timeout=10)
+                return
         for ep in self.inputs.values():
             ep.transport.stop()
         if self._thread:
             self._thread.join(timeout=10)
         with self._step_lock:
+            # graceful shutdown: flush any open deferred-validation
+            # interval, then persist a final checkpoint so a clean stop
+            # is always resumable from its exact last tick. ONLY when
+            # there is progress past the last checkpoint: a no-progress
+            # save would be a redundant generation, and on an
+            # aborted/refused deploy it would overwrite a store the
+            # operator may still want to inspect with FRESH-EMPTY state
+            # (turning a strict-mode refusal into a silent reset).
             self._flush_driver_locked()
+            if self.checkpoint_dir and self.steps > self._last_ckpt_step:
+                try:
+                    self._checkpoint_locked()
+                except Exception as e:  # noqa: BLE001 — still shut down
+                    self.checkpoint_error = f"{type(e).__name__}: {e}"
 
     def _flush_driver_locked(self) -> None:
         """Validate + deliver a compiled driver's open interval (no-op for
@@ -266,17 +450,36 @@ class Controller:
         self.handle.step()
         self.steps += 1
         self._emit_outputs()
+        self._maybe_checkpoint_locked()
         self._run_monitors()
 
     def _emit_outputs(self) -> None:
+        from dbsp_tpu.zset.batch import concat_batches
+
         for out in self.outputs.values():
             # per-consumer queue: the HTTP server's /read peeks the same
             # handle, so a destructive take() here would race it
             batch = out.collection.handle.read_consumer(out.cursor)
+            if out.pending is not None:
+                # deltas whose write failed fold into this emission (Z-set
+                # sum — exactly what the consumer queue does for laggards)
+                batch = out.pending if batch is None else concat_batches(
+                    [out.pending, batch]).consolidate().shrink_to_fit()
+                out.pending = None
             if batch is not None and int(batch.live_count()) > 0:
                 data = out.encoder.encode(batch)
-                out.transport.write(data)
-                out.transport.flush()
+                try:
+                    out.transport.write(data)
+                    out.transport.flush()
+                except Exception as e:  # noqa: BLE001 — a dead SINK must
+                    # not kill the circuit thread: record the failure (the
+                    # flight source latches it as degraded), retain the
+                    # batch for the next emission, and keep serving — a
+                    # recovered sink misses nothing
+                    out.error = f"{type(e).__name__}: {e}"
+                    out.pending = batch
+                    continue
+                out.error = None
                 out.total_bytes += len(data)
                 out.total_records += len(batch.to_dict())
 
@@ -296,6 +499,9 @@ class Controller:
             "state": self.state,
             "steps": self.steps,
             "pushed_records": self.total_pushed,
+            "checkpoints": self.checkpoints,
+            "last_checkpoint_tick": self.last_checkpoint_tick,
+            "checkpoint_error": self.checkpoint_error,
             "inputs": {
                 name: {
                     "total_records": ep.total_records,
@@ -303,13 +509,19 @@ class Controller:
                     "buffered_records": ep.buffered(),
                     "paused": ep.paused,
                     "eoi": ep.eoi,
-                    "error": ep.error,
+                    # a transport's terminal failure (dead broker past the
+                    # retry budget) surfaces as the endpoint's error too
+                    "error": ep.error or getattr(ep.transport, "error",
+                                                 None),
+                    "transport_retries": getattr(ep.transport, "retries",
+                                                 0),
                 } for name, ep in self.inputs.items()
             },
             "outputs": {
                 name: {
                     "total_records": out.total_records,
                     "total_bytes": out.total_bytes,
+                    "error": out.error,
                 } for name, out in self.outputs.items()
             },
         }

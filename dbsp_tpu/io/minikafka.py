@@ -26,7 +26,7 @@ import json
 import socket
 import socketserver
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 class MiniKafkaBroker:
@@ -37,21 +37,29 @@ class MiniKafkaBroker:
         self.topics: Dict[str, List[bytes]] = {}
         self.offsets: Dict[Tuple[str, str], int] = {}
         self.lock = threading.Lock()
-        broker = self
+        self._conns: List[socket.socket] = []  # live connections (stop()
+        broker = self                          # severs them — a real death)
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for line in self.rfile:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        req = json.loads(line)
-                        resp = broker._handle(req)
-                    except Exception as e:  # noqa: BLE001 — report + serve
-                        resp = {"error": f"{type(e).__name__}: {e}"}
-                    self.wfile.write(json.dumps(resp).encode() + b"\n")
-                    self.wfile.flush()
+                with broker.lock:
+                    broker._conns.append(self.connection)
+                try:
+                    for line in self.rfile:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            req = json.loads(line)
+                            resp = broker._handle(req)
+                        except Exception as e:  # noqa: BLE001 — report
+                            resp = {"error": f"{type(e).__name__}: {e}"}
+                        self.wfile.write(json.dumps(resp).encode() + b"\n")
+                        self.wfile.flush()
+                finally:
+                    with broker.lock:
+                        if self.connection in broker._conns:
+                            broker._conns.remove(self.connection)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -86,38 +94,123 @@ class MiniKafkaBroker:
         return self
 
     def stop(self) -> None:
+        """Full broker death: stop accepting AND sever every established
+        connection (a bare listener shutdown would leave existing handler
+        threads serving — clients would never notice the 'death')."""
         self.server.shutdown()
         self.server.server_close()
+        with self.lock:
+            conns, self._conns = list(self._conns), []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class _Conn:
-    """One line-JSON request/response TCP connection."""
+    """One line-JSON request/response TCP connection, hardened against a
+    flaky/dead broker: connects and reads under a timeout, and
+    :meth:`request` retries transient transport failures with bounded
+    exponential backoff (reconnecting each attempt). Retries are counted
+    (:attr:`retries` — surfaced as
+    ``dbsp_tpu_io_transport_retries_total{endpoint}``); when the budget is
+    exhausted a :class:`ConnectionError` propagates so the endpoint
+    TERMINATES (degraded pipeline) instead of hanging the controller
+    thread forever. Delivery note: a retried ``produce`` whose response
+    was lost may duplicate (at-least-once); a retried ``fetch`` may skip
+    messages whose offsets the broker already advanced — the transport's
+    auto-commit contract, unchanged."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, timeout_s: float = 10.0,
+                 retries: int = 5, backoff_s: float = 0.05):
         if not address.startswith("mini://"):
             raise ValueError(
                 f"minikafka address must start with 'mini://': {address!r}")
         host, port = address[len("mini://"):].rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=10)
-        self.rfile = self.sock.makefile("rb")
+        self.addr = (host, int(port))
+        self.timeout_s = float(timeout_s)
+        self.max_retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.retries = 0  # transient failures retried (monotone counter)
         self.lock = threading.Lock()
+        self.sock = None
+        self.rfile = None
+        self._connect()
 
-    def request(self, req: dict) -> dict:
-        with self.lock:
-            self.sock.sendall(json.dumps(req).encode() + b"\n")
-            line = self.rfile.readline()
+    def _connect(self) -> None:
+        self.close()
+        self.sock = socket.create_connection(self.addr,
+                                             timeout=self.timeout_s)
+        self.sock.settimeout(self.timeout_s)  # read timeout
+        self.rfile = self.sock.makefile("rb")
+
+    def configure_retry(self, timeout_s: Optional[float] = None,
+                        retries: Optional[int] = None,
+                        backoff_s: Optional[float] = None) -> None:
+        if timeout_s is not None:
+            self.timeout_s = float(timeout_s)
+            if self.sock is not None:
+                self.sock.settimeout(self.timeout_s)
+        if retries is not None:
+            self.max_retries = int(retries)
+        if backoff_s is not None:
+            self.backoff_s = float(backoff_s)
+
+    def _roundtrip(self, payload: bytes) -> bytes:
+        if self.sock is None:
+            self._connect()
+        self.sock.sendall(payload)
+        line = self.rfile.readline()
         if not line:
             raise ConnectionError("minikafka broker closed the connection")
+        return line
+
+    def request(self, req: dict) -> dict:
+        import time
+
+        payload = json.dumps(req).encode() + b"\n"
+        last: Optional[Exception] = None
+        with self.lock:
+            for attempt in range(self.max_retries + 1):
+                if attempt:
+                    self.retries += 1
+                    # bounded exponential backoff, capped at 2s per wait
+                    time.sleep(min(2.0,
+                                   self.backoff_s * (2 ** (attempt - 1))))
+                    try:
+                        self._connect()
+                    except OSError as e:
+                        last = e
+                        continue
+                try:
+                    line = self._roundtrip(payload)
+                    break
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    last = e
+                    self.close()
+            else:
+                raise ConnectionError(
+                    f"minikafka broker {self.addr} unreachable after "
+                    f"{self.max_retries} retries: {last}") from last
         resp = json.loads(line)
         if resp.get("error"):
             raise RuntimeError(resp["error"])
         return resp
 
     def close(self) -> None:
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        for f in (self.rfile, self.sock):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        self.sock = None
+        self.rfile = None
 
 
 class _Record:
@@ -137,6 +230,11 @@ class MiniConsumer:
         self.topics = list(topics)
         self.group = group_id
         self.conn = _Conn(bootstrap_servers)
+
+    @property
+    def retries(self) -> int:
+        """Transport retries this consumer's connection has performed."""
+        return self.conn.retries
 
     def poll(self, timeout_ms: int = 500, max_records: int = 500) -> dict:
         """Fetch once per topic; when everything is empty, block up to
@@ -175,6 +273,10 @@ class MiniProducer:
         self.conn = _Conn(bootstrap_servers)
         self._pending: List[Tuple[str, bytes]] = []
         self.lock = threading.Lock()
+
+    @property
+    def retries(self) -> int:
+        return self.conn.retries
 
     def send(self, topic: str, value: bytes) -> None:
         with self.lock:

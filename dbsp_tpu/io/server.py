@@ -84,7 +84,15 @@ class CircuitServer:
                     # its objectives" (the compiled->host fallback cliff
                     # must be visible here, not only in a counter)
                     out = {"state": c.state,
-                           "mode": getattr(c.handle, "mode", "host")}
+                           "mode": getattr(c.handle, "mode", "host"),
+                           # durability: the tick recovery would resume
+                           # from (None = no checkpoint yet/configured)
+                           "last_checkpoint_tick": getattr(
+                               c, "last_checkpoint_tick", None),
+                           "checkpoints": getattr(c, "checkpoints", 0)}
+                    ck_err = getattr(c, "checkpoint_error", None)
+                    if ck_err:
+                        out["checkpoint_error"] = ck_err
                     if server.obs is not None:
                         server.obs.watch()
                         out["slo"] = server.obs.slo.status_dict()
@@ -185,6 +193,16 @@ class CircuitServer:
                 elif route == "/step":
                     c.step()
                     self._json({"steps": c.steps})
+                elif route == "/checkpoint":
+                    # write one durable checkpoint generation now
+                    # (quiesced under the step lock); 400 when no
+                    # directory is configured
+                    try:
+                        info = c.checkpoint()
+                    except Exception as e:  # noqa: BLE001 — API error
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 400)
+                    self._json(info)
                 elif route.startswith("/input_endpoint/"):
                     name = route.rsplit("/", 1)[1]
                     n = int(self.headers.get("Content-Length", 0))

@@ -26,6 +26,13 @@ ChunkCallback = Callable[[bytes], None]
 class InputTransport:
     name = "input"
 
+    #: True when a restarted transport re-delivers its stream FROM THE
+    #: BEGINNING (file reads). Restore-on-deploy then skips the
+    #: checkpointed consumed-row prefix (Controller.restore_from) so the
+    #: replay is exactly-once; position-keeping transports (broker
+    #: consumer groups) leave this False and resume server-side.
+    replays_from_start = False
+
     def start(self, on_chunk: ChunkCallback, on_eoi: Callable[[], None]) -> None:
         raise NotImplementedError
 
@@ -53,6 +60,7 @@ class FileInputTransport(InputTransport):
     """Streams a file in chunks on a reader thread; optional tail-follow."""
 
     name = "file_input"
+    replays_from_start = True  # re-reads from byte 0 on every (re)start
 
     def __init__(self, path: str, chunk_size: int = 1 << 16,
                  follow: bool = False):
@@ -154,6 +162,25 @@ class KafkaInputTransport(InputTransport):
         self.poll_timeout = poll_timeout
         self._stop = threading.Event()
         self._paused = threading.Event()
+        self._consumer = None
+        self._retry_cfg: dict = {}
+        self.error: str | None = None  # terminal transport failure, if any
+
+    def configure_retry(self, timeout_s: float = 10.0, retries: int = 5,
+                        backoff_s: float = 0.05) -> None:
+        """Controller-config knobs (ControllerConfig.transport_*): applied
+        to the underlying connection at/after consumer construction."""
+        self._retry_cfg = {"timeout_s": timeout_s, "retries": retries,
+                           "backoff_s": backoff_s}
+        conn = getattr(self._consumer, "conn", None)
+        if conn is not None and hasattr(conn, "configure_retry"):
+            conn.configure_retry(**self._retry_cfg)
+
+    @property
+    def retries(self) -> int:
+        """Transport-level retries performed (mini client); 0 for client
+        libraries that retry internally."""
+        return getattr(self._consumer, "retries", 0)
 
     def start(self, on_chunk, on_eoi) -> None:
         if self._kind == "confluent":
@@ -163,6 +190,7 @@ class KafkaInputTransport(InputTransport):
                 "auto.offset.reset": "earliest",
             })
             consumer.subscribe(self.topics)
+            self._consumer = consumer
 
             def run():
                 while not self._stop.is_set():
@@ -178,17 +206,33 @@ class KafkaInputTransport(InputTransport):
             consumer = self._mod.KafkaConsumer(
                 *self.topics, bootstrap_servers=self.brokers,
                 group_id=self.group_id, auto_offset_reset="earliest")
+            self._consumer = consumer
+            if self._retry_cfg and hasattr(
+                    getattr(consumer, "conn", None), "configure_retry"):
+                consumer.conn.configure_retry(**self._retry_cfg)
 
             def run():
                 while not self._stop.is_set():
                     if self._paused.is_set():
                         time.sleep(0.05)
                         continue
-                    polled = consumer.poll(timeout_ms=int(self.poll_timeout * 1000))
+                    try:
+                        polled = consumer.poll(
+                            timeout_ms=int(self.poll_timeout * 1000))
+                    except (ConnectionError, OSError) as e:
+                        # dead broker past the retry budget: TERMINATE the
+                        # endpoint (error + eoi -> controller sees a
+                        # degraded pipeline) instead of hanging the reader
+                        # thread in an unbounded reconnect loop
+                        self.error = f"{type(e).__name__}: {e}"
+                        break
                     for records in polled.values():
                         for r in records:
                             on_chunk(r.value + b"\n")
-                consumer.close()
+                try:
+                    consumer.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
                 on_eoi()
 
         threading.Thread(target=run, daemon=True, name="kafka-input").start()
@@ -219,6 +263,19 @@ class KafkaOutputTransport(OutputTransport):
                 {"bootstrap.servers": brokers})
         else:
             self._producer = self._mod.KafkaProducer(bootstrap_servers=brokers)
+
+    def configure_retry(self, timeout_s: float = 10.0, retries: int = 5,
+                        backoff_s: float = 0.05) -> None:
+        """Controller-config knobs — bound the SYNCHRONOUS per-write stall
+        a dead output broker can inflict on the circuit thread."""
+        conn = getattr(self._producer, "conn", None)
+        if conn is not None and hasattr(conn, "configure_retry"):
+            conn.configure_retry(timeout_s=timeout_s, retries=retries,
+                                 backoff_s=backoff_s)
+
+    @property
+    def retries(self) -> int:
+        return getattr(self._producer, "retries", 0)
 
     def write(self, data: bytes) -> None:
         for line in data.splitlines():

@@ -29,7 +29,15 @@ Routes:
   GET  /pipelines, /pipelines/<name>
   POST /pipelines                    deploy {"name", "program"}
   POST /pipelines/<name>/shutdown
+  POST /pipelines/<name>/checkpoint  write one durable generation now
   DELETE /pipelines/<name>           (409 while running)
+
+Durability: with ``DBSP_TPU_CHECKPOINT_DIR`` set (or a per-pipeline
+``checkpoint_dir`` config key), each pipeline checkpoints periodically
+into its own generation store and deploys RESTORE the newest valid
+generation (see ``dbsp_tpu.checkpoint`` and README §Durability);
+``DBSP_TPU_RESTORE_STRICT=1`` refuses deploys whose restore fails instead
+of starting fresh.
 """
 
 from __future__ import annotations
@@ -99,8 +107,10 @@ class Pipeline:
         # host scheduler: the recorded reason (the fallback perf cliff must
         # be visible in deploy status, not buried in a counter)
         self.fallback_reason: Optional[str] = None
+        # tick restored from a checkpoint at deploy (None = fresh start)
+        self.restored_tick: Optional[int] = None
 
-    def compile_and_start(self) -> None:
+    def compile_and_start(self, _allow_restore: bool = True) -> None:
         from dbsp_tpu.circuit import Runtime
         from dbsp_tpu.io import Catalog, CircuitServer, build_controller
         from dbsp_tpu.obs import PipelineObs
@@ -108,9 +118,12 @@ class Pipeline:
 
         self.status = "compiling"
         # the pipeline config's `slo` section configures this pipeline's
-        # watchdog objectives (obs/slo.py); omitted = fallback-only SLOs
-        self.obs = PipelineObs(name=self.name,
-                               slo=(self.config or {}).get("slo"))
+        # watchdog objectives (obs/slo.py); omitted = fallback-only SLOs.
+        # Kept across the rebuild-after-failed-restore pass so the
+        # recorded restore flight event/incident survives.
+        if self.obs is None:
+            self.obs = PipelineObs(name=self.name,
+                                   slo=(self.config or {}).get("slo"))
         # "workers" was already an accepted pipeline-config key
         # (io/config.py known_sections) but never honored: deploy over an
         # SPMD worker mesh when requested so managed pipelines shard
@@ -161,15 +174,64 @@ class Pipeline:
         else:
             profiler = CPUProfiler(handle.circuit)
             self.obs.attach_circuit(handle.circuit)
-        self.controller = build_controller(driver, catalog,
-                                           self.config or {})
+        cfgd = dict(self.config or {})
+        env_dir = os.environ.get("DBSP_TPU_CHECKPOINT_DIR")
+        if env_dir and not cfgd.get("checkpoint_dir"):
+            # per-pipeline subdirectory under the fleet checkpoint root
+            cfgd["checkpoint_dir"] = os.path.join(env_dir, self.name)
+        self.controller = build_controller(driver, catalog, cfgd)
         self.obs.attach_controller(self.controller)
+        if not self._restore_on_deploy(_allow_restore):
+            # the failed restore may have mutated engine state before
+            # raising — serving it as "fresh" would double-apply replayed
+            # inputs. Tear the half-restored build down and rebuild from
+            # scratch with restore disabled (the flight event, latched
+            # fallback_reason, and obs survive the second pass).
+            self.controller.stop()  # no-progress stop: writes nothing
+            self.controller = None
+            return self.compile_and_start(_allow_restore=False)
         self.server = CircuitServer(self.controller, profiler=profiler,
                                     obs=self.obs, findings=findings)
         self.server.start()
         self.port = self.server.port
         self.controller.start()
         self.status = "running"
+
+    def _restore_on_deploy(self, allow_restore: bool = True) -> bool:
+        """Recovery: when the pipeline's checkpoint directory holds
+        generations, restore the newest valid one before serving. A
+        corrupted CURRENT generation falls back to the previous one and
+        records a ``restore`` flight event (one SLO-visible incident). A
+        restore that fails outright records the event with its reason and
+        latches ``fallback_reason``; unless ``DBSP_TPU_RESTORE_STRICT=1``
+        (which refuses the deploy — durability-critical fleets prefer a
+        dead pipeline over a silent state reset), the caller REBUILDS the
+        engine and starts fresh (returns False: a failed restore can
+        leave partially-applied state behind, unsafe to serve)."""
+        from dbsp_tpu import checkpoint as ckpt
+
+        path = self.controller.checkpoint_dir
+        if not allow_restore or not path or not ckpt.exists(path):
+            return True
+        try:
+            info = self.controller.restore_from()
+        except Exception as e:  # noqa: BLE001 — surfaced + policy below
+            reason = f"{type(e).__name__}: {e}"
+            self.obs.flight.record("restore", ok=False, reason=reason[:300])
+            if os.environ.get("DBSP_TPU_RESTORE_STRICT", "0") != "0":
+                raise RuntimeError(
+                    f"restore-on-deploy failed (strict mode): {reason}")
+            self.fallback_reason = f"restore failed: {reason[:200]}"
+            return False
+        self.restored_tick = info["tick"]
+        self.obs.flight.record(
+            "restore", ok=True, tick=info["tick"],
+            generation=info.get("generation"),
+            fallback_from=info.get("fallback_from"),
+            reason=(f"generation {info.get('fallback_from')} corrupt; "
+                    f"restored {info.get('name')}"
+                    if info.get("fallback_from") else None))
+        return True
 
     def stop(self) -> None:
         if self.controller:
@@ -197,6 +259,9 @@ class Pipeline:
         out = {"name": self.name, "status": self.status, "port": self.port,
                "error": self.error, "mode": self.mode,
                "fallback_reason": self.fallback_reason,
+               "restored_tick": self.restored_tick,
+               "last_checkpoint_tick": getattr(
+                   self.controller, "last_checkpoint_tick", None),
                "program_version": self.program.get("version")}
         out["health"] = self.health()
         if self.obs is not None:
@@ -393,6 +458,13 @@ class PipelineManager:
                             parts[3] == "shutdown":
                         mgr.pipelines[parts[2]].stop()
                         self._json(mgr.pipelines[parts[2]].describe())
+                    elif len(parts) == 4 and parts[1] == "pipelines" and \
+                            parts[3] == "checkpoint":
+                        with mgr.lock:
+                            p = mgr.pipelines.get(parts[2])
+                        if p is None or p.controller is None:
+                            return self._json({"error": "not found"}, 404)
+                        self._json(p.controller.checkpoint())
                     else:
                         self._json({"error": "no route"}, 404)
                 except Exception as e:  # surface as API error, keep serving

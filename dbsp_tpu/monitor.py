@@ -15,6 +15,13 @@ the *incident-capture* layer (per-tick events with attributed causes in a
 bounded ring; SLO breaches freeze windows into ``/incidents`` reports).
 All attach via the same ``register_*_event_handler`` API and compose
 freely.
+
+Durability events live in the same incident-capture layer: periodic
+checkpoints and deploy-time restores (``dbsp_tpu.checkpoint``) record
+``checkpoint``/``restore`` flight events, and a corrupted-generation
+fallback or failed restore surfaces as a ``restore`` incident at
+``/incidents`` (README §Durability) — the oracle here never sees them
+because they are control-plane actions, not scheduler protocol.
 """
 
 from __future__ import annotations

@@ -27,6 +27,17 @@ monotone id — wall-clock ``ts`` and monotonic ``t_ns``):
   ``watermark``       event-time lag sample of a watermark operator
   ``compile``         a step-program (re)trace was observed
   ``fallback``        compiled->host fallback, with the recorded reason
+  ``checkpoint``      one durable checkpoint generation written (tick,
+                      generation, linked blob count) — or its failure
+                      (``error``)
+  ``restore``         a checkpoint restore: ``ok``, the restored tick, and
+                      ``fallback_from`` when a corrupted newer generation
+                      was skipped (the SLO watchdog turns these into
+                      one-shot ``restore`` incidents; a failed restore
+                      latches a degraded state)
+  ``transport``       terminal transport failure of an input endpoint
+                      (dead broker past the retry budget) — latched by the
+                      watchdog as a degraded state
 
 Overhead discipline: ``record()`` is one dict build + deque append under a
 lock — no device syncs, no formatting; tests/test_flight.py gates it at
@@ -48,6 +59,7 @@ from typing import Deque, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
     "FlightRecorder", "CompiledFlightSource", "HostFlightSource",
+    "ControllerFlightSource",
     "spike_causes", "dominant_cause", "trace_slice", "ticks_from_samples",
 ]
 
@@ -308,6 +320,45 @@ class CompiledFlightSource:
             self._consolidate_seen[path] = count
         if delta:
             self.flight.record("consolidate", paths=delta)
+
+
+class ControllerFlightSource:
+    """IO-layer feeder: controller endpoint state -> ring events.
+
+    Polls ``Controller.stats()`` (host dict reads, no device work) and
+    records one ``transport`` event per endpoint-error TRANSITION — a dead
+    broker or poisoned feed becomes SLO-visible (the watchdog latches it
+    as a degraded state) instead of living only in /stats. Checkpoint
+    events are recorded by the controller itself (``controller.flight``);
+    this source only watches for failures the controller cannot announce
+    synchronously."""
+
+    def __init__(self, controller, flight: FlightRecorder):
+        self.controller = controller
+        self.flight = flight
+        self._errors_seen: Dict[str, str] = {}
+
+    def poll(self) -> None:
+        try:
+            stats = self.controller.stats()
+        except Exception:
+            return  # a mid-teardown race must not kill the watch pass
+        for section in ("inputs", "outputs"):
+            for name, ep in stats.get(section, {}).items():
+                err = ep.get("error")
+                key = f"{section}/{name}"
+                prev = self._errors_seen.get(key)
+                if err and prev != err:
+                    self._errors_seen[key] = err
+                    self.flight.record("transport", endpoint=name,
+                                       error=str(err)[:200])
+                elif not err and prev:
+                    # RECOVERY transition: a transient sink blip (the
+                    # pending-batch retry delivered) must not leave the
+                    # pipeline latched degraded forever
+                    del self._errors_seen[key]
+                    self.flight.record("transport", endpoint=name,
+                                       recovered=True)
 
 
 class HostFlightSource:

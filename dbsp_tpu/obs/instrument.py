@@ -328,7 +328,18 @@ class ControllerInstrumentation:
         reg.counter("dbsp_tpu_io_pushed_records_total",
                     "Rows pushed via the host API / HTTP endpoints"
                     ).set_total(s["pushed_records"])
+        reg.counter("dbsp_tpu_io_checkpoints_total",
+                    "Durable checkpoint generations written by this "
+                    "controller").set_total(s.get("checkpoints", 0))
+        # (the tick the last checkpoint covers is NOT a metric — it is an
+        # index, not a count/unit; read it from /status or /stats)
         for name, ep in s["inputs"].items():
+            reg.counter("dbsp_tpu_io_transport_retries_total",
+                        "Transient transport failures retried with "
+                        "backoff (connect/read), per input endpoint",
+                        labels=("endpoint",)).labels(
+                            endpoint=name).set_total(
+                                ep.get("transport_retries", 0))
             reg.counter("dbsp_tpu_io_input_records_total",
                         "Rows ingested per input endpoint",
                         labels=("endpoint",)).labels(
@@ -406,7 +417,17 @@ class PipelineObs:
                                        spans=self.spans)
 
     def attach_controller(self, controller) -> ControllerInstrumentation:
+        from dbsp_tpu.obs.flight import ControllerFlightSource
+
         add_monitor = getattr(controller, "add_monitor", None)
         if add_monitor is not None:
             add_monitor(self.watch)
+        # checkpoint/restore events become SLO-visible: the controller
+        # records them on this pipeline's ring, and the flight source
+        # watches endpoint/transport failures the controller cannot
+        # announce synchronously
+        if hasattr(controller, "flight"):
+            controller.flight = self.flight
+        self._flight_sources.append(
+            ControllerFlightSource(controller, self.flight))
         return ControllerInstrumentation(controller, self.registry)

@@ -54,12 +54,14 @@ __all__ = ["SLOConfig", "SLOWatchdog", "SLO_KEYS"]
 
 # the closed set of objectives (also the only values the `slo` metric
 # label ever takes — tools/check_metrics.py keeps label NAMES closed; this
-# keeps the value set enumerable too)
+# keeps the value set enumerable too). ``restore`` is a one-shot durability
+# incident (corrupted-generation fallback / failed restore at deploy);
+# ``transport`` latches a terminal input-endpoint failure (dead broker).
 SLO_KEYS = ("p99_tick", "tick_abs", "watermark_lag", "fallback_to_host",
-            "overflow_replays")
+            "overflow_replays", "restore", "transport")
 
 # SLOs whose active breach means the pipeline still serves, just degraded
-_DEGRADED_ONLY = ("fallback_to_host",)
+_DEGRADED_ONLY = ("fallback_to_host", "transport")
 
 
 class SLOConfig:
@@ -137,6 +139,13 @@ class SLOWatchdog:
         self._replay_ts: Deque[float] = deque(maxlen=1024)
         self._wm_lag: Optional[float] = None
         self._fallback: Optional[dict] = None
+        # per-endpoint latched transport failures; a recovery event
+        # (transient sink blip whose retry delivered) un-latches its
+        # endpoint, so only endpoints CURRENTLY broken keep the pipeline
+        # degraded
+        self._transport: Dict[str, dict] = {}
+        self._restore_failed: Optional[dict] = None  # latched failed restore
+        self._restores: List[dict] = []  # new restore events this pass
         self._active: Dict[str, dict] = {}  # slo -> open incident
         self._incidents: Deque[dict] = deque(maxlen=max_incidents)
         self._ids = 0
@@ -193,6 +202,19 @@ class SLOWatchdog:
                 self._wm_lag = ev.get("lag")
             elif k == "fallback":
                 self._fallback = ev
+            elif k == "transport":
+                if ev.get("recovered"):
+                    self._transport.pop(ev.get("endpoint", ""), None)
+                else:
+                    self._transport[ev.get("endpoint", "")] = ev
+            elif k == "restore":
+                # one-shot durability incidents, handled below (outside
+                # the episode machinery: a restore is an EVENT, not a
+                # condition that can stay in breach)
+                if ev.get("ok") is False:
+                    self._restore_failed = ev
+                if ev.get("ok") is False or ev.get("fallback_from"):
+                    self._restores.append(ev)
         lats = sorted(t.get("latency_ns", 0) for t in self._ticks)
         p50 = lats[len(lats) // 2] if lats else 0
         p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else 0
@@ -221,8 +243,27 @@ class SLOWatchdog:
                            "overflow"))
         if cfg.fallback_to_host and self._fallback is not None:
             checks.append(("fallback_to_host", True, 1.0, 0.0, "fallback"))
+        if self._transport or "transport" in self._active:
+            # also evaluated (un-breached) while an incident is open so
+            # recovery RESOLVES the episode instead of freezing it active
+            checks.append(("transport", bool(self._transport),
+                           float(len(self._transport)), 0.0, "transport"))
 
         opened: List[dict] = []
+        # one-shot restore incidents: a corrupted-generation fallback or a
+        # failed restore each produce EXACTLY ONE incident — opened and
+        # resolved in the same pass (the triggering event cannot recur),
+        # never entering the episode/active machinery
+        for ev in self._restores:
+            inc = self._open_incident("restore", 1.0, 0.0, "restore",
+                                      [], p50)
+            inc["resolved_ts"] = time.time()
+            del self._active["restore"]
+            for field in ("reason", "fallback_from", "tick", "generation"):
+                if ev.get(field) is not None:
+                    inc[field] = ev[field]
+            opened.append(inc)
+        self._restores = []
         breaching_ticks = [t for t in new_ticks if t.get("causes")]
         for slo, breached, observed, threshold, fixed_cause in checks:
             inc = self._active.get(slo)
@@ -311,18 +352,24 @@ class SLOWatchdog:
             active = set(self._active)
         if active - set(_DEGRADED_ONLY):
             return "unhealthy"
-        if active or self._fallback is not None:
+        if active or self._fallback is not None or self._transport or \
+                self._restore_failed is not None:
             return "degraded"
         return "ok"
 
     @property
     def fallback_reason(self) -> Optional[str]:
-        """The latched compiled->host fallback reason, if any — DURABLE:
-        the watchdog retains it after the one-shot flight event ages out
-        of the bounded ring (consumers must read it here, not rescan the
-        ring)."""
+        """The latched compiled->host fallback reason — or, failing that,
+        a latched failed-restore reason — if any. DURABLE: the watchdog
+        retains it after the one-shot flight event ages out of the bounded
+        ring (consumers must read it here, not rescan the ring)."""
         fb = self._fallback
-        return fb.get("reason") if fb is not None else None
+        if fb is not None:
+            return fb.get("reason")
+        rf = self._restore_failed
+        if rf is not None:
+            return f"restore failed: {rf.get('reason')}"
+        return None
 
     def status_dict(self) -> dict:
         with self._lock:

@@ -39,15 +39,21 @@ class ZSetInput(SourceOperator):
 
         rt = Runtime.current()
         workers = rt.workers if rt is not None else 1
+        # SWAP the buffers out FIRST (one atomic-under-the-GIL statement):
+        # consolidation below can jit-compile for hundreds of ms, and rows
+        # pushed from other threads during that window must land in the
+        # NEXT tick's buffer — a clear-after-read here destroyed them
+        # (found by the slow-consumer fault test: a stalling sink widened
+        # the eval window and rows pushed mid-step vanished)
+        rows, self._rows = self._rows, []
+        batches, self._batches = self._batches, []
         # canonicalize each part once, then fold with rank-merges — pushed
         # batches that are already consolidated (the common generator path)
         # are never re-sorted
-        parts = [b if done else b.consolidate()
-                 for b, done in self._batches]
-        if self._rows:
+        parts = [b if done else b.consolidate() for b, done in batches]
+        if rows:
             parts.append(Batch.from_tuples(
-                self._rows, self.key_dtypes, self.val_dtypes))
-        self._rows, self._batches = [], []
+                rows, self.key_dtypes, self.val_dtypes))
         if not parts:
             return Batch.empty(self.key_dtypes, self.val_dtypes,
                                lead=(workers,) if workers > 1 else ())

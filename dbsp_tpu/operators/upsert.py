@@ -62,8 +62,11 @@ class UpsertInput(SourceOperator):
         if not self._pending:
             return Batch.empty(self.key_dtypes, self.val_dtypes,
                                lead=(workers,) if workers > 1 else ())
-        items = list(self._pending.items())
-        self._pending.clear()
+        # swap-first (atomic under the GIL): upserts arriving from other
+        # threads during the (jit-compiling) drain below belong to the
+        # next tick — a clear-after-read would destroy them
+        pending, self._pending = self._pending, {}
+        items = list(pending.items())
 
         # touched keys (sorted batch of unique keys)
         qcap = bucket_cap(len(items))
@@ -107,8 +110,11 @@ class UpsertInput(SourceOperator):
         """Drain pending upserts as a COMMAND batch for the compiled path
         (cnodes.CUpsertIn): unique sorted keys; weight +1 rows carry the
         new values, -1 rows are deletes (values zero-filled)."""
-        items = sorted(self._pending.items())
-        self._pending.clear()
+        # swap-first (atomic under the GIL): commands upserted from other
+        # threads while this drain runs must land in the next tick, not
+        # vanish in a clear-after-read (same race as ZSetInput.eval)
+        pending, self._pending = self._pending, {}
+        items = sorted(pending.items())
         rows = []
         for k, v in items:
             if v is None:

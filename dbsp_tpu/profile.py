@@ -24,6 +24,14 @@ watchdog freezes breach windows into self-contained ``/incidents``
 reports. Oracle (monitor.py), measurement (this file + instrument.py),
 and incident capture are separable concerns; all can attach to one
 circuit simultaneously and none depends on another.
+
+Durability note: checkpoint/restore activity (``dbsp_tpu.checkpoint``)
+shows up in the incident-capture layer, not here — ``checkpoint`` flight
+events carry per-generation timing/size, restores (including the
+corrupted-generation fallback) emit ``restore`` incidents at
+``/incidents``, and ``/status`` carries ``last_checkpoint_tick``
+(README §Durability). A profiler dump describes the live process; after a
+restore it restarts from zero, which is itself a useful recovery marker.
 """
 
 from __future__ import annotations

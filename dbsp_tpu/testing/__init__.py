@@ -1,0 +1,19 @@
+"""``dbsp_tpu.testing`` — fault-injection and robustness harnesses.
+
+:mod:`dbsp_tpu.testing.faults` is the deterministic fault harness behind
+the durability acceptance tests: seeded SIGKILL-at-tick of a pipeline
+subprocess, transport connect/read failure injection, slow-consumer
+stalls, and checkpoint corruption — see README §Durability.
+"""
+
+from dbsp_tpu.testing.faults import (FaultPlan, StallingOutputTransport,
+                                     corrupt_checkpoint, read_deltas,
+                                     read_status, run_child,
+                                     spawn_child, transport_chaos,
+                                     wait_for_tick)
+
+__all__ = [
+    "FaultPlan", "StallingOutputTransport", "corrupt_checkpoint",
+    "read_deltas", "read_status", "run_child", "spawn_child",
+    "transport_chaos", "wait_for_tick",
+]

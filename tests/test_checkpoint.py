@@ -1,0 +1,634 @@
+"""Durable checkpoint/restore: format v2, compiled-engine coverage,
+generations + corruption fallback, incremental hard-links, retained-feed
+replay, the state-schema lint, and the checkpoint-overhead bound.
+
+(The crash-safety side — SIGKILL mid-stream + restore-on-deploy — lives in
+tests/test_faults.py on the fault-injection harness.)
+"""
+
+import json
+import os
+import time
+
+import pytest
+import numpy as np
+import jax.numpy as jnp
+
+from dbsp_tpu import checkpoint as ckpt
+from dbsp_tpu.circuit import Runtime
+from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+from dbsp_tpu.operators import Count, Max, add_input_zset
+from dbsp_tpu.zset.batch import Batch
+
+
+def _agg_build(c):
+    s, h = add_input_zset(c, [jnp.int64], [jnp.int32])
+    return h, s.aggregate(Count()).integrate().output()
+
+
+def _feed(h, t, n=24):
+    h.extend([((i % 7, 10 + t + i), 1) for i in range(n)])
+
+
+# ---------------------------------------------------------------------------
+# compiled-engine round trip
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_checkpoint_roundtrip(tmp_path):
+    """Save a compiled serving driver mid-stream; a freshly compiled
+    driver restores it and the continued output matches an uninterrupted
+    run exactly."""
+    path = str(tmp_path / "ck")
+    handle, (h, out) = Runtime.init_circuit(1, _agg_build)
+    drv = CompiledCircuitDriver(handle)
+    for t in range(6):
+        _feed(h, t)
+        drv.step()
+    ref = out.to_dict()
+
+    handle2, (h2, out2) = Runtime.init_circuit(1, _agg_build)
+    drv2 = CompiledCircuitDriver(handle2)
+    for t in range(3):
+        _feed(h2, t)
+        drv2.step()
+    info = ckpt.save(drv2, path)
+    assert info["tick"] == 3 and info["generation"] == 1
+
+    handle3, (h3, out3) = Runtime.init_circuit(1, _agg_build)
+    drv3 = CompiledCircuitDriver(handle3)
+    r = ckpt.restore(drv3, path)
+    assert r["tick"] == 3 and r["fallback_from"] is None
+    assert drv3._tick == 3
+    for t in range(3, 6):
+        _feed(h3, t)
+        drv3.step()
+    assert out3.to_dict() == ref
+
+    # the SOURCE driver is untouched by the save (state copied, not moved)
+    for t in range(3, 6):
+        _feed(h2, t)
+        drv2.step()
+    assert out2.to_dict() == ref
+
+
+def test_retained_window_checkpoint_replays_open_interval(tmp_path):
+    """With a validation cadence > 1, a checkpoint taken mid-interval
+    persists the interval-start snapshot plus the retained feeds; restore
+    replays them so the resumed stream is exact."""
+    path = str(tmp_path / "ck")
+    handle, (h, out) = Runtime.init_circuit(1, _agg_build)
+    drv = CompiledCircuitDriver(handle, validate_every=3)
+    for t in range(8):
+        _feed(h, t)
+        drv.step()
+    drv.flush()
+    ref = out.to_dict()
+
+    handle2, (h2, out2) = Runtime.init_circuit(1, _agg_build)
+    drv2 = CompiledCircuitDriver(handle2, validate_every=3)
+    for t in range(5):  # one validated interval + 2 retained ticks
+        _feed(h2, t)
+        drv2.step()
+    assert len(drv2._retained) == 2
+    info = ckpt.save(drv2, path)
+    assert info["tick"] == 3  # the validated interval-start tick
+
+    handle3, (h3, out3) = Runtime.init_circuit(1, _agg_build)
+    drv3 = CompiledCircuitDriver(handle3, validate_every=3)
+    ckpt.restore(drv3, path)
+    assert drv3._tick == 5 and len(drv3._retained) == 2
+    for t in range(5, 8):
+        _feed(h3, t)
+        drv3.step()
+    drv3.flush()
+    assert out3.to_dict() == ref
+
+
+def test_structure_mismatch_rejected_compiled(tmp_path):
+    path = str(tmp_path / "ck")
+    handle, (h, out) = Runtime.init_circuit(1, _agg_build)
+    drv = CompiledCircuitDriver(handle)
+    _feed(h, 0)
+    drv.step()
+    ckpt.save(drv, path)
+
+    def other(c):
+        s, h2 = add_input_zset(c, [jnp.int64], [jnp.int32])
+        return h2, s.aggregate(Max(0)).integrate().output()
+
+    handle2, _ = Runtime.init_circuit(1, other)
+    drv2 = CompiledCircuitDriver(handle2)
+    with pytest.raises(ckpt.CheckpointError, match="structure differs"):
+        ckpt.restore(drv2, path)
+
+
+# ---------------------------------------------------------------------------
+# generations: atomicity, corruption fallback, incremental hard-links
+# ---------------------------------------------------------------------------
+
+
+def _drv_at(ticks):
+    handle, (h, out) = Runtime.init_circuit(1, _agg_build)
+    drv = CompiledCircuitDriver(handle)
+    for t in range(ticks):
+        _feed(h, t)
+        drv.step()
+    return drv, h, out
+
+
+def test_generations_rotate_and_prune(tmp_path):
+    path = str(tmp_path / "ck")
+    drv, h, out = _drv_at(2)
+    for i in range(ckpt.KEEP_GENERATIONS + 2):
+        ckpt.save(drv, path)
+    gens = sorted(n for n in os.listdir(path) if n.startswith("gen-"))
+    assert len(gens) == ckpt.KEEP_GENERATIONS
+    with open(os.path.join(path, "CURRENT")) as f:
+        assert f.read().strip() == gens[-1]
+
+
+def test_corrupt_blob_falls_back_to_previous_generation(tmp_path):
+    from dbsp_tpu.testing.faults import corrupt_checkpoint
+
+    path = str(tmp_path / "ck")
+    drv, h, out = _drv_at(3)
+    ckpt.save(drv, path)
+    ref = out.to_dict()
+    _feed(h, 3)
+    drv.step()
+    ckpt.save(drv, path)
+    corrupt_checkpoint(path, kind="blob", seed=7)
+
+    handle2, (h2, out2) = Runtime.init_circuit(1, _agg_build)
+    drv2 = CompiledCircuitDriver(handle2)
+    r = ckpt.restore(drv2, path)
+    # newest generation corrupt -> previous one restored, and the skip is
+    # reported for the caller's SLO incident
+    assert r["fallback_from"] == "gen-00000002"
+    assert r["name"] == "gen-00000001" and r["tick"] == 3
+    # functional: the restored engine serves the generation-1 state
+    from dbsp_tpu.compiled.compiler import CompiledHandle  # noqa: F401
+
+    assert drv2.ch.states  # decoded without error
+    handle_ref, (h_ref, out_ref) = Runtime.init_circuit(1, _agg_build)
+    # ... and continues identically to a run checkpointed at tick 3
+    for t in range(3):
+        _feed(h_ref, t)
+    # (reference comparison happens in the roundtrip tests; here the
+    # contract under test is the fallback itself)
+
+
+def test_corrupt_manifest_and_truncation_fall_back(tmp_path):
+    from dbsp_tpu.testing.faults import corrupt_checkpoint
+
+    path = str(tmp_path / "ck")
+    drv, h, out = _drv_at(2)
+    ckpt.save(drv, path)
+    _feed(h, 2)
+    drv.step()
+    ckpt.save(drv, path)
+    corrupt_checkpoint(path, kind="manifest")
+    name, payload, fallback = ckpt.load_manifest(path)
+    assert fallback == "gen-00000002" and name == "gen-00000001"
+
+    # corrupt the remaining generation too: restore must fail loudly
+    ckpt.save(drv, path)  # gen 3
+    for g in [n for n in os.listdir(path) if n.startswith("gen-")]:
+        p = os.path.join(path, g, "manifest.json")
+        with open(p, "r+b") as f:
+            f.seek(5)
+            f.write(b"XXXX")
+    with pytest.raises(ckpt.CheckpointError, match="no valid checkpoint"):
+        ckpt.load_manifest(path)
+
+
+def test_incremental_save_hard_links_clean_deep_levels(tmp_path):
+    path = str(tmp_path / "ck")
+    drv, h, out = _drv_at(8)
+    drv.ch.maintain()  # move rows into deep levels
+    i1 = ckpt.save(drv, path)
+    _feed(h, 8)
+    drv.step()  # dirties l0 only; deep levels stay version-clean
+    i2 = ckpt.save(drv, path)
+    assert i2["linked_arrays"] > 0
+    # linked blobs are literal hard links to the previous generation
+    g1 = os.path.join(path, "gen-00000001")
+    g2 = os.path.join(path, "gen-00000002")
+    shared = 0
+    for name in os.listdir(g2):
+        if not name.endswith(".npy"):
+            continue
+        p1, p2 = os.path.join(g1, name), os.path.join(g2, name)
+        if os.path.exists(p1) and os.path.samefile(p1, p2):
+            shared += 1
+    assert shared >= i2["linked_arrays"] > 0
+    # and the linked generation restores correctly
+    handle2, (h2, out2) = Runtime.init_circuit(1, _agg_build)
+    drv2 = CompiledCircuitDriver(handle2)
+    r = ckpt.restore(drv2, path)
+    assert r["generation"] == 2
+    flat_a = [np.asarray(x) for x in
+              __import__("jax").tree_util.tree_leaves(drv.ch.states)]
+    flat_b = [np.asarray(x) for x in
+              __import__("jax").tree_util.tree_leaves(drv2.ch.states)]
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# encoder/decoder round trip: adversarial state pytrees (hypothesis)
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip(tree, tmp_path, tag):
+    """Encode -> write generation -> load -> decode; returns the decoded
+    tree (full disk round trip, checksums verified)."""
+    path = str(tmp_path / f"rt-{tag}")
+    enc = ckpt._Encoder()
+    payload = {"engine": "host", "structure": [], "tick": 0,
+               "states": {"t": enc.encode(tree)}}
+    ckpt._write_generation(path, payload, enc, {})
+    name, loaded, fallback = ckpt.load_manifest(path)
+    assert fallback is None
+    dec = ckpt._Decoder(ckpt._make_loader(os.path.join(path, name), loaded))
+    return dec.decode(loaded["states"]["t"])
+
+
+def _assert_tree_equal(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, f"structure mismatch: {ta} != {tb}"
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype, (xa.dtype, ya.dtype)
+        assert xa.shape == ya.shape
+        assert np.array_equal(xa, ya)
+
+
+def test_checkpoint_encoder_property(tmp_path):
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    dtypes = st.sampled_from(["int32", "int64", "bool", "float32"])
+
+    def arrays(shape_strategy):
+        return st.tuples(dtypes, shape_strategy).map(
+            lambda dt_sh: np.arange(
+                int(np.prod(dt_sh[1])) or 0).reshape(dt_sh[1]).astype(
+                    dt_sh[0]) % 2 if dt_sh[0] == "bool" else
+            (np.arange(int(np.prod(dt_sh[1])) or 0,
+                       dtype=np.int64).reshape(dt_sh[1]) * 37 % 1009
+             ).astype(dt_sh[0]))
+
+    shapes = st.sampled_from([(0,), (1,), (5,), (8,), (2, 8), (3, 0)])
+
+    def batches(draw_sharded=True):
+        def mk(args):
+            dt, cap, nk, nv, sharded, tag_runs = args
+            lead = (2,) if sharded else ()
+            cols = tuple(
+                (np.arange(cap, dtype=np.int64) * (7 + i) % 97)
+                .astype(dt).reshape(1, cap).repeat(lead[0], 0)
+                if lead else
+                (np.arange(cap, dtype=np.int64) * (7 + i) % 97).astype(dt)
+                for i in range(nk + nv))
+            w = (np.arange(cap, dtype=np.int64) % 3 - 1)
+            if lead:
+                w = w.reshape(1, cap).repeat(lead[0], 0)
+            runs = None
+            if tag_runs and cap and cap % 2 == 0:
+                runs = (cap // 2, cap // 2)
+            return Batch(tuple(jnp.asarray(c) for c in cols[:nk]),
+                         tuple(jnp.asarray(c) for c in cols[nk:]),
+                         jnp.asarray(w), runs)
+
+        return st.tuples(dtypes, st.sampled_from([0, 1, 4, 8]),
+                         st.integers(1, 2), st.integers(0, 2),
+                         st.booleans() if draw_sharded else st.just(False),
+                         st.booleans()).map(mk)
+
+    leaves = st.one_of(
+        arrays(shapes), batches(),
+        st.integers(-2**40, 2**40), st.booleans(),
+        st.text(max_size=8), st.none(),
+        st.floats(allow_nan=False, allow_infinity=False, width=32))
+    trees = st.recursive(
+        leaves,
+        lambda kids: st.one_of(
+            st.lists(kids, max_size=3).map(tuple),
+            st.lists(kids, max_size=3),
+            st.dictionaries(st.text(
+                alphabet="abcdefgh", min_size=1, max_size=4), kids,
+                max_size=3)),
+        max_leaves=8)
+
+    counter = [0]
+
+    @given(tree=trees)
+    @settings(max_examples=25, deadline=None,
+              suppress_health_check=[HealthCheck.too_slow])
+    def run(tree):
+        counter[0] += 1
+        got = _roundtrip(tree, tmp_path, counter[0])
+        _assert_tree_equal(tree, got)
+        # sorted-run aux metadata survives (part of batch identity)
+        import jax
+
+        for a, b in zip(jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, Batch)),
+                jax.tree_util.tree_leaves(
+                    got, is_leaf=lambda x: isinstance(x, Batch))):
+            if isinstance(a, Batch):
+                assert isinstance(b, Batch) and a.runs == b.runs
+
+    run()
+
+
+def test_checkpoint_encoder_adversarial_cases(tmp_path):
+    """Deterministic companion to the hypothesis property (which skips
+    when hypothesis is absent): handcrafted adversarial pytrees — mixed
+    dtypes incl. int64/bool, EMPTY arrays, sharded [W, cap] batches with
+    runs aux, scalars, deep nesting — restore bit-identically."""
+    cases = {
+        "dtypes": {
+            "i64": jnp.arange(5, dtype=jnp.int64) * (1 << 40),
+            "i32": jnp.arange(5, dtype=jnp.int32) - 3,
+            "b": jnp.asarray([True, False, True]),
+            "f32": jnp.asarray([0.5, -1.25, 3e12], jnp.float32),
+        },
+        "empty": (jnp.zeros((0,), jnp.int64), np.zeros((3, 0), np.int32)),
+        "sharded_batch": Batch(
+            (jnp.arange(16, dtype=jnp.int64).reshape(2, 8),),
+            (jnp.arange(16, dtype=jnp.int32).reshape(2, 8),),
+            (jnp.arange(16, dtype=jnp.int64).reshape(2, 8) % 3 - 1),
+            runs=(4, 4)),
+        "untagged_batch": Batch((jnp.arange(4, dtype=jnp.int64),), (),
+                                jnp.ones((4,), jnp.int64), runs=None),
+        "scalars": [np.int64(-7), np.bool_(True), 3.5, "s", None, True,
+                    (1, (2, [3]))],
+        "nested": {"a": {"b": ({"c": jnp.arange(2)},)}},
+    }
+    got = _roundtrip(cases, tmp_path, "adversarial")
+    _assert_tree_equal(cases, got)
+    assert got["sharded_batch"].runs == (4, 4)
+    assert got["untagged_batch"].runs is None
+    assert got["scalars"][0] == -7 and \
+        got["scalars"][0].dtype == np.dtype("int64")
+    assert isinstance(got["scalars"][6], tuple)
+
+
+def test_spine_roundtrip_preserves_runs_metadata(tmp_path):
+    from dbsp_tpu.trace.spine import Spine
+
+    sp = Spine([jnp.int64], [jnp.int32])
+    sp.insert(Batch.from_tuples([((1, 5), 1), ((2, 6), 1)],
+                                [jnp.int64], [jnp.int32]))
+    sp.insert(Batch.from_tuples([((3, 7), 2)], [jnp.int64], [jnp.int32]))
+    got = _roundtrip({"sp": sp}, tmp_path, "spine")["sp"]
+    assert got.to_dict() == sp.to_dict()
+    assert [b.runs for b in got.batches] == [b.runs for b in sp.batches]
+    assert got.dirty == sp.dirty
+
+
+# ---------------------------------------------------------------------------
+# nexmark coverage: bit-identical restore, host and compiled
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["host", "compiled"])
+@pytest.mark.parametrize("qname", ["q1", "q2", "q3", "q4", "q8"])
+def test_nexmark_checkpoint_roundtrip(tmp_path, mode, qname):
+    """Checkpoint/restore mid-stream is bit-identical across the Nexmark
+    query set in BOTH engines: the restored pipeline's continued outputs
+    equal the uninterrupted run's."""
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    B = 150
+    query = getattr(queries, qname)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, query(*streams).output()
+
+    def mk():
+        handle, (handles, out) = Runtime.init_circuit(1, build)
+        if mode == "compiled":
+            try:
+                return CompiledCircuitDriver(handle), handles, out
+            except NotImplementedError:
+                pytest.skip(f"{qname} has no compiled equivalent")
+        return handle, handles, out
+
+    gen = NexmarkGenerator(GeneratorConfig(seed=1))
+    d1, hs1, out1 = mk()
+    deltas_ref = []
+    c1 = out1._op  # record per-tick deltas via to_dict snapshots
+    for t in range(8):
+        gen.feed(hs1, t * B, (t + 1) * B)
+        d1.step()
+        deltas_ref.append(out1.to_dict())
+
+    gen2 = NexmarkGenerator(GeneratorConfig(seed=1))
+    d2, hs2, out2 = mk()
+    for t in range(5):
+        gen2.feed(hs2, t * B, (t + 1) * B)
+        d2.step()
+    path = str(tmp_path / "ck")
+    ckpt.save(d2, path, tick=5 if mode == "host" else None)
+
+    d3, hs3, out3 = mk()
+    r = ckpt.restore(d3, path)
+    assert r["tick"] == 5
+    gen3 = NexmarkGenerator(GeneratorConfig(seed=1))
+    for t in range(5, 8):
+        gen3.feed(hs3, t * B, (t + 1) * B)
+        d3.step()
+        assert out3.to_dict() == deltas_ref[t], f"tick {t} diverged"
+
+
+# ---------------------------------------------------------------------------
+# manager restore-on-deploy (end to end over REST)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_manager_restore_on_deploy(tmp_path, monkeypatch):
+    """Deploy -> serve -> checkpoint (client API) -> shutdown -> redeploy
+    the same pipeline name: the new deploy restores the checkpointed view
+    state and /status reports the durability fields."""
+    from dbsp_tpu.client import Connection
+    from dbsp_tpu.manager import PipelineManager
+
+    monkeypatch.setenv("DBSP_TPU_CHECKPOINT_DIR", str(tmp_path / "fleet"))
+    tables = {"bids": {"columns": ["auction", "price"],
+                       "dtypes": ["int64", "int64"], "key_columns": 1}}
+    sql = {"by_auction": "SELECT auction, COUNT(*) AS n FROM bids "
+                         "GROUP BY auction"}
+    m = PipelineManager()
+    m.start()
+    try:
+        conn = Connection(port=m.port)
+        conn.create_program("prog", tables, sql)
+        pipe = conn.start_pipeline("p1", "prog")
+        pipe.push("bids", [[1, 10], [1, 20], [2, 30]])
+        pipe.step()
+        assert pipe.read("by_auction") == {(1, 2): 1, (2, 1): 1}
+        info = pipe.checkpoint()  # client-triggered durable generation
+        assert info["tick"] >= 1
+        assert pipe.status()["last_checkpoint_tick"] == info["tick"]
+        conn.shutdown_pipeline("p1")
+        conn.delete_pipeline("p1")
+
+        pipe2 = conn.start_pipeline("p1", "prog")
+        desc = [p for p in conn.pipelines() if p["name"] == "p1"][0]
+        assert desc["restored_tick"] is not None
+        # the restored integral is live: a new bid under auction 1 bumps
+        # the CHECKPOINTED count (2 -> 3) and auction 2's pre-shutdown
+        # count is still present — the view reads as if never restarted
+        pipe2.push("bids", [[1, 99]])
+        pipe2.step()
+        assert pipe2.read("by_auction") == {(1, 3): 1, (2, 1): 1}
+    finally:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# state-schema lint (tools/check_state.py) — tier-1
+# ---------------------------------------------------------------------------
+
+
+def test_state_schema_lint_clean():
+    from tools.check_state import check_tree
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert check_tree(root) == []
+
+
+def test_state_schema_lint_catches_unclaimed_field(tmp_path, monkeypatch):
+    """Seeded defect: an attribute the schema doesn't claim is flagged;
+    so is a stale schema entry."""
+    import tools.check_state as cs
+
+    src = (tmp_path / "mod.py")
+    src.write_text(
+        "class CompiledHandle:\n"
+        "    def __init__(self):\n"
+        "        self.states = {}\n"
+        "        self.brand_new_field = 1\n")
+    monkeypatch.setattr(cs, "CHECKED_CLASSES",
+                        (("mod.py", "CompiledHandle"),))
+    violations = cs.check_tree(str(tmp_path))
+    assert any("brand_new_field" in v and "not claimed" in v
+               for v in violations)
+    assert any("no longer assigns" in v for v in violations)  # stale ones
+
+
+# ---------------------------------------------------------------------------
+# steady-state checkpoint overhead bound
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_overhead_bounded(tmp_path):
+    """Periodic checkpointing at the default cadence costs < 10% of
+    elapsed on a mini q4 protocol: incremental saves (hard-linked clean
+    deep levels) amortize over DEFAULT_EVERY_TICKS ticks of real work.
+    (bench.py reports the same quantity as ``checkpoint_overhead`` on the
+    full protocol.)"""
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    B = 500
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    drv = CompiledCircuitDriver(handle)
+    gen = NexmarkGenerator(GeneratorConfig(seed=1))
+    path = str(tmp_path / "ck")
+    # warmup: let capacities stabilize and programs compile
+    for t in range(10):
+        gen.feed(handles, t * B, (t + 1) * B)
+        drv.step()
+    ckpt.save(drv, path)  # cold full generation (not measured)
+
+    # steady state: per-tick cost vs per-save cost
+    n = 24
+    t0 = time.perf_counter()
+    for t in range(10, 10 + n):
+        gen.feed(handles, t * B, (t + 1) * B)
+        drv.step()
+    per_tick_s = (time.perf_counter() - t0) / n
+
+    saves = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ckpt.save(drv, path)
+        saves.append(time.perf_counter() - t0)
+    save_s = sorted(saves)[len(saves) // 2]  # median warm incremental save
+
+    interval_s = ckpt.DEFAULT_EVERY_TICKS * per_tick_s
+    fraction = save_s / (save_s + interval_s)
+    assert fraction < 0.10, (
+        f"checkpoint overhead {fraction:.1%} (save {save_s * 1e3:.1f} ms "
+        f"per {interval_s * 1e3:.0f} ms interval) exceeds the 10% bound")
+
+
+# ---------------------------------------------------------------------------
+# controller integration: periodic cadence + graceful shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_controller_periodic_and_final_checkpoint(tmp_path):
+    from dbsp_tpu.io import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+
+    path = str(tmp_path / "ck")
+    handle, (h, out) = Runtime.init_circuit(1, _agg_build)
+    drv = CompiledCircuitDriver(handle)
+    ctl = Controller(drv, Catalog(), ControllerConfig(
+        checkpoint_dir=path, checkpoint_every_ticks=3))
+    for t in range(7):
+        _feed(h, t)
+        ctl.step()
+    assert ctl.checkpoints == 2  # steps 3 and 6
+    assert ctl.last_checkpoint_tick == 6
+    ctl.stop()  # graceful: flush + FINAL checkpoint
+    assert ctl.last_checkpoint_tick == 7
+    ctl.stop()  # idempotent under double-call
+    ctl.pause()  # and pause after shutdown is a no-op
+    assert ctl.checkpoints == 3
+
+    # restore-on-deploy path picks up the final generation
+    handle2, (h2, out2) = Runtime.init_circuit(1, _agg_build)
+    drv2 = CompiledCircuitDriver(handle2)
+    ctl2 = Controller(drv2, Catalog(), ControllerConfig(
+        checkpoint_dir=path))
+    info = ctl2.restore_from()
+    assert info["tick"] == 7 and ctl2.steps == 7
+    assert out2.to_dict() == {}  # outputs are per-tick deltas, not state
+    _feed(h2, 7)
+    ctl2.step()
+    _feed(h, 7)
+    ctl.handle.step()  # original driver continues outside the controller
+    assert out2.to_dict() == out.to_dict()
+
+
+def test_checkpoint_without_directory_is_an_error(tmp_path):
+    from dbsp_tpu.io import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+
+    handle, (h, out) = Runtime.init_circuit(1, _agg_build)
+    ctl = Controller(handle, Catalog(), ControllerConfig())
+    if ctl.checkpoint_dir:  # env leaked into the test run
+        pytest.skip("DBSP_TPU_CHECKPOINT_DIR set in the environment")
+    with pytest.raises(ValueError, match="no checkpoint directory"):
+        ctl.checkpoint()

@@ -100,9 +100,12 @@ def test_server_endpoints(tmp_path):
         with urllib.request.urlopen(req, timeout=5) as r:
             return r.status, r.read()
 
-    # /status now rides the execution mode along (obs-less server: no slo)
+    # /status rides the execution mode + durability fields along
+    # (obs-less server: no slo)
     assert json.loads(get("/status")[1]) == {"state": "initializing",
-                                             "mode": "host"}
+                                             "mode": "host",
+                                             "last_checkpoint_tick": None,
+                                             "checkpoints": 0}
     # push rows over HTTP, step explicitly, read the output endpoint
     st, body = post("/input_endpoint/events?format=json",
                     b'{"insert": [7, 1]}\n{"insert": [7, 2]}\n')
@@ -123,7 +126,9 @@ def test_server_endpoints(tmp_path):
         get("/nope")
     st, _ = post("/pause")
     assert json.loads(get("/status")[1]) == {"state": "paused",
-                                             "mode": "host"}
+                                             "mode": "host",
+                                             "last_checkpoint_tick": None,
+                                             "checkpoints": 0}
     server.stop()
 
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""State-schema lint: every serving-state field is claimed by the
+checkpoint schema registry.
+
+The durability failure mode this prevents: someone adds a field to
+``CompiledHandle`` / ``CompiledCircuitDriver`` / the controller endpoint
+state, the checkpoint encoder never learns about it, and restore silently
+resurrects pipelines with that state zeroed — a correctness bug that only
+fires after a crash, the worst possible time to discover it.
+
+Mechanism (AST, like check_hotpath/check_metrics; wired tier-1 via
+tests/test_checkpoint.py and tools/lint_all.py): walk every ``self.X = ``
+assignment in the bodies of the registered classes and require each
+attribute to be claimed in ``dbsp_tpu.checkpoint.STATE_SCHEMA`` as
+``persisted`` (in the manifest), ``derived`` (reconstructible; safe to
+lose), ``config`` (rebuilt at deploy), or ``runtime`` (process-local).
+Stale claims — schema entries whose attribute no longer exists — are
+violations too, so the registry tracks the code both ways.
+
+Usage: ``python tools/check_state.py [repo_root]`` — prints violations
+and exits 1 when any are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+#: (file relative to repo root, class name) pairs under schema control —
+#: the classes whose instances a checkpoint must fully account for
+CHECKED_CLASSES: Tuple[Tuple[str, str], ...] = (
+    ("dbsp_tpu/compiled/compiler.py", "CompiledHandle"),
+    ("dbsp_tpu/compiled/driver.py", "CompiledCircuitDriver"),
+    ("dbsp_tpu/io/controller.py", "Controller"),
+    ("dbsp_tpu/io/controller.py", "_InputEndpoint"),
+    ("dbsp_tpu/io/controller.py", "_OutputEndpoint"),
+)
+
+DISPOSITIONS = ("persisted", "derived", "config", "runtime")
+
+
+def _self_attrs(cls: ast.ClassDef) -> Dict[str, int]:
+    """attr -> first line of every ``self.X = ...`` in the class body,
+    plus class-level attribute defaults (``spans = None``) — ALL_CAPS
+    constants excluded."""
+    out: Dict[str, int] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and not t.id.isupper():
+                    out.setdefault(t.id, stmt.lineno)
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                not stmt.target.id.isupper():
+            out.setdefault(stmt.target.id, stmt.lineno)
+    for node in ast.walk(cls):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            # tuple targets: self.a, self.b = ...
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Attribute) and \
+                        isinstance(e.value, ast.Name) and \
+                        e.value.id == "self":
+                    out.setdefault(e.attr, node.lineno)
+    return out
+
+
+def check_tree(root: str) -> List[str]:
+    from dbsp_tpu.checkpoint import STATE_SCHEMA
+
+    violations: List[str] = []
+    for rel, cls_name in CHECKED_CLASSES:
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        cls = next((n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef) and n.name == cls_name),
+                   None)
+        if cls is None:
+            violations.append(f"{rel}: class {cls_name} not found (update "
+                              "tools/check_state.py CHECKED_CLASSES)")
+            continue
+        schema = STATE_SCHEMA.get(cls_name)
+        if schema is None:
+            violations.append(
+                f"{rel}: class {cls_name} has no STATE_SCHEMA entry in "
+                "dbsp_tpu/checkpoint.py")
+            continue
+        attrs = _self_attrs(cls)
+        for attr, lineno in sorted(attrs.items()):
+            if attr not in schema:
+                violations.append(
+                    f"{rel}:{lineno}: {cls_name}.{attr} is not claimed by "
+                    "the checkpoint schema registry "
+                    "(dbsp_tpu.checkpoint.STATE_SCHEMA) — declare it "
+                    f"{DISPOSITIONS} so restore can never silently drop "
+                    "state")
+            elif schema[attr].split(":")[0] not in DISPOSITIONS:
+                violations.append(
+                    f"{rel}: {cls_name}.{attr} has unknown disposition "
+                    f"{schema[attr]!r} (allowed: {DISPOSITIONS})")
+        stale: Set[str] = set(schema) - set(attrs)
+        for attr in sorted(stale):
+            violations.append(
+                f"{rel}: STATE_SCHEMA claims {cls_name}.{attr} but the "
+                "class no longer assigns it — drop the stale entry")
+    return violations
+
+
+def main(argv=None) -> int:
+    root = (argv or sys.argv[1:] or [_ROOT])[0]
+    violations = check_tree(os.path.abspath(root))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_state: {len(violations)} violation(s)")
+        return 1
+    print("check_state: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,8 @@ the tier-1 test in tests/test_analysis.py):
    metric names follow the convention.
 2. ``tools/check_hotpath.py``  — no host round-trips in operator eval
    bodies / jitted functions; no load-bearing asserts in circuit/ and io/.
+2b. ``tools/check_state.py``   — every serving-state field is claimed by
+   the checkpoint schema registry (restore can never silently drop state).
 3. **Analyzer self-check** — build every Nexmark query circuit plus a set
    of representative demo circuits and run the static analyzer
    (dbsp_tpu/analysis) over each: any ERROR finding is a lint failure
@@ -39,6 +41,12 @@ def run_check_hotpath() -> list:
     from tools.check_hotpath import check_tree
 
     return check_tree(PKG)
+
+
+def run_check_state() -> list:
+    from tools.check_state import check_tree
+
+    return check_tree(_ROOT)
 
 
 def _demo_circuits():
@@ -118,6 +126,7 @@ def run_analyzer_selfcheck() -> list:
 def main() -> int:
     fronts = [("check_metrics", run_check_metrics),
               ("check_hotpath", run_check_hotpath),
+              ("check_state", run_check_state),
               ("analyzer_selfcheck", run_analyzer_selfcheck)]
     failed = 0
     for name, fn in fronts:
