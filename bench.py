@@ -370,6 +370,7 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     from dbsp_tpu.zset import kernels as _zk
 
     consolidate_before = dict(_zk.CONSOLIDATE_COUNTS)
+    kernel_paths_before = dict(_zk.KERNEL_DISPATCH_COUNTS)
     cfg = GeneratorConfig(seed=1)
 
     def build(c):
@@ -555,6 +556,15 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     detail["consolidate_paths"] = {
         k: int(v - consolidate_before.get(k, 0))
         for k, v in _zk.CONSOLIDATE_COUNTS.items()}
+    # kernel-dispatch decisions (zset/kernels.py KERNEL_DISPATCH_COUNTS):
+    # which backend (native/xla/pallas) each kernel entry point selected
+    # during this query — the A/B evidence for DBSP_TPU_NATIVE /
+    # DBSP_TPU_PALLAS force-off runs
+    detail["kernel_paths"] = {
+        f"{kern}:{backend}": int(v - kernel_paths_before.get((kern, backend),
+                                                            0))
+        for (kern, backend), v in sorted(_zk.KERNEL_DISPATCH_COUNTS.items())
+        if v - kernel_paths_before.get((kern, backend), 0)}
     detail.update(elapsed_s=round(elapsed, 3), events=measured, ticks=ticks,
                   replayed_intervals=max(0, len(samples) - expected))
     return eps

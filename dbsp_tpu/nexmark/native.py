@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 from typing import Dict, Optional
 
 import numpy as np
@@ -46,16 +45,19 @@ def build_library(force: bool = False) -> str:
         raise RuntimeError(_build_error)
     if force or not os.path.exists(_SO) or (
             os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        # stamped build chokepoint (tools/build_native) — dev rebuilds
+        # embed the source SHA-256 for the staleness lint
+        import sys
+
+        if _REPO_ROOT not in sys.path:
+            sys.path.insert(0, _REPO_ROOT)
+        from tools.build_native import compile_so
+
         try:
-            r = subprocess.run(
-                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                 "-o", _SO, _SRC],
-                check=True, capture_output=True, text=True)
-        except FileNotFoundError:
-            _build_error = "g++ not found; native generator unavailable"
-            raise RuntimeError(_build_error) from None
-        except subprocess.CalledProcessError as e:
-            _build_error = f"native generator build failed:\n{e.stderr}"
+            compile_so(_SRC, _SO,
+                       ["-O3", "-march=native", "-shared", "-fPIC"])
+        except RuntimeError as e:
+            _build_error = f"native generator: {e}"
             raise RuntimeError(_build_error) from None
     return _SO
 

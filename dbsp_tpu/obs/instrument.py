@@ -50,7 +50,9 @@ def export_consolidate_paths(registry: MetricsRegistry) -> None:
         "dbsp_tpu_zset_consolidate_total",
         "Consolidation dispatch decisions by regime (process-wide; "
         "skipped = metadata no-op, rank = sorted-run merge fold, "
-        "native = C++ argsort, sort = lax.sort, deferred = removed by "
+        "native = C++ argsort, sort = lax.sort, "
+        "native_unsupported_dtype = native selected but demoted to sort "
+        "by a non-int64-widenable column dtype, deferred = removed by "
         "the compiled placement pass)", labels=("path",))
 
     def _collect() -> None:
@@ -58,6 +60,37 @@ def export_consolidate_paths(registry: MetricsRegistry) -> None:
 
         for path, n in zkernels.CONSOLIDATE_COUNTS.items():
             counter.labels(path=path).set_total(n)
+
+    registry.register_collector(_collect)
+
+
+def export_kernel_dispatch(registry: MetricsRegistry) -> None:
+    """Register a collector mirroring the kernel dispatch decisions
+    (``zset/kernels.py::KERNEL_DISPATCH_COUNTS``) as
+    ``dbsp_tpu_zset_kernel_dispatch_total{kernel,backend}`` — which
+    implementation (native C++ custom call / pure XLA / Pallas) each Z-set
+    kernel entry point selected. Same counting convention as the
+    consolidation-path counter: dispatch DECISIONS (per eval eagerly, per
+    trace under jit), not per-tick kernel volume — the metric answers "is
+    this pipeline on the kernels I think it is", e.g. after a
+    ``DBSP_TPU_NATIVE`` force-off or a dtype change knocked a path off the
+    native set."""
+    if getattr(registry, "_kernel_dispatch_exported", False):
+        return
+    registry._kernel_dispatch_exported = True
+    counter = registry.counter(
+        "dbsp_tpu_zset_kernel_dispatch_total",
+        "Z-set kernel dispatch decisions by entry point and backend "
+        "(native = C++ FFI custom call, xla = pure-XLA lowering, "
+        "pallas = hand-written Pallas program)",
+        labels=("kernel", "backend"))
+
+    def _collect() -> None:
+        from dbsp_tpu.zset import kernels as zkernels
+
+        for (kern, backend), n in list(
+                zkernels.KERNEL_DISPATCH_COUNTS.items()):
+            counter.labels(kernel=kern, backend=backend).set_total(n)
 
     registry.register_collector(_collect)
 
@@ -91,6 +124,7 @@ class CircuitInstrumentation:
             "dbsp_tpu_circuit_steps_total", "Root-circuit steps evaluated")
         registry.register_collector(self._collect_graph)
         export_consolidate_paths(registry)
+        export_kernel_dispatch(registry)
         circuit.register_scheduler_event_handler(self._on_event)
         # mark exchange operators so they accumulate rows/bytes moved —
         # this costs one scalar device->host sync per exchange per tick
@@ -255,6 +289,7 @@ class CompiledInstrumentation:
         self._overhead_seen: Dict[str, int] = {}
         registry.register_collector(self._collect)
         export_consolidate_paths(registry)
+        export_kernel_dispatch(registry)
         if spans is not None:
             driver.spans = spans  # driver records tick/validate spans
 

@@ -54,6 +54,11 @@ ALLOWED_LABEL_NAMES = frozenset((
     "operator", "node", "endpoint", "phase", "cause", "reason", "path",
     "rule", "severity", "slo", "pipeline", "worker", "mode", "state",
     "query", "kind",
+    # kernel dispatch attribution: "kernel" names a Z-set kernel entry
+    # point (merge/probe/expand/...), "backend" the implementation it
+    # dispatched to (native/xla/pallas) — both closed, enumerable sets
+    # (zset/native_merge.py::KERNELS x three backends)
+    "kernel", "backend",
 ))
 
 

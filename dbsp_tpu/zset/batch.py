@@ -343,6 +343,20 @@ def consolidate_regime(batch: Batch) -> Batch:
     runs = batch.runs
     if runs is not None and 2 <= len(runs) <= RANK_FOLD_MAX_RUNS:
         kernels.count_consolidate_path("rank")
+        # native fast path: ONE k-way C++ merge over the run slices
+        # (ZsetRankFoldImpl) instead of a fold of R-1 pairwise merges —
+        # same canonical output, R-1 fewer custom calls and no
+        # intermediate accumulator buffers
+        if batch.cols and batch.weights.ndim == 1 and \
+                kernels.native_kernel("rank_fold"):
+            from dbsp_tpu.zset import native_merge
+
+            if native_merge.supports(c.dtype for c in batch.cols):
+                kernels.count_kernel_dispatch("rank_fold", "native")
+                cols, w = native_merge.rank_fold_native(
+                    batch.cols, batch.weights, runs)
+                return Batch(cols[:nk], cols[nk:], w, runs=(batch.cap,))
+        kernels.count_kernel_dispatch("rank_fold", "xla")
         # fold sorted merges over the run slices, smallest runs first so
         # each merge probes the smaller side into the accumulator
         bounds = []

@@ -13,8 +13,9 @@ This module collapses that fan-out (the engine's answer to the reference's
 
 * :func:`lex_probe_ladder` — ONE vectorized lexicographic search over the
   whole level ladder: [K, m] (level, query) lanes share a single unrolled
-  binary-search loop (on CPU with the native library, K cheap C++ probe
-  calls — same result, same shape).
+  binary-search loop (on CPU with the native library, ONE ladder-wide C++
+  probe call; on accelerator backends a Pallas grid-over-levels program —
+  same result, same shape).
 * :func:`expand_ladder` — ONE ``expand_ranges``-style prefix-sum allocation
   whose [K*m] counts span levels: each output slot resolves to (level,
   query row, source row) through a single searchsorted over the cross-level
@@ -62,15 +63,28 @@ def lex_probe_ladder(tables: Sequence[Cols], query_cols: Cols,
     assert tables, "lex_probe_ladder: empty ladder"
     K = len(tables)
     m = query_cols[0].shape[0] if query_cols else 0
-    if query_cols and query_cols[0].ndim == 1 and \
-            kernels.merge_strategy() == "native":
-        from dbsp_tpu.zset import native_merge
-
+    if query_cols and query_cols[0].ndim == 1:
         dts = [c.dtype for t in tables for c in t]
-        if native_merge.supports((*dts, *(c.dtype for c in query_cols))):
-            return jnp.stack([
-                native_merge.lex_probe_native(t, query_cols, side)
-                for t in tables])
+        # cheap pre-check before importing the pallas module: the CPU
+        # backend without an explicit override never selects it, and the
+        # import itself is not free on cold start
+        if kernels.pallas_requested():
+            from dbsp_tpu.zset import pallas_kernels
+
+            all_cols = (*(c for t in tables for c in t), *query_cols)
+            if pallas_kernels.use_pallas("probe_ladder", all_cols):
+                kernels.count_kernel_dispatch("probe_ladder", "pallas")
+                return pallas_kernels.lex_probe_ladder_pallas(
+                    tables, query_cols, side)
+        if kernels.native_kernel("probe_ladder"):
+            from dbsp_tpu.zset import native_merge
+
+            if native_merge.supports(
+                    (*dts, *(c.dtype for c in query_cols))):
+                kernels.count_kernel_dispatch("probe_ladder", "native")
+                return native_merge.lex_probe_ladder_native(
+                    tables, query_cols, side)
+    kernels.count_kernel_dispatch("probe_ladder", "xla")
     caps = [t[0].shape[0] for t in tables]
     steps = max(c.bit_length() for c in caps)
     strict = side == "left"
@@ -102,6 +116,16 @@ def expand_ladder(lo: jnp.ndarray, hi: jnp.ndarray, out_cap: int):
     standard overflow contract of :func:`kernels.expand_ranges` applies).
     """
     K, m = lo.shape
+    if kernels.native_kernel("expand"):
+        kernels.count_kernel_dispatch("expand", "native")
+        from dbsp_tpu.zset import native_merge
+
+        flat, src, valid, total = native_merge.expand_ranges_native(
+            lo.reshape(K * m), hi.reshape(K * m), out_cap)
+        level = flat // m
+        qrow = flat - level * m
+        return level, qrow, src, valid, total
+    kernels.count_kernel_dispatch("expand", "xla")
     counts = jnp.maximum(hi - lo, 0).reshape(K * m)
     starts = jnp.cumsum(counts) - counts
     # the OVERFLOW total accumulates in 64-bit: a ladder-wide match count
@@ -128,9 +152,21 @@ def _select_gather(cols_per_level: Sequence[Cols], level: jnp.ndarray,
                    src: jnp.ndarray) -> Cols:
     """Gather column values from the level each output slot resolved to:
     one clamped gather per level per column, combined by level-id select
-    (no scatters, no per-level buffers)."""
+    (no scatters, no per-level buffers). On CPU with the native library the
+    whole select tree is ONE C++ pass reading exactly the (level, src) cell
+    each slot resolved to (ZsetGatherImpl) — bit-identical values, clamped
+    reads on dead slots included."""
     if not cols_per_level[0]:
         return ()
+    if level.ndim == 1 and kernels.native_kernel("gather"):
+        from dbsp_tpu.zset import native_merge
+
+        if native_merge.supports(c.dtype for cols in cols_per_level
+                                 for c in cols):
+            kernels.count_kernel_dispatch("gather", "native")
+            return native_merge.gather_levels_native(cols_per_level, level,
+                                                     src)
+    kernels.count_kernel_dispatch("gather", "xla")
     outs: List[jnp.ndarray] = []
     for ci in range(len(cols_per_level[0])):
         acc = None

@@ -32,17 +32,83 @@ from jax import lax
 #              (no sort of the combined rows);
 #   native   — full consolidation via the C++ argsort custom call;
 #   sort     — full multi-operand ``lax.sort`` consolidation;
+#   native_unsupported_dtype — native was SELECTED but a column dtype
+#              (float) is not int64-widenable, so the call demoted to the
+#              sort path. Counted separately so a schema change that
+#              silently knocks a pipeline off the native kernels is
+#              visible in /metrics instead of folding into plain "sort";
 #   deferred — the compiled placement pass removed the consolidation from
 #              the program entirely (its consumers canonicalize anyway).
 # Eager host-path calls count once per eval; calls under an XLA trace count
 # once per TRACE — the counter attributes which regimes fire where, not
 # per-tick kernel volume.
 CONSOLIDATE_COUNTS: Dict[str, int] = {
-    "sort": 0, "rank": 0, "native": 0, "skipped": 0, "deferred": 0}
+    "sort": 0, "rank": 0, "native": 0, "skipped": 0, "deferred": 0,
+    "native_unsupported_dtype": 0}
 
 
 def count_consolidate_path(path: str) -> None:
     CONSOLIDATE_COUNTS[path] = CONSOLIDATE_COUNTS.get(path, 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# Kernel dispatch accounting + the per-kernel native gate
+# ---------------------------------------------------------------------------
+
+# Which implementation each kernel entry point dispatched to, keyed by
+# (kernel, backend) with backend one of "native" (C++ FFI custom call),
+# "xla" (pure-XLA lowering) or "pallas" (hand-written Pallas program).
+# Same counting convention as CONSOLIDATE_COUNTS (eager calls per eval,
+# traced calls per trace); exported by obs as
+# ``dbsp_tpu_zset_kernel_dispatch_total{kernel,backend}`` and embedded in
+# bench JSON as ``kernel_paths`` — so which path a deployment's hot loop
+# actually took is observable, not inferred from env vars.
+KERNEL_DISPATCH_COUNTS: Dict[Tuple[str, str], int] = {}
+
+
+def count_kernel_dispatch(kernel: str, backend: str) -> None:
+    key = (kernel, backend)
+    KERNEL_DISPATCH_COUNTS[key] = KERNEL_DISPATCH_COUNTS.get(key, 0) + 1
+
+
+def native_kernel(kernel: str) -> bool:
+    """Should ``kernel`` dispatch to its native C++ implementation HERE?
+
+    True only on the CPU backend, with the FFI library loadable, and with
+    the kernel not forced off via ``DBSP_TPU_NATIVE`` (csv force-off list;
+    ``0`` = all off — see ``native_merge.kernel_enabled``). Callers still
+    check dtype support per call site."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return False
+    from dbsp_tpu.zset import native_merge
+
+    return native_merge.available() and native_merge.kernel_enabled(kernel)
+
+
+# The DBSP_TPU_PALLAS spellings that force the Pallas kernels ON even off
+# an accelerator backend — the ONE definition shared by the dispatch
+# pre-checks here/in cursor.py and pallas_kernels.enabled(), so the
+# grammar cannot drift between the cheap check and the real one.
+PALLAS_FORCE_ON = ("1", "on", "interpret")
+
+
+def pallas_requested() -> bool:
+    """Cheap pre-check for the Pallas dispatch branch WITHOUT importing
+    the pallas module (not free on CPU cold start): an accelerator
+    backend, or an explicit DBSP_TPU_PALLAS force-on. The full gate
+    (including the force-off spellings and dtype support) lives in
+    ``pallas_kernels.use_pallas`` — this only decides whether that module
+    is worth importing."""
+    import os
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return True
+    return os.environ.get("DBSP_TPU_PALLAS", "").strip().lower() in \
+        PALLAS_FORCE_ON
 
 # ---------------------------------------------------------------------------
 # Sentinels
@@ -124,8 +190,17 @@ def compact(cols: Sequence[jnp.ndarray], weights: jnp.ndarray,
     one searchsorted over the inclusive keep-prefix-sums — a scatter
     formulation measured ~40ns/element on XLA:CPU (scatters lower to a
     sequential update loop; a 16k-row x 7-col filter cost ~5ms/tick), while
-    searchsorted + gathers vectorize. Bit-identical output either way.
+    searchsorted + gathers vectorize. On CPU with the native library the
+    whole pass is ONE sequential C++ copy (ZsetCompactImpl). Bit-identical
+    output on every path.
     """
+    if cols and weights.ndim == 1 and native_kernel("compact"):
+        from dbsp_tpu.zset import native_merge
+
+        if native_merge.supports(c.dtype for c in cols):
+            count_kernel_dispatch("compact", "native")
+            return native_merge.compact_native(cols, weights, keep)
+    count_kernel_dispatch("compact", "xla")
     cap = weights.shape[0]
     csum = jnp.cumsum(keep.astype(jnp.int32))
     total = csum[-1]
@@ -151,13 +226,20 @@ def consolidate_cols(cols: Sequence[jnp.ndarray], weights: jnp.ndarray
     net weight is zero, and packs survivors to the front. Output capacity ==
     input capacity; tail rows are dead (weight 0, sentinel keys).
     """
-    if cols and weights.ndim == 1 and merge_strategy() == "native":
+    if cols and weights.ndim == 1 and native_kernel("consolidate"):
         from dbsp_tpu.zset import native_merge
 
         if native_merge.supports(c.dtype for c in cols):
             count_consolidate_path("native")
+            count_kernel_dispatch("consolidate", "native")
             return native_merge.consolidate_cols_native(cols, weights)
-    count_consolidate_path("sort")
+        # native was selected but a column dtype (float) is not
+        # int64-widenable: the demotion is its own counter bucket so the
+        # silent fallback is visible in /metrics
+        count_consolidate_path("native_unsupported_dtype")
+    else:
+        count_consolidate_path("sort")
+    count_kernel_dispatch("consolidate", "xla")
     cap = weights.shape[0]
     cols, (weights,) = sort_rows(cols, (weights,))
     dup = rows_equal_prev(cols, n=cap)
@@ -185,15 +267,14 @@ def merge_strategy() -> str:
     XLA:CPU's comparator-based multi-operand sort measured ~50x slower than
     the C++ walk at spine-tail shapes (1.2s vs ~25ms for 1.5M rows x 7
     cols). ``sort`` remains the fallback when the native library can't
-    build or a column dtype (float) isn't int64-widenable.
+    build, the ``merge`` kernel is forced off (``DBSP_TPU_NATIVE``), or a
+    column dtype (float) isn't int64-widenable.
     """
     import jax
 
     if jax.default_backend() != "cpu":
         return "rank"
-    from dbsp_tpu.zset import native_merge
-
-    return "native" if native_merge.available() else "sort"
+    return "native" if native_kernel("merge") else "sort"
 
 
 def merge_sorted_cols(cols_a: Sequence[jnp.ndarray], w_a: jnp.ndarray,
@@ -223,23 +304,41 @@ def merge_sorted_cols(cols_a: Sequence[jnp.ndarray], w_a: jnp.ndarray,
 
         if w_a.ndim == 1 and \
                 native_merge.supports(c.dtype for c in cols_a):
+            count_kernel_dispatch("merge", "native")
             return native_merge.merge_consolidated_cols(cols_a, w_a,
                                                         cols_b, w_b)
         strategy = "sort"
     if strategy == "sort":
+        count_kernel_dispatch("merge", "xla")
         cols = tuple(jnp.concatenate([a, b.astype(a.dtype)])
                      for a, b in zip(cols_a, cols_b))
         return consolidate_cols(cols, jnp.concatenate([w_a, w_b]))
     na, nb = w_a.shape[0], w_b.shape[0]
-    ra = lex_probe(cols_b, cols_a, side="left")    # b-rows strictly < a_i
-    rb = lex_probe(cols_a, cols_b, side="right")   # a-rows <= b_j
-    pos_a = jnp.arange(na, dtype=jnp.int32) + ra
-    pos_b = jnp.arange(nb, dtype=jnp.int32) + rb
-    out_cols = []
-    for ca, cb in zip(cols_a, cols_b):
-        buf = sentinel_fill((na + nb,), ca.dtype)
-        out_cols.append(buf.at[pos_a].set(ca).at[pos_b].set(cb.astype(ca.dtype)))
-    w = jnp.zeros((na + nb,), w_a.dtype).at[pos_a].set(w_a).at[pos_b].set(w_b)
+    # rank path (accelerators): the probe + position-scatter inner loop,
+    # either the Pallas program (zset/pallas_kernels.py) or the XLA
+    # formulation — bit-identical buffers either way; the netting +
+    # compaction tail below is shared.
+    from dbsp_tpu.zset import pallas_kernels
+
+    if pallas_kernels.use_pallas("rank_merge", (*cols_a, *cols_b)) and \
+            w_a.ndim == 1:
+        count_kernel_dispatch("merge", "pallas")
+        out_cols, w = pallas_kernels.rank_merge_scatter(
+            cols_a, w_a, cols_b, w_b)
+        out_cols = list(out_cols)
+    else:
+        count_kernel_dispatch("merge", "xla")
+        ra = lex_probe(cols_b, cols_a, side="left")   # b-rows strictly < a_i
+        rb = lex_probe(cols_a, cols_b, side="right")  # a-rows <= b_j
+        pos_a = jnp.arange(na, dtype=jnp.int32) + ra
+        pos_b = jnp.arange(nb, dtype=jnp.int32) + rb
+        out_cols = []
+        for ca, cb in zip(cols_a, cols_b):
+            buf = sentinel_fill((na + nb,), ca.dtype)
+            out_cols.append(
+                buf.at[pos_a].set(ca).at[pos_b].set(cb.astype(ca.dtype)))
+        w = jnp.zeros((na + nb,), w_a.dtype).at[pos_a].set(w_a) \
+            .at[pos_b].set(w_b)
     dup = rows_equal_prev(out_cols, n=na + nb)
     seg = jnp.cumsum(~dup) - 1
     sums = jax.ops.segment_sum(w, seg, num_segments=na + nb)
@@ -344,13 +443,15 @@ def lex_probe(table_cols: Tuple[jnp.ndarray, ...],
     """
     assert table_cols, "lex_probe requires at least one key column"
     if table_cols[0].ndim == 1 and query_cols[0].ndim == 1 and \
-            merge_strategy() == "native":
+            native_kernel("probe"):
         from dbsp_tpu.zset import native_merge
 
         if native_merge.supports(c.dtype for c in (*table_cols,
                                                    *query_cols)):
+            count_kernel_dispatch("probe", "native")
             return native_merge.lex_probe_native(table_cols, query_cols,
                                                  side)
+    count_kernel_dispatch("probe", "xla")
     n = table_cols[0].shape[0]
     m = query_cols[0].shape[0]
     lo = jnp.zeros((m,), jnp.int32)
@@ -389,7 +490,17 @@ def expand_ranges(lo: jnp.ndarray, hi: jnp.ndarray, out_cap: int
     ``out_cap`` and re-run with a grown capacity bucket — see
     ``operators/join.py``. ``total`` is returned (not clamped) precisely so
     that check is possible.
+
+    On CPU with the native library the count/scan/search pass is ONE
+    sequential C++ walk (ZsetExpandImpl) with the identical tail contract
+    (invalid slots anchor at the last non-empty range).
     """
+    if lo.ndim == 1 and native_kernel("expand"):
+        count_kernel_dispatch("expand", "native")
+        from dbsp_tpu.zset import native_merge
+
+        return native_merge.expand_ranges_native(lo, hi, out_cap)
+    count_kernel_dispatch("expand", "xla")
     counts = jnp.maximum(hi - lo, 0)
     starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
     total = jnp.sum(counts, dtype=jnp.int64)  # 64-bit: see expand_ladder

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+import sys
 import threading
 from typing import Optional, Sequence, Tuple
 
@@ -83,6 +83,16 @@ _PP = ctypes.POINTER(ctypes.c_int64)
 FFI_TARGET = "dbsp_zset_merge"
 PROBE_TARGET = "dbsp_zset_probe"
 CONSOLIDATE_TARGET = "dbsp_zset_consolidate"
+EXPAND_TARGET = "dbsp_zset_expand"
+GATHER_TARGET = "dbsp_zset_gather"
+COMPACT_TARGET = "dbsp_zset_compact"
+PROBE_LADDER_TARGET = "dbsp_zset_probe_ladder"
+RANK_FOLD_TARGET = "dbsp_zset_rank_fold"
+
+# every native kernel the per-kernel force-off knob can address (the
+# DBSP_TPU_NATIVE csv grammar — see :func:`kernel_enabled`)
+KERNELS = ("merge", "consolidate", "probe", "probe_ladder", "expand",
+           "gather", "compact", "rank_fold")
 
 
 def _build() -> str:
@@ -97,17 +107,19 @@ def _build() -> str:
         raise RuntimeError(_build_error)
     if not os.path.exists(_SO) or (
             os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-        include = _FFI.include_dir()
+        # route through the stamped build chokepoint (tools/build_native)
+        # so dev rebuilds embed the source SHA-256 exactly like the
+        # recorded builds — the staleness lint depends on it
+        if _REPO_ROOT not in sys.path:
+            sys.path.insert(0, _REPO_ROOT)
+        from tools.build_native import compile_so
+
         try:
-            subprocess.run(
-                ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
-                 "-fPIC", f"-I{include}", "-o", _SO, _SRC],
-                check=True, capture_output=True, text=True)
-        except FileNotFoundError:
-            _build_error = "g++ not found; native merge unavailable"
-            raise RuntimeError(_build_error) from None
-        except subprocess.CalledProcessError as e:
-            _build_error = f"native merge build failed:\n{e.stderr}"
+            compile_so(_SRC, _SO,
+                       ["-O3", "-march=native", "-std=c++17", "-shared",
+                        "-fPIC"], [_FFI.include_dir()])
+        except RuntimeError as e:
+            _build_error = f"native merge: {e}"
             raise RuntimeError(_build_error) from None
     return _SO
 
@@ -128,29 +140,68 @@ def _load() -> ctypes.CDLL:
             ]
             _lib = lib
         if not _registered:
-            _FFI.register_ffi_target(
-                FFI_TARGET, _FFI.pycapsule(_lib.ZsetMergeFfi),
-                platform="cpu")
-            _FFI.register_ffi_target(
-                PROBE_TARGET, _FFI.pycapsule(_lib.ZsetProbeFfi),
-                platform="cpu")
-            _FFI.register_ffi_target(
-                CONSOLIDATE_TARGET,
-                _FFI.pycapsule(_lib.ZsetConsolidateFfi),
-                platform="cpu")
+            for target, symbol in (
+                    (FFI_TARGET, "ZsetMergeFfi"),
+                    (PROBE_TARGET, "ZsetProbeFfi"),
+                    (CONSOLIDATE_TARGET, "ZsetConsolidateFfi"),
+                    (EXPAND_TARGET, "ZsetExpandFfi"),
+                    (GATHER_TARGET, "ZsetGatherFfi"),
+                    (COMPACT_TARGET, "ZsetCompactFfi"),
+                    (PROBE_LADDER_TARGET, "ZsetProbeLadderFfi"),
+                    (RANK_FOLD_TARGET, "ZsetRankFoldFfi")):
+                _FFI.register_ffi_target(
+                    target, _FFI.pycapsule(getattr(_lib, symbol)),
+                    platform="cpu")
             _registered = True
     return _lib
 
 
 def available() -> bool:
-    """Library builds/loads on this machine (cached)."""
+    """Library builds/loads on this machine (cached) and the knobs allow
+    SOME native kernel (``DBSP_TPU_NATIVE=0`` / legacy
+    ``DBSP_TPU_NATIVE_MERGE=0`` are the all-off switches)."""
     if os.environ.get("DBSP_TPU_NATIVE_MERGE", "1") == "0":
+        return False
+    if os.environ.get("DBSP_TPU_NATIVE", "1").strip() == "0":
         return False
     try:
         _load()
         return True
     except RuntimeError:
         return False
+
+
+_warned_unknown_kernels: set = set()
+
+
+def kernel_enabled(kernel: str) -> bool:
+    """Per-kernel A/B switch: ``DBSP_TPU_NATIVE=<csv|0|1>``.
+
+    Unset/``1`` — every native kernel enabled (the default). ``0`` — all
+    disabled (same as the legacy ``DBSP_TPU_NATIVE_MERGE=0``). A csv of
+    names from :data:`KERNELS` (e.g. ``expand,gather``) FORCES those
+    kernels onto their XLA fallback while the rest stay native — so any
+    single kernel can be A/B'd from bench.py without code edits. A csv
+    entry that names no known kernel warns LOUDLY (once per value): a
+    typo'd force-off would otherwise no-op silently and corrupt the very
+    A/B evidence the knob exists to produce. Does not check library
+    availability; pair with :func:`available`."""
+    v = os.environ.get("DBSP_TPU_NATIVE", "1").strip()
+    if v == "0":
+        return False
+    if v in ("", "1"):
+        return True
+    off = {s.strip() for s in v.split(",") if s.strip()}
+    unknown = off - set(KERNELS)
+    if unknown and v not in _warned_unknown_kernels:
+        _warned_unknown_kernels.add(v)
+        import warnings
+
+        warnings.warn(
+            f"DBSP_TPU_NATIVE names unknown kernel(s) {sorted(unknown)} — "
+            f"they match nothing and force nothing off. Valid names: "
+            f"{', '.join(KERNELS)}", stacklevel=2)
+    return kernel not in off
 
 
 def _supported_dtype(d) -> bool:
@@ -274,3 +325,114 @@ def lex_probe_native(table_cols: Sequence[jnp.ndarray],
     if vma:
         pos = jax.lax.pcast(pos, tuple(vma), to="varying")
     return pos
+
+
+def _retag(out, ref):
+    """Re-tag custom-call results with the reference value's vma (see
+    merge_consolidated_cols — custom calls drop the tag under shard_map)."""
+    vma = _vma_of(ref)
+    if vma:
+        return tuple(jax.lax.pcast(o, tuple(vma), to="varying") for o in out)
+    return tuple(out)
+
+
+def lex_probe_ladder_native(tables, query_cols, side: str = "left"
+                            ) -> jnp.ndarray:
+    """ONE custom call probing the query rows into EVERY level's sorted
+    table (native/zset_merge.cpp::ZsetProbeLadderImpl) — drop-in for the
+    CPU branch of ``cursor.lex_probe_ladder``, replacing K separate probe
+    dispatches + a stack with a single [K, m] result."""
+    _load()
+    K = len(tables)
+    ncols = len(tables[0])
+    m = query_cols[0].shape[-1]
+    t64 = [c.astype(jnp.int64) for t in tables for c in t]
+    q64 = [c.astype(jnp.int64) for c in query_cols]
+    meta = jnp.asarray([K, ncols, 1 if side == "right" else 0], jnp.int64)
+    result = (jax.ShapeDtypeStruct((K, m), jnp.int32),)
+    out = _FFI.ffi_call(PROBE_LADDER_TARGET, result,
+                        vmap_method="sequential")(*t64, *q64, meta)
+    return _retag(out, query_cols[0])[0]
+
+
+def expand_ranges_native(lo: jnp.ndarray, hi: jnp.ndarray, out_cap: int):
+    """Sequential range expansion (ZsetExpandImpl) — drop-in for the CPU
+    branch of ``kernels.expand_ranges`` (and, over flattened [K*m] ranges,
+    ``cursor.expand_ladder``). Returns ``(row, src, valid, total)`` with
+    the same dtypes/tail contract as the searchsorted formulation."""
+    _load()
+    result = (jax.ShapeDtypeStruct((out_cap,), jnp.int32),
+              jax.ShapeDtypeStruct((out_cap,), jnp.int32),
+              jax.ShapeDtypeStruct((out_cap,), jnp.bool_),
+              jax.ShapeDtypeStruct((1,), jnp.int64))
+    out = _FFI.ffi_call(EXPAND_TARGET, result, vmap_method="sequential")(
+        lo.astype(jnp.int64), hi.astype(jnp.int64))
+    row, src, valid, total = _retag(out, lo)
+    return row, src, valid, total.reshape(())
+
+
+def gather_levels_native(cols_per_level, level: jnp.ndarray,
+                         src: jnp.ndarray):
+    """Grouped gather across trace levels (ZsetGatherImpl) — drop-in for
+    ``cursor._select_gather``: out[ci][j] = level[j]'s column ci at the
+    clamped src[j]. One pass instead of K clamped gathers + selects per
+    column."""
+    _load()
+    ncols = len(cols_per_level[0])
+    if not ncols:
+        return ()
+    dtypes = tuple(c.dtype for c in cols_per_level[0])
+    n = level.shape[-1]
+    tabs = [cols[ci].astype(jnp.int64)
+            for ci in range(ncols) for cols in cols_per_level]
+    result = tuple(jax.ShapeDtypeStruct((n,), jnp.int64)
+                   for _ in range(ncols))
+    out = _FFI.ffi_call(GATHER_TARGET, result, vmap_method="sequential")(
+        level.astype(jnp.int32), src.astype(jnp.int32), *tabs)
+    out = _retag(out, level)
+    return tuple(c.astype(d) for c, d in zip(out, dtypes))
+
+
+def compact_native(cols, weights: jnp.ndarray, keep: jnp.ndarray):
+    """Single-pass compaction (ZsetCompactImpl) — drop-in for the CPU
+    branch of ``kernels.compact``."""
+    _load()
+    ncols = len(cols)
+    dtypes = tuple(c.dtype for c in cols)
+    sentinels = tuple(
+        1 if np.dtype(d) == np.bool_ else int(np.iinfo(np.dtype(d)).max)
+        for d in dtypes)
+    cap = weights.shape[-1]
+    c64 = tuple(c.astype(jnp.int64) for c in cols)
+    result = tuple(jax.ShapeDtypeStruct((cap,), jnp.int64)
+                   for _ in range(ncols + 1))
+    out = _FFI.ffi_call(COMPACT_TARGET, result, vmap_method="sequential")(
+        *c64, weights.astype(jnp.int64), keep.astype(jnp.bool_),
+        jnp.asarray(sentinels, jnp.int64))
+    out = _retag(out, weights)
+    out_cols = tuple(c.astype(d) for c, d in zip(out[:ncols], dtypes))
+    return out_cols, out[ncols].astype(weights.dtype)
+
+
+def rank_fold_native(cols, weights: jnp.ndarray, runs):
+    """K-way merge consolidation of an R-run batch (ZsetRankFoldImpl) —
+    drop-in for the rank regime of ``batch.consolidate_regime``: one
+    custom call instead of a fold of R-1 pairwise merges. ``runs`` is the
+    STATIC sorted-run metadata (segment lengths summing to cap)."""
+    _load()
+    ncols = len(cols)
+    dtypes = tuple(c.dtype for c in cols)
+    sentinels = tuple(
+        1 if np.dtype(d) == np.bool_ else int(np.iinfo(np.dtype(d)).max)
+        for d in dtypes)
+    cap = weights.shape[-1]
+    c64 = tuple(c.astype(jnp.int64) for c in cols)
+    result = tuple(jax.ShapeDtypeStruct((cap,), jnp.int64)
+                   for _ in range(ncols + 1))
+    out = _FFI.ffi_call(RANK_FOLD_TARGET, result, vmap_method="sequential")(
+        *c64, weights.astype(jnp.int64),
+        jnp.asarray(tuple(runs), jnp.int64),
+        jnp.asarray(sentinels, jnp.int64))
+    out = _retag(out, weights)
+    out_cols = tuple(c.astype(d) for c, d in zip(out[:ncols], dtypes))
+    return out_cols, out[ncols].astype(weights.dtype)

@@ -1,0 +1,243 @@
+"""Pallas TPU prototypes of the engine's irregular-access inner loops.
+
+ROOFLINE §3 names XLA:TPU's lowering of the probe/gather loops as the
+projection's biggest unknown: dependent gathers lower to while loops at
+XLA's discretion, which is exactly the fusion guess the DBSP
+delta-proportional cost model cannot afford to lose. These kernels take
+that lowering into our own hands (the MegaBlocks move: stop trusting the
+compiler on irregular gather/scatter and hand-write the hot loop):
+
+* :func:`lex_probe_ladder_pallas` — the ladder-wide lexicographic binary
+  search (``cursor.lex_probe_ladder``) as ONE Pallas program, grid over
+  trace levels, each program resolving every query against its level's
+  sorted key columns with static block shapes ([K, maxcap] stacked tables,
+  [1, m] query lanes).
+* :func:`rank_merge_scatter` — the rank-merge inner loop of
+  ``kernels.merge_sorted_cols`` (cross-rank binary search + position
+  scatter) as a single program; the netting/compaction tail stays shared
+  with the XLA path.
+
+Selection: :func:`use_pallas` — ON when ``jax.default_backend() != "cpu"``
+(the CPU backend keeps its native C++ custom calls), overridable with
+``DBSP_TPU_PALLAS`` (``0``/``off`` force off everywhere; ``1``/``on``
+force on; ``interpret`` forces the INTERPRETER — how the tier-1 suite
+bit-identity-tests these kernels on CPU with no TPU attached, and the
+mode every kernel here runs in automatically when the backend is CPU).
+The first live tunnel run via tools/aot_tpu.py measures the compiled
+variants; until then interpret-mode identity is the maintained contract.
+
+Integer/bool columns only (widened to int64 like the native C++ path —
+sign-extension preserves lexicographic order); float columns stay on the
+XLA formulation. All outputs are bit-identical to the XLA reference
+(tests/test_pallas_kernels.py proves it on adversarial ladders).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+Cols = Tuple[jnp.ndarray, ...]
+
+
+def _mode() -> str:
+    return os.environ.get("DBSP_TPU_PALLAS", "").strip().lower()
+
+
+def enabled() -> bool:
+    """Pallas kernels selected for dispatch (see module doc). The
+    force-on spellings are shared with the dispatch pre-checks
+    (``kernels.PALLAS_FORCE_ON``) so the grammar cannot drift."""
+    from dbsp_tpu.zset.kernels import PALLAS_FORCE_ON
+
+    m = _mode()
+    if m in ("0", "off", "false"):
+        return False
+    if m in PALLAS_FORCE_ON:
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def interpret_mode() -> bool:
+    """Run under the Pallas interpreter instead of Mosaic — forced by
+    ``DBSP_TPU_PALLAS=interpret`` and automatic on the CPU backend (there
+    is no Mosaic target there; this is what makes the tier-1 suite able
+    to execute these kernels)."""
+    return _mode() == "interpret" or jax.default_backend() == "cpu"
+
+
+def _supported_dtype(d) -> bool:
+    d = jnp.dtype(d)
+    return jnp.issubdtype(d, jnp.integer) or d == jnp.bool_
+
+
+def use_pallas(kernel: str, cols) -> bool:
+    """Dispatch gate for one call site: pallas enabled AND every operand
+    column int64-widenable. ``kernel`` mirrors the dispatch-counter name
+    (``probe_ladder`` / ``rank_merge``) so a future per-kernel split of
+    the env knob has a stable vocabulary."""
+    return enabled() and all(_supported_dtype(c.dtype) for c in cols)
+
+
+# ---------------------------------------------------------------------------
+# Shared in-kernel primitive: vectorized lexicographic binary search
+# ---------------------------------------------------------------------------
+
+
+def _lex_search(table_cols, query_cols, n, steps: int, strict: bool,
+                hi_init=None):
+    """Insertion points of ``query`` lanes into ``table`` lanes ([1, m]
+    int32) — the same mid-split recurrence as ``kernels.lex_probe``, so the
+    converged result is bit-identical. ``n`` may be a traced per-level
+    cap; ``steps`` must statically cover ceil(log2(n + 1))."""
+    m = query_cols[0].shape[-1]
+    lo = jnp.zeros((1, m), jnp.int32)
+    hi = jnp.full((1, m), n, jnp.int32) if hi_init is None else hi_init
+
+    def step(_, lohi):
+        lo, hi = lohi
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        lt = jnp.zeros((1, m), jnp.bool_)
+        eq = jnp.ones((1, m), jnp.bool_)
+        for t, q in zip(table_cols, query_cols):
+            tv = jnp.take_along_axis(t, mid, axis=1)
+            lt = lt | (eq & (tv < q))
+            eq = eq & (tv == q)
+        go_right = lt if strict else (lt | eq)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, step, (lo, hi))
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# Ladder-wide probe
+# ---------------------------------------------------------------------------
+
+
+def _probe_ladder_kernel(caps_ref, *refs, ncols: int, steps: int,
+                         strict: bool):
+    tabs = [refs[i][:] for i in range(ncols)]            # [1, maxcap]
+    qs = [refs[ncols + i][:] for i in range(ncols)]      # [1, m]
+    out_ref = refs[2 * ncols]
+    cap = caps_ref[0, 0]
+    out_ref[:] = _lex_search(tabs, qs, cap, steps, strict)
+
+
+def lex_probe_ladder_pallas(tables: Sequence[Cols], query_cols: Cols,
+                            side: str = "left") -> jnp.ndarray:
+    """Drop-in for the accelerator branch of ``cursor.lex_probe_ladder``:
+    grid over the K trace levels, one program per level, each resolving
+    all m queries with an in-VMEM binary search over its level's stacked
+    (sentinel-padded) key columns. Returns [K, m] int32, lane (k, i) ==
+    ``lex_probe(tables[k], query_cols, side)[i]`` bit-for-bit."""
+    assert tables and query_cols
+    K = len(tables)
+    ncols = len(query_cols)
+    m = query_cols[0].shape[-1]
+    caps = [t[0].shape[-1] for t in tables]
+    maxcap = max(caps)
+    steps = max(c.bit_length() for c in caps)
+    # stack heterogeneous levels into [K, maxcap] per column; the pad value
+    # is never read (the search clamps hi to the level's own cap)
+    stacked = []
+    for ci in range(ncols):
+        rows = []
+        for t in tables:
+            c = t[ci].astype(jnp.int64)
+            if c.shape[-1] < maxcap:
+                c = jnp.concatenate(
+                    [c, jnp.full((maxcap - c.shape[-1],), jnp.iinfo(
+                        jnp.int64).max, jnp.int64)])
+            rows.append(c)
+        stacked.append(jnp.stack(rows))
+    qcols = [q.astype(jnp.int64).reshape(1, m) for q in query_cols]
+    caps_arr = jnp.asarray(caps, jnp.int32).reshape(K, 1)
+
+    grid = (K,)
+    in_specs = [pl.BlockSpec((1, 1), lambda k: (k, 0))]
+    in_specs += [pl.BlockSpec((1, maxcap), lambda k: (k, 0))
+                 for _ in range(ncols)]
+    in_specs += [pl.BlockSpec((1, m), lambda k: (0, 0))
+                 for _ in range(ncols)]
+    out = pl.pallas_call(
+        partial(_probe_ladder_kernel, ncols=ncols, steps=steps,
+                strict=side == "left"),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, m), lambda k: (k, 0)),
+        out_shape=jax.ShapeDtypeStruct((K, m), jnp.int32),
+        interpret=interpret_mode(),
+    )(caps_arr, *stacked, *qcols)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rank-merge inner loop (cross-rank probe + position scatter)
+# ---------------------------------------------------------------------------
+
+
+def _rank_merge_kernel(*refs, ncols: int, na: int, nb: int, steps_a: int,
+                       steps_b: int):
+    acols = [refs[i][:] for i in range(ncols)]                   # [1, na]
+    wa = refs[ncols][:]
+    bcols = [refs[ncols + 1 + i][:] for i in range(ncols)]       # [1, nb]
+    wb = refs[2 * ncols + 1][:]
+    sent_ref = refs[2 * ncols + 2]                               # [1, ncols]
+    out_refs = refs[2 * ncols + 3: 3 * ncols + 3]
+    ow_ref = refs[3 * ncols + 3]
+    # cross-ranks: b-rows strictly before a_i; a-rows at-or-before b_j —
+    # the bijective position map of kernels.merge_sorted_cols' rank path
+    ra = _lex_search(bcols, acols, nb, steps_b, strict=True)
+    rb = _lex_search(acols, bcols, na, steps_a, strict=False)
+    pos_a = (jax.lax.broadcasted_iota(jnp.int32, (1, na), 1) + ra)[0]
+    pos_b = (jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1) + rb)[0]
+    for ci in range(ncols):
+        buf = jnp.full((na + nb,), sent_ref[0, ci], jnp.int64)
+        buf = buf.at[pos_a].set(acols[ci][0]).at[pos_b].set(bcols[ci][0])
+        out_refs[ci][:] = buf[None, :]
+    w = jnp.zeros((na + nb,), jnp.int64)
+    w = w.at[pos_a].set(wa[0]).at[pos_b].set(wb[0])
+    ow_ref[:] = w[None, :]
+
+
+def rank_merge_scatter(cols_a: Cols, w_a: jnp.ndarray, cols_b: Cols,
+                       w_b: jnp.ndarray):
+    """The rank-merge inner loop as ONE Pallas program: both cross-rank
+    binary searches plus the position scatters of every column and the
+    weights. Returns the scattered (pre-netting) ``(cols, w)`` buffers of
+    capacity na+nb — bit-identical to the ``.at[pos].set`` formulation in
+    ``kernels.merge_sorted_cols``; the caller's netting + compaction tail
+    is unchanged."""
+    ncols = len(cols_a)
+    assert ncols and w_a.ndim == 1 and w_b.ndim == 1
+    na, nb = int(w_a.shape[0]), int(w_b.shape[0])
+    dtypes = tuple(c.dtype for c in cols_a)
+    sent = jnp.asarray(
+        [1 if np.dtype(d) == np.bool_ else int(np.iinfo(np.dtype(d)).max)
+         for d in dtypes], jnp.int64).reshape(1, ncols)
+    a64 = [c.astype(jnp.int64).reshape(1, na) for c in cols_a]
+    b64 = [c.astype(jnp.int64).reshape(1, nb) for c in cols_b]
+    out_shapes = tuple(jax.ShapeDtypeStruct((1, na + nb), jnp.int64)
+                       for _ in range(ncols + 1))
+    out = pl.pallas_call(
+        partial(_rank_merge_kernel, ncols=ncols, na=na, nb=nb,
+                steps_a=na.bit_length(), steps_b=nb.bit_length()),
+        out_shape=out_shapes,
+        interpret=interpret_mode(),
+    )(*a64, w_a.astype(jnp.int64).reshape(1, na),
+      *b64, w_b.astype(jnp.int64).reshape(1, nb), sent)
+    out_cols = tuple(c.reshape(na + nb).astype(d)
+                     for c, d in zip(out[:ncols], dtypes))
+    w = out[ncols].reshape(na + nb).astype(w_a.dtype)
+    return out_cols, w

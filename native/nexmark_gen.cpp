@@ -13,6 +13,14 @@
 #include <cstdint>
 #include <cstring>
 
+// Source provenance stamp (see native/zset_merge.cpp + the staleness lint
+// in tools/build_native.py): builds pass -DDBSP_TPU_SRC_SHA256="<sha>".
+#ifndef DBSP_TPU_SRC_SHA256
+#define DBSP_TPU_SRC_SHA256 "unstamped"
+#endif
+
+extern "C" const char* dbsp_src_sha256() { return DBSP_TPU_SRC_SHA256; }
+
 namespace {
 
 constexpr int64_t PERSON_PROPORTION = 1;

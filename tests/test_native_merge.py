@@ -81,6 +81,198 @@ def test_strategy_selected_on_cpu():
         assert kernels.merge_strategy() == "native"
 
 
+# ---------------------------------------------------------------------------
+# new native entry points: expand / gather / compact / rank-fold / ladder
+# probe — native vs XLA property tests (the per-kernel force-off knob is
+# the A/B switch, so these also pin the DBSP_TPU_NATIVE grammar)
+# ---------------------------------------------------------------------------
+
+
+def _xla_only(monkeypatch):
+    monkeypatch.setenv("DBSP_TPU_NATIVE", "0")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_expand_ranges_native_matches_xla(monkeypatch, seed):
+    from dbsp_tpu.zset import kernels
+
+    rng = np.random.default_rng(200 + seed)
+    m = int(rng.integers(1, 60))
+    lo = np.sort(rng.integers(0, 100, m)).astype(np.int32)
+    widths = rng.integers(0, 5, m) * rng.integers(0, 2, m)
+    if seed == 4:
+        widths[:] = 0  # total == 0: every slot invalid
+    hi = (lo + widths).astype(np.int32)
+    out_cap = [64, 8][seed % 2]  # 8 often overflows (tail contract)
+    got = kernels.expand_ranges(jnp.asarray(lo), jnp.asarray(hi), out_cap)
+    _xla_only(monkeypatch)
+    want = kernels.expand_ranges(jnp.asarray(lo), jnp.asarray(hi), out_cap)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g.dtype == w.dtype and g.shape == w.shape
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w),
+                                      err_msg=f"output {i}")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_compact_native_matches_xla(monkeypatch, seed):
+    from dbsp_tpu.zset import kernels
+
+    rng = np.random.default_rng(300 + seed)
+    cap = 64
+    dtypes = (np.int64, np.int32, bool)[:(seed % 2) + 2]
+    cols = tuple(jnp.asarray(rng.integers(0, 2 if d is bool else 50, cap)
+                             .astype(d)) for d in dtypes)
+    wdtype = np.int32 if seed == 3 else np.int64  # aggregate passes int32
+    w = jnp.asarray(rng.integers(-2, 3, cap).astype(wdtype))
+    keep = jnp.asarray(rng.integers(0, 2, cap).astype(bool))
+    got_cols, got_w = kernels.compact(cols, w, keep)
+    _xla_only(monkeypatch)
+    want_cols, want_w = kernels.compact(cols, w, keep)
+    assert got_w.dtype == want_w.dtype
+    for g, wv in zip((*got_cols, got_w), (*want_cols, want_w)):
+        assert g.dtype == wv.dtype
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(wv))
+
+
+def _ladder(rng, caps=(64, 32, 16, 8)):
+    from dbsp_tpu.zset.batch import Batch as B
+
+    levels = []
+    for cap in caps:
+        n = int(rng.integers(0, cap // 2 + 1))
+        cols = [rng.integers(0, 12, n).astype(np.int64) for _ in range(3)]
+        ws = rng.integers(-2, 3, n)
+        ws[ws == 0] = 1
+        levels.append(B.from_columns(cols[:2], cols[2:], ws, cap=cap))
+    return levels
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_join_ladder_native_matches_xla(monkeypatch, seed):
+    """probe-ladder + expand + leveled gather, end to end through the
+    fused join cursor (the q4 hot path), native vs XLA."""
+    from dbsp_tpu.zset import cursor
+    from dbsp_tpu.zset.batch import Batch as B
+
+    rng = np.random.default_rng(400 + seed)
+    levels = _ladder(rng)
+    n = 12
+    cols = [rng.integers(0, 12, n).astype(np.int64) for _ in range(3)]
+    ws = rng.integers(-2, 3, n)
+    ws[ws == 0] = 1
+    delta = B.from_columns(cols[:2], cols[2:], ws, cap=16)
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    out_cap = 16 if seed == 3 else 512  # 16 exercises overflow truncation
+    got_b, got_t = cursor.join_ladder(delta, levels, 2, fn, out_cap)
+    _xla_only(monkeypatch)
+    want_b, want_t = cursor.join_ladder(delta, levels, 2, fn, out_cap)
+    assert int(got_t) == int(want_t)
+    for g, w in zip((*got_b.cols, got_b.weights),
+                    (*want_b.cols, want_b.weights)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_probe_ladder_native_matches_xla(monkeypatch, side):
+    from dbsp_tpu.zset import cursor
+
+    rng = np.random.default_rng(77)
+    levels = _ladder(rng)
+    tables = [lvl.keys for lvl in levels]
+    q = tuple(jnp.asarray(rng.integers(0, 14, 24).astype(np.int64))
+              for _ in range(2))
+    got = np.asarray(cursor.lex_probe_ladder(tables, q, side))
+    _xla_only(monkeypatch)
+    want = np.asarray(cursor.lex_probe_ladder(tables, q, side))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("nruns", [2, 3, 5, 8])
+def test_rank_fold_native_matches_sort(monkeypatch, nruns):
+    from dbsp_tpu.zset import kernels
+    from dbsp_tpu.zset.batch import Batch as B, concat_batches
+
+    rng = np.random.default_rng(500 + nruns)
+    parts = []
+    for _ in range(nruns):
+        n = int(rng.integers(0, 20))
+        cols = [rng.integers(0, 8, n).astype(np.int64) for _ in range(3)]
+        ws = rng.integers(-2, 3, n)
+        ws[ws == 0] = 1
+        parts.append(B.from_columns(cols[:2], cols[2:], ws, cap=32))
+    parts.append(parts[0].neg())  # exact cancellation across runs
+    cat = concat_batches(parts)
+    before = dict(kernels.KERNEL_DISPATCH_COUNTS)
+    folded = cat.consolidate()
+    assert kernels.KERNEL_DISPATCH_COUNTS.get(("rank_fold", "native"), 0) \
+        > before.get(("rank_fold", "native"), 0)
+    _xla_only(monkeypatch)
+    sort_ref = cat.tagged(None).consolidate()
+    for g, w in zip((*folded.cols, folded.weights),
+                    (*sort_ref.cols, sort_ref.weights)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_native_build_stamp_lint_clean():
+    """The staleness lint (tools/build_native.py): the zset library this
+    suite just exercised must carry the hash of the checked-out source —
+    a cached binary drifted from its .cpp is a red tier-1 test, not a
+    silent wrong-vintage kernel."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.build_native import check_tree, embedded_sha, sha256_file
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = [v for v in check_tree(root) if "zset_merge" in v]
+    assert violations == []
+    # and the embedded stamp is actually present (available() built it)
+    got = embedded_sha(os.path.join(root, "native", "libzset_merge.so"))
+    assert got == sha256_file(os.path.join(root, "native",
+                                           "zset_merge.cpp"))
+
+
+def test_kernel_enabled_grammar(monkeypatch):
+    """DBSP_TPU_NATIVE: unset/1 = all on, 0 = all off, csv = force-off
+    list; legacy DBSP_TPU_NATIVE_MERGE=0 still kills everything."""
+    monkeypatch.delenv("DBSP_TPU_NATIVE", raising=False)
+    assert native_merge.kernel_enabled("expand")
+    monkeypatch.setenv("DBSP_TPU_NATIVE", "0")
+    assert not native_merge.kernel_enabled("expand")
+    assert not native_merge.available()
+    monkeypatch.setenv("DBSP_TPU_NATIVE", "expand, gather")
+    assert not native_merge.kernel_enabled("expand")
+    assert not native_merge.kernel_enabled("gather")
+    assert native_merge.kernel_enabled("merge")
+    assert native_merge.available()
+    monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
+    monkeypatch.setenv("DBSP_TPU_NATIVE_MERGE", "0")
+    assert not native_merge.available()
+
+
+def test_unsupported_dtype_demotion_is_counted(monkeypatch):
+    """A float column demotes native->sort and is counted under its own
+    consolidate path (satellite: silent-fallback visibility)."""
+    from dbsp_tpu.zset import kernels
+
+    cols = (jnp.asarray(np.array([3.0, 1.0, 2.0], np.float64)),)
+    w = jnp.ones((3,), jnp.int64)
+    before = dict(kernels.CONSOLIDATE_COUNTS)
+    kernels.consolidate_cols(cols, w)
+    delta = {k: v - before.get(k, 0)
+             for k, v in kernels.CONSOLIDATE_COUNTS.items()}
+    assert delta["native_unsupported_dtype"] == 1
+    assert delta.get("sort", 0) == 0
+    # the merge entry point demotes through the same counter
+    before = dict(kernels.CONSOLIDATE_COUNTS)
+    kernels.merge_sorted_cols(cols, w, cols, w)
+    delta = {k: v - before.get(k, 0)
+             for k, v in kernels.CONSOLIDATE_COUNTS.items()}
+    assert delta["native_unsupported_dtype"] == 1
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_jit_path_matches(seed):
     """merge_with inside jit (the compiled-circuit context) stays exact."""

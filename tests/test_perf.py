@@ -116,6 +116,15 @@ def test_throughput_within_band(qname):
 # microbench shapes have more scheduler/cache jitter than a 16-tick run.
 KERNEL_BAND = float(os.environ.get("PERF_KERNEL_BAND", 2 * PERF_BAND))
 
+# Every kernel path the microbench must keep floors for — grows with the
+# kernel substrate; a recording that silently drops one is a red test,
+# not a silent coverage hole.
+EXPECTED_KERNELS = {
+    "consolidate", "rank_fold", "lex_probe", "lex_probe_ladder",
+    "merge_sorted_cols", "expand_ranges", "compact", "gather_ladder",
+    "flight_record",
+}
+
 
 def test_kernel_microbench_floor():
     """Coarse per-kernel floor (tools/microbench_kernels.py): a kernel that
@@ -126,6 +135,11 @@ def test_kernel_microbench_floor():
     if not base:
         pytest.skip("perf_baseline.json has no kernels section — record "
                     "with `python tools/record_perf.py`")
+    missing = EXPECTED_KERNELS - set(base)
+    assert not missing, (
+        f"perf_baseline.json kernels section is missing {sorted(missing)} "
+        "— re-record with `python tools/record_perf.py` so the new "
+        "kernel paths are floor-gated")
     import sys
 
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(

@@ -1,0 +1,227 @@
+"""Reproducible builds + staleness lint for the native libraries.
+
+The native shared objects (``native/lib*.so``, gitignored) are built
+lazily per machine and CACHED in the working tree, guarded only by an
+mtime comparison — which means a stale or foreign binary (source edited
+under a preserved mtime, a binary copied in from another checkout or
+built from different source) used to be undetectable: the engine would
+silently serve wrong-vintage kernels. This tool closes that hole:
+
+* ``python tools/build_native.py``            — rebuild every library from
+  source with the RECORDED flags, stamping the source SHA-256 INTO the
+  binary (``-DDBSP_TPU_SRC_SHA256`` → the ``dbsp_src_sha256()`` symbol)
+  and recording ``native/BUILD_STAMP.json`` (source + binary hashes +
+  flags; a local build record, gitignored like the binaries) alongside.
+* ``python tools/build_native.py --check``    — the staleness lint: reads
+  each PRESENT binary's embedded hash back (dlopen, no XLA involved)
+  and compares it against the hash of the checked-out ``.cpp``, plus the
+  recorded stamp file when one exists. A missing binary is NOT a
+  violation (it builds on first use); a present binary that does not
+  match its source is. Wired into ``tools/lint_all.py`` and tier-1 via
+  tests/test_native_merge.py, so a drifted cached binary is a red lint.
+
+The mtime-triggered dev rebuilds (``zset/native_merge.py``,
+``nexmark/native.py``) route their g++ invocations through
+:func:`compile_so` here, so EVERY build path stamps identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+
+STAMP_PATH = os.path.join("native", "BUILD_STAMP.json")
+
+# The recorded build matrix. ``ffi_include`` adds the jax XLA-FFI header
+# path (resolved at build time — it is environment-dependent and therefore
+# NOT part of the recorded identity).
+LIBRARIES = (
+    {"name": "zset_merge",
+     "src": os.path.join("native", "zset_merge.cpp"),
+     "so": os.path.join("native", "libzset_merge.so"),
+     "flags": ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC"],
+     "ffi_include": True},
+    {"name": "nexmark_gen",
+     "src": os.path.join("native", "nexmark_gen.cpp"),
+     "so": os.path.join("native", "libnexmark_gen.so"),
+     "flags": ["-O3", "-march=native", "-shared", "-fPIC"],
+     "ffi_include": False},
+)
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def compile_so(src: str, so: str, flags: List[str],
+               include_dirs: Optional[List[str]] = None) -> None:
+    """One stamped g++ invocation (raises RuntimeError with stderr on
+    failure) — the single chokepoint every build path goes through. Also
+    refreshes this library's BUILD_STAMP entry so an mtime-triggered dev
+    rebuild cannot leave the staleness lint pointing at a stale record."""
+    cmd = ["g++", *flags,
+           f'-DDBSP_TPU_SRC_SHA256="{sha256_file(src)}"']
+    for inc in include_dirs or ():
+        cmd.append(f"-I{inc}")
+    cmd += ["-o", so, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError:
+        raise RuntimeError("g++ not found; native build unavailable") \
+            from None
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from None
+    _update_stamp(src, so, flags)
+
+
+def _update_stamp(src: str, so: str, flags: List[str]) -> None:
+    """Merge one library's build record into the stamp file (best effort —
+    a read-only tree must not fail the build itself)."""
+    name = None
+    for lib in LIBRARIES:
+        if os.path.basename(lib["so"]) == os.path.basename(so):
+            name = lib["name"]
+            break
+    if name is None:
+        return
+    root = os.path.dirname(os.path.dirname(os.path.abspath(so)))
+    stamp_file = os.path.join(root, STAMP_PATH)
+    try:
+        with open(stamp_file) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        rec = {}
+    rec[name] = {
+        "src": os.path.relpath(src, root),
+        "so": os.path.relpath(so, root),
+        "flags": list(flags),
+        "src_sha256": sha256_file(src),
+        "so_sha256": sha256_file(so),
+    }
+    try:
+        with open(stamp_file, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
+
+def _ffi_include_dir() -> str:
+    from dbsp_tpu.zset.native_merge import _ffi_module
+
+    ffi = _ffi_module()
+    if ffi is None:
+        raise RuntimeError("XLA FFI API unavailable in this jax version")
+    return ffi.include_dir()
+
+
+def embedded_sha(so_path: str) -> Optional[str]:
+    """The source hash a binary was stamped with (``None`` when the symbol
+    is missing — a pre-stamp build)."""
+    try:
+        lib = ctypes.CDLL(so_path)
+        fn = lib.dbsp_src_sha256
+    except (OSError, AttributeError):
+        return None
+    fn.restype = ctypes.c_char_p
+    return fn().decode()
+
+
+def build_all(root: str = _ROOT) -> Dict[str, dict]:
+    """Rebuild every recorded library (compile_so stamps each as it
+    goes); returns the resulting stamp records."""
+    for lib in LIBRARIES:
+        src = os.path.join(root, lib["src"])
+        so = os.path.join(root, lib["so"])
+        incs = [_ffi_include_dir()] if lib["ffi_include"] else []
+        compile_so(src, so, list(lib["flags"]), incs)
+    with open(os.path.join(root, STAMP_PATH)) as f:
+        return json.load(f)
+
+
+def check_tree(root: str = _ROOT) -> List[str]:
+    """Staleness lint: every PRESENT cached binary must carry the hash of
+    the checked-out sources (and match the local stamp record when one
+    exists). A missing binary/stamp is fine — they materialize on first
+    use. Returns violation strings; empty means clean."""
+    fix = "rebuild + restamp with `python tools/build_native.py`"
+    violations: List[str] = []
+    stamp_file = os.path.join(root, STAMP_PATH)
+    recorded: Dict[str, dict] = {}
+    if os.path.exists(stamp_file):
+        try:
+            with open(stamp_file) as f:
+                recorded = json.load(f)
+        except ValueError:
+            violations.append(f"{STAMP_PATH}: unreadable JSON — {fix}")
+    for lib in LIBRARIES:
+        src = os.path.join(root, lib["src"])
+        so = os.path.join(root, lib["so"])
+        name = lib["name"]
+        if not os.path.exists(so):
+            continue  # lazy-built on first use — nothing to drift yet
+        src_sha = sha256_file(src)
+        got = embedded_sha(so)
+        if got is None:
+            violations.append(
+                f"{lib['so']}: no embedded source stamp (pre-stamp or "
+                f"out-of-tree build) — {fix}")
+        elif got != src_sha:
+            violations.append(
+                f"{lib['so']}: embedded source hash {got[:12]}… != "
+                f"checked-out {lib['src']} hash {src_sha[:12]}… (cached "
+                f"binary drifted from source) — {fix}")
+        rec = recorded.get(name)
+        if rec is None:
+            continue  # no local build record for this lib — nothing more
+        if rec.get("src_sha256") != src_sha:
+            violations.append(
+                f"{STAMP_PATH}: {name} records source hash "
+                f"{str(rec.get('src_sha256'))[:12]}… but {lib['src']} "
+                f"hashes {src_sha[:12]}… — {fix}")
+        so_sha = sha256_file(so)
+        if rec.get("so_sha256") != so_sha:
+            violations.append(
+                f"{STAMP_PATH}: {name} records binary hash "
+                f"{str(rec.get('so_sha256'))[:12]}… but {lib['so']} "
+                f"hashes {so_sha[:12]}… (binary replaced without "
+                f"restamp) — {fix}")
+    return violations
+
+
+def main() -> int:
+    sys.path.insert(0, _ROOT)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="lint only (no rebuild)")
+    args = ap.parse_args()
+    if args.check:
+        violations = check_tree()
+        for v in violations:
+            print(v)
+        print(f"build_native --check: "
+              f"{'ok' if not violations else f'{len(violations)} stale'}")
+        return 1 if violations else 0
+    stamp = build_all()
+    for name, rec in sorted(stamp.items()):
+        print(f"built {rec['so']}  src {rec['src_sha256'][:12]}…  "
+              f"flags {' '.join(rec['flags'])}")
+    print(f"wrote {STAMP_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,8 @@ the tier-1 test in tests/test_analysis.py):
    bodies / jitted functions; no load-bearing asserts in circuit/ and io/.
 2b. ``tools/check_state.py``   — every serving-state field is claimed by
    the checkpoint schema registry (restore can never silently drop state).
+2c. ``tools/build_native.py``  — cached native binaries carry the
+   SHA-256 of their checked-out sources (a drifted ``.so`` is a red lint).
 3. **Analyzer self-check** — build every Nexmark query circuit plus a set
    of representative demo circuits and run the static analyzer
    (dbsp_tpu/analysis) over each: any ERROR finding is a lint failure
@@ -45,6 +47,12 @@ def run_check_hotpath() -> list:
 
 def run_check_state() -> list:
     from tools.check_state import check_tree
+
+    return check_tree(_ROOT)
+
+
+def run_check_native() -> list:
+    from tools.build_native import check_tree
 
     return check_tree(_ROOT)
 
@@ -127,6 +135,7 @@ def main() -> int:
     fronts = [("check_metrics", run_check_metrics),
               ("check_hotpath", run_check_hotpath),
               ("check_state", run_check_state),
+              ("check_native", run_check_native),
               ("analyzer_selfcheck", run_analyzer_selfcheck)]
     failed = 0
     for name, fn in fronts:

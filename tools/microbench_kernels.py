@@ -14,7 +14,18 @@ Kernels & shapes (ROOFLINE §1):
   * lex_probe_ladder — the same queries fused over a 4-level ladder
                        (1M/256k/64k/16k rows — zset/cursor.py);
   * merge_sorted_cols— spine tail-class merge, 1M + 64k rows x 7 cols;
-  * expand_ranges    — 16k ranges expanded into a 64k slot buffer.
+  * expand_ranges    — 16k ranges expanded into a 64k slot buffer;
+  * compact          — live-row packing of a half-dead 16k x 6-col run
+                       (the filter/distinct/upsert output shape);
+  * gather_ladder    — the fused group gather (probe + expand + leveled
+                       gather) of 4096 query keys against a 4-level
+                       ladder (262k..4k rows) into 8192 slots — ROOFLINE
+                       §1's "group gather" row, end to end.
+
+Every entry dispatches through the engine's own backend switch, so the
+measured path follows DBSP_TPU_NATIVE / DBSP_TPU_PALLAS — A/B a single
+kernel with e.g. ``DBSP_TPU_NATIVE=expand python tools/microbench_kernels.py``
+(forces expand alone onto XLA; see zset/native_merge.py::kernel_enabled).
 
 Run:  python tools/microbench_kernels.py            (JSON to stdout)
       python tools/microbench_kernels.py --reps 9   (more samples)
@@ -136,7 +147,35 @@ def run(reps: int = 5) -> dict:
         "ms": _time(lambda l, h: kernels.expand_ranges(l, h, 65_536),
                     lo, hi, reps=reps)}
 
-    # 7) flight-recorder steady-state overhead: one tick event recorded
+    # 7) compaction: pack the live half of a 16k-row run (the shape every
+    #    filter / distinct / upsert output pays per tick)
+    ccols = _cols(n, k6, sort_first=True, seed=11)
+    cw = jnp.asarray(np.random.default_rng(12).integers(-1, 2, n)
+                     .astype(np.int64))
+    out["compact"] = {
+        "shape": f"{n} rows x {k6} cols (~half live)",
+        "ms": _time(lambda c, w: kernels.compact(c, w, w != 0),
+                    ccols, cw, reps=reps)}
+
+    # 8) fused group gather: probe + cross-level expansion + leveled value
+    #    gather for 4096 query keys over a 4-level ladder (ROOFLINE §1
+    #    "group gather" at q4 aggregate shapes)
+    glevels = []
+    for i, cap in enumerate((262_144, 65_536, 16_384, 4_096)):
+        kc = _cols(cap, 2, seed=20 + i)
+        vc = _cols(cap, 4, sort_first=False, seed=30 + i)
+        glevels.append(Batch(kc, vc, jnp.ones((cap,), jnp.int64),
+                             runs=(cap,)))
+    gq = 4_096
+    qkeys = tuple(c[:gq] for c in _cols(gq, 2, seed=40))
+    qlive = jnp.ones((gq,), bool)
+    out["gather_ladder"] = {
+        "shape": f"{gq} groups x 4 levels (262144..4096 rows) -> 8192 "
+                 "slots",
+        "ms": _time(lambda qk, ql: cursor.gather_ladder(
+            qk, ql, glevels, 8_192)[0], qkeys, qlive, reps=reps)}
+
+    # 9) flight-recorder steady-state overhead: one tick event recorded
     #    into the bounded ring (dbsp_tpu/obs/flight.py) — pure host work,
     #    no device dispatch. Reported as ms per 1000 events; the tier-1
     #    gate (tests/test_flight.py) bounds the per-event cost at < 2% of

@@ -41,9 +41,40 @@ import numpy as np  # noqa: E402
 # v5e single-chip headline specs (public): 819 GB/s HBM, 394 bf16 GFLOP/s
 # per MXU lane irrelevant here — every kernel below is memory-bound.
 V5E_HBM_GBS = 819
-# measured single-core CPU effective bandwidth on this host (streaming
-# copy, from the native-merge microbenches): ~8 GB/s
-CPU_EFF_GBS = 8
+# fallback CPU effective bandwidth when the fit-time measurement is
+# unavailable: the PR-4 reference host's ~8 GB/s
+CPU_EFF_GBS_FALLBACK = 8
+
+# The PR-4 reference-host calibration (BENCH_local_fused_cursors.json on
+# its container): measured q4 kernel-side ms/tick and the 8 GB/s model
+# prediction it was fitted against. Containers differ round to round
+# (core speed varies ~3x at similar memory bandwidth), so cross-host
+# kernel-side changes are reported by scaling THIS fixed reference with a
+# same-host A/B ratio (--bench vs --bench-off), never by comparing raw
+# ms across hosts.
+REF_KERNEL_MS = 8.2
+REF_PRED_MS = 1.74  # 13.9 MB/tick at 8 GB/s
+REF_GAP = REF_KERNEL_MS / REF_PRED_MS  # the "4.7x" ROADMAP item 5 names
+
+
+def _host_bandwidth_gbs() -> float:
+    """Measured streaming (copy) bandwidth of THIS host, GB/s — the
+    denominator the CPU-side roofline prediction must use for a same-host
+    gap to mean anything. ~0.3 s, single-threaded numpy copy."""
+    import time
+
+    try:
+        a = np.random.randint(0, 1000, 20_000_000).astype(np.int64)
+        b = np.empty_like(a)
+        np.copyto(b, a)  # warm pages
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.copyto(b, a)
+            best = min(best, time.perf_counter() - t0)
+        return (a.nbytes * 2 / 1e9) / best
+    except Exception:  # noqa: BLE001 — fall back to the reference figure
+        return float(CPU_EFF_GBS_FALLBACK)
 
 
 def _cost(fn, *args):
@@ -91,10 +122,15 @@ def kernel_table():
             .at[pos_b].set(wb)
         return tuple(out), w
 
-    # force the pure-XLA path for analysis (native callbacks are opaque
-    # to cost analysis and never run on TPU anyway)
-    native = os.environ.get("DBSP_TPU_NATIVE_MERGE")
+    # force the pure-XLA path for analysis (native custom calls and
+    # Pallas programs are opaque to cost analysis; the XLA HLO is the
+    # backend-independent traffic model)
+    saved = {k: os.environ.get(k) for k in
+             ("DBSP_TPU_NATIVE_MERGE", "DBSP_TPU_NATIVE",
+              "DBSP_TPU_PALLAS")}
     os.environ["DBSP_TPU_NATIVE_MERGE"] = "0"
+    os.environ["DBSP_TPU_NATIVE"] = "0"
+    os.environ["DBSP_TPU_PALLAS"] = "0"
     try:
         rows.append(("spine drain merge (rank)",
                      f"{na}+{nb} rows x {k} cols",
@@ -134,14 +170,15 @@ def kernel_table():
                          q, lv, l, 8192), qk, lvl, qlive),
                      8192 * 7 * 8 * 2))
     finally:
-        if native is None:
-            os.environ.pop("DBSP_TPU_NATIVE_MERGE", None)
-        else:
-            os.environ["DBSP_TPU_NATIVE_MERGE"] = native
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return rows
 
 
-def per_tick_model():
+def per_tick_model(cpu_gbs: float = CPU_EFF_GBS_FALLBACK):
     """Amortized per-tick HBM bytes for q4 at the bench protocol
     (7,500 ev/tick CPU; 100,000 ev/tick TPU), from the LSM cost model:
     every row passes each of K=4 levels once; probes and operator-output
@@ -165,7 +202,7 @@ def per_tick_model():
             "pred_v5e_tick_ms": total / (V5E_HBM_GBS * 1e9) * 1e3,
             "pred_v5e_events_per_s":
                 ev_tick / (total / (V5E_HBM_GBS * 1e9)),
-            "pred_cpu_tick_ms": total / (CPU_EFF_GBS * 1e9) * 1e3,
+            "pred_cpu_tick_ms": total / (cpu_gbs * 1e9) * 1e3,
         }
     return out
 
@@ -185,8 +222,12 @@ def _bench_measurement(path: str | None = None):
     import json
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # "_off" files are A/B control runs (native kernels forced off) —
+    # never a default calibration target
     cands = ([path] if path else
-             sorted(glob.glob(os.path.join(root, "BENCH_local*.json")),
+             sorted((p for p in
+                     glob.glob(os.path.join(root, "BENCH_local*.json"))
+                     if "_off" not in os.path.basename(p)),
                     reverse=True) +
              sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
                     reverse=True))
@@ -223,12 +264,19 @@ def main():
     ap.add_argument("--print", action="store_true", dest="stdout")
     ap.add_argument("--bench", default=None,
                     help="bench JSON to calibrate against (default: newest "
-                         "BENCH_r*.json in the repo root)")
+                         "BENCH_local*/BENCH_r*.json in the repo root)")
+    ap.add_argument("--bench-off", default=None, dest="bench_off",
+                    help="same-host CONTROL run — the previous commit (a "
+                         "HEAD worktree) or a DBSP_TPU_NATIVE force-off "
+                         "run — enables the host-independent A/B refit "
+                         "of the reference gap")
     args = ap.parse_args()
 
     rows = kernel_table()
-    model = per_tick_model()
+    host_gbs = _host_bandwidth_gbs()
+    model = per_tick_model(host_gbs)
     meas = _bench_measurement(args.bench)
+    meas_off = _bench_measurement(args.bench_off) if args.bench_off else None
 
     lines = []
     w = lines.append
@@ -263,7 +311,8 @@ def main():
       "over its lifetime; probes/consolidations are delta-proportional. "
       "Per-tick HBM traffic and the bandwidth-bound tick time:\n")
     w("| protocol | events/tick | bytes/tick | v5e tick (pred) | "
-      "v5e events/s (pred) | CPU tick (pred, 8 GB/s) |")
+      f"v5e events/s (pred) | CPU tick (pred, {host_gbs:.1f} GB/s "
+      "measured on this host) |")
     w("|---|---|---|---|---|---|")
     for proto, m in model.items():
         w(f"| {proto} | {m['events_per_tick']:,} | "
@@ -273,7 +322,16 @@ def main():
           f"{m['pred_cpu_tick_ms']:.1f} ms |")
     w("")
     meas_cpu_ms = meas["kernel_ms"]
-    gap = meas_cpu_ms / model["cpu"]["pred_cpu_tick_ms"]
+    host_gap = meas_cpu_ms / model["cpu"]["pred_cpu_tick_ms"]
+    # host-independent refit: scale the fixed PR-4 reference calibration
+    # by the same-host A/B ratio (kernel-side ms with the native kernel
+    # set ON vs forced OFF). Raw cross-host ms comparisons are
+    # meaningless — container core speed varies ~3x round to round.
+    ab_ratio = None
+    gap = host_gap
+    if meas_off is not None and meas_off["kernel_ms"] > 0:
+        ab_ratio = meas_cpu_ms / meas_off["kernel_ms"]
+        gap = REF_GAP * ab_ratio
     adj = model["tpu"]["pred_v5e_events_per_s"] / gap
     host_note = ""
     if meas["host_share"] is not None:
@@ -284,15 +342,31 @@ def main():
                      "{:.1f} ms/tick).".format(100 * meas["host_share"],
                                                meas["p50_ms"]))
     w("Calibration: measured q4 kernel-side time is ~{:.1f} ms/tick at "
-      "the CPU protocol ({}) vs the bandwidth model's {:.1f} ms — a "
-      "{:.1f}x gap from non-streaming access (scatters, probe "
-      "irregularity) and per-op overheads that a roofline ignores.{} "
-      "Applying the SAME gap to the v5e projection as a conservative "
+      "the CPU protocol ({}) vs the bandwidth model's {:.2f} ms at this "
+      "host's measured {:.1f} GB/s — a {:.1f}x gap on this host from "
+      "non-streaming access (scatters, probe irregularity) and per-op "
+      "overheads that a roofline ignores.{}\n".format(
+          meas_cpu_ms, meas["source"], model["cpu"]["pred_cpu_tick_ms"],
+          host_gbs, host_gap, host_note))
+    if ab_ratio is not None:
+        w("**Kernel-side gap refit (same-host A/B):** the control run "
+          "({} — the pre-change code on the SAME host) measures {:.1f} "
+          "ms/tick kernel-side; the extended native/Pallas kernel set "
+          "cuts that to {:.1f} ms/tick — a x{:.2f} kernel-side factor "
+          "under identical protocol, state and container. Scaling the "
+          "PR-4 reference calibration ({:.1f} ms vs {:.2f} ms = {:.1f}x) "
+          "by that factor re-fits the kernel-side gap to **{:.1f}x**. "
+          "(Raw cross-host ms are NOT comparable: this round's container "
+          "has ~2-3x slower cores at similar memory bandwidth than the "
+          "PR-4 recording host, which is exactly why the refit is "
+          "A/B-based.)\n".format(
+              meas_off["source"], meas_off["kernel_ms"], meas_cpu_ms,
+              ab_ratio, REF_KERNEL_MS, REF_PRED_MS, REF_GAP, gap))
+    w("Applying the {:.1f}x gap to the v5e projection as a conservative "
       "discount gives **~{:.0f}M events/s on one v5e chip** — "
       "{:.0f}x the reference protocol's 10M/s offered rate, before "
       "multi-chip scaling over the existing SPMD shard path.\n".format(
-          meas_cpu_ms, meas["source"], model["cpu"]["pred_cpu_tick_ms"],
-          gap, host_note, adj / 1e6, adj / 10e6))
+          gap, adj / 1e6, adj / 10e6))
     w("## 3. What this predicts for the north star\n")
     w("At the TPU protocol (100k-event ticks) the projected v5e tick is "
       "single-digit milliseconds — {:.0f}M events/s on ONE chip against "
@@ -317,10 +391,20 @@ def main():
       "a specific run. The remaining gap is what a bandwidth model can "
       "speak to: scatter irregularity and probe lowering, now attacked "
       "by the fused trace cursors (zset/cursor.py: one ladder-wide probe "
-      "+ one cross-level expansion per consumer) and the sorted-run "
+      "+ one cross-level expansion per consumer), the sorted-run "
       "consolidation regimes (zset/batch.py: skip / rank-merge fold / "
       "native argsort / sort, counted in "
-      "`dbsp_tpu_zset_consolidate_total{path}`).\n")
+      "`dbsp_tpu_zset_consolidate_total{path}`), and the full native "
+      "CPU kernel set (merge/consolidate/probe/probe-ladder/expand/"
+      "gather/compact/rank-fold — anchored breadth-first C++ searches, "
+      "galloping block-copy merges; dispatch visible in "
+      "`dbsp_tpu_zset_kernel_dispatch_total{kernel,backend}` and bench "
+      "JSON `kernel_paths`, per-kernel A/B via DBSP_TPU_NATIVE). On "
+      "accelerator backends the ladder probe and rank-merge inner loops "
+      "select hand-written Pallas programs (zset/pallas_kernels.py, "
+      "DBSP_TPU_PALLAS) instead of trusting XLA's while-loop fusion "
+      "guesses — interpret-mode bit-identity is tier-1-gated; the first "
+      "live tunnel run measures them compiled.\n")
     w("## 4. Staged TPU artifact\n")
     w("`tools/aot_tpu.py` AOT-compiles the full compiled q4 step for the "
       "TPU backend and serializes it (jax.export) the moment "
