@@ -58,18 +58,35 @@ the first measured query's), with every query's numbers under
 detail["queries"]. A query that exceeds the remaining time budget is
 skipped and marked.
 
+Multi-worker: BENCH_WORKERS=N runs each compiled measurement as an
+N-worker SPMD circuit (virtual CPU devices or real chips); the bench JSON
+gains ``workers`` plus an ``exchange`` block (per-exchange worst-worker
+occupancy vs bucket capacity, process-wide overflow counts).
+``--workers-sweep 1,2,4,8`` is the MULTICHIP protocol: one child process
+per worker count over a mesh sized for the largest W, aggregated into one
+JSON object with per-query speedup/efficiency (``--sweep-out PATH``
+writes it to a file — MULTICHIP_r*.json).
+
+Growth proof: BENCH_GROWTH=1 records a throughput-vs-accumulated-trace-
+size sample per validated interval plus a ``growth_summary`` decay figure
+(early/late interval throughput); BENCH_SCAN=1 forces scanned-chunk
+dispatch on CPU (one dispatch per validation interval — the 10M-event
+growth run uses both with a coarse BENCH_VALIDATE_EVERY).
+
 Env knobs: BENCH_EVENTS (per query; default 750_000 on CPU — >=100 ticks
 at the CPU batch — 2_000_000 on TPU), BENCH_BATCH (events/tick, default
 7_500 on CPU / 100_000 on TPU), BENCH_QUERIES, BENCH_QUERY (headline
 override), BENCH_WARM_TICKS (default 4), BENCH_PLATFORM (cpu|tpu|probe,
 default probe), BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE
-(compiled|host), BENCH_VALIDATE_EVERY (default 8), BENCH_SLO / --slo (SLO
-gate; thresholds from DBSP_TPU_SLO_P99_TICK_MS / _TICK_P50_MULTIPLE /
-_WATERMARK_LAG / _OVERFLOW_REPLAYS).
+(compiled|host), BENCH_VALIDATE_EVERY (default 8), BENCH_WORKERS,
+BENCH_SCAN, BENCH_GROWTH, BENCH_SLO / --slo (SLO gate; thresholds from
+DBSP_TPU_SLO_P99_TICK_MS / _TICK_P50_MULTIPLE / _WATERMARK_LAG /
+_OVERFLOW_REPLAYS).
 """
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -328,6 +345,57 @@ def _knobs(platform: str):
             int(os.environ.get("BENCH_WARM_TICKS", 4)))
 
 
+def _bench_workers() -> int:
+    """BENCH_WORKERS=N runs each compiled measurement as an N-worker SPMD
+    circuit over the visible device mesh (virtual CPU devices via
+    XLA_FLAGS=--xla_force_host_platform_device_count, or real chips). The
+    --workers-sweep supervisor sets this per child."""
+    return max(1, int(os.environ.get("BENCH_WORKERS", "1")))
+
+
+def _exchange_detail(ch, workers: int, before: dict) -> dict:
+    """Exchange efficiency observables for the bench JSON: per-exchange
+    worst-worker occupancy vs static bucket (skew), overflow counts and
+    exchange-attributed replays — both WINDOWED to the measured run via
+    the ``before`` snapshot (warmup capacity discovery overflows by
+    design; attributing those to the measured window would misread benign
+    growth as skew)."""
+    from dbsp_tpu.compiled import cnodes
+    from dbsp_tpu.parallel.exchange import EXCHANGE_OVERFLOW_COUNTS
+
+    nodes = {}
+    for cn in ch.cnodes:
+        if isinstance(cn, cnodes.CExchange):
+            cap = cn.caps.get("exchange", 0)
+            nodes[str(cn.node.index)] = {
+                "required": int(cn.last_required),
+                "cap": cap,
+                "occupancy": round(cn.last_required / cap, 4) if cap
+                else None,
+            }
+    counts = before.get("counts", {})
+    counts0 = before.get("counts0", {})
+    return {"workers": workers, "nodes": nodes,
+            "overflows": {k: int(v - counts.get(k, 0))
+                          for k, v in EXCHANGE_OVERFLOW_COUNTS.items()
+                          if v - counts.get(k, 0)},
+            # THIS query's warmup window only (counts0 is snapshotted at
+            # query start): the process-global counter also carries earlier
+            # queries' overflows in a multi-query run
+            "warmup_overflows": {k: int(v - counts0.get(k, 0))
+                                 for k, v in counts.items()
+                                 if v - counts0.get(k, 0)},
+            "exchange_replays": ch.exchange_overflows
+            - before.get("replays", 0)}
+
+
+def _exchange_snapshot(ch) -> dict:
+    from dbsp_tpu.parallel.exchange import EXCHANGE_OVERFLOW_COUNTS
+
+    return {"counts": dict(EXCHANGE_OVERFLOW_COUNTS),
+            "replays": ch.exchange_overflows}
+
+
 def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     """Measure one query in compiled mode (one XLA program per tick,
     device-side generation, periodic validation — see module doc).
@@ -352,16 +420,21 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         "BENCH_VALIDATE_EVERY",
         2 if platform == "cpu" and big_state else 8))
     query = getattr(queries, qname)
+    workers = _bench_workers()
     # device generation needs whole 50-event epochs; warmup needs >= 1 tick
     # for capacity discovery + presize
     batch = max(batch // 50, 1) * 50
     warm_ticks = max(warm_ticks, 1)
     ept = batch // 50  # epochs (50-event groups) per tick
     # per-tick blocking gives a true latency distribution; over the tunnel
-    # (~1.5s RPC per dispatch) the scanned-chunk mode is the only viable one
-    scan = platform != "cpu"
+    # (~1.5s RPC per dispatch) the scanned-chunk mode is the only viable
+    # one. BENCH_SCAN=1 forces scanned chunks on CPU too (the growth run
+    # uses it: one dispatch per coarse validation interval).
+    scan = platform != "cpu" or os.environ.get("BENCH_SCAN") == "1"
+    growth = os.environ.get("BENCH_GROWTH") == "1"
 
-    detail.update(query=qname, batch_per_tick=batch, events=0)
+    detail.update(query=qname, batch_per_tick=batch, events=0,
+                  workers=workers)
     # cold-vs-warm warmup attribution: warmup_s is dominated by
     # trace+compile on a cold cache and by deserialization on a warm one
     cache_state = _compile_cache_state()
@@ -377,7 +450,7 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         streams, handles = build_inputs(c)
         return handles, query(*streams).output()
 
-    handle, (handles, out) = Runtime.init_circuit(1, build)
+    handle, (handles, out) = Runtime.init_circuit(workers, build)
     hp, ha, hb = handles
 
     def gen_fn(tick):
@@ -398,6 +471,9 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     detail["trace_levels"] = cnodes.TRACE_LEVELS
 
     ch = compile_circuit(handle, gen_fn=gen_fn)
+    from dbsp_tpu.parallel.exchange import EXCHANGE_OVERFLOW_COUNTS
+
+    exchange_query_start = dict(EXCHANGE_OVERFLOW_COUNTS)
     # Warmup protocol tuned for tunnel-scale compile costs (~3 min per
     # program): validate every tick, and on the FIRST overflow jump monotone
     # capacities straight to their projected end-of-run size
@@ -430,12 +506,35 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     # across the chunk; the first chunk's compile counts toward elapsed
     # (reported separately as scan_compile_s).
     ch.reset_timing()
+    exchange_before = _exchange_snapshot(ch)
+    exchange_before["counts0"] = exchange_query_start
     t0 = _time.perf_counter()
     m0 = warm_ticks + 1
+    growth_log: list = []
+    growth_prev = {"events": 0, "t": 0.0}
 
     def progress(next_tick):
-        detail.update(events=(next_tick - m0) * batch,
-                      elapsed_s=round(_time.perf_counter() - t0, 3))
+        ev = (next_tick - m0) * batch
+        el = _time.perf_counter() - t0
+        detail.update(events=ev, elapsed_s=round(el, 3))
+        if growth:
+            # throughput-vs-accumulated-trace-size curve: one sample per
+            # validated interval (BENCH_GROWTH=1; the 10M-event growth
+            # proof reads decay off this log)
+            from dbsp_tpu.compiled import cnodes as _cnodes
+
+            rows = sum(cn.caps[k] for cn in ch.cnodes
+                       if isinstance(cn, _cnodes._Leveled)
+                       for k in cn.level_keys)
+            seg_ev = ev - growth_prev["events"]
+            seg_s = el - growth_prev["t"]
+            if seg_ev > 0 and seg_s > 0:
+                growth_log.append({
+                    "events": ev,
+                    "elapsed_s": round(el, 3),
+                    "trace_cap_rows": int(rows),
+                    "interval_events_per_s": round(seg_ev / seg_s, 1)})
+            growth_prev.update(events=ev, t=el)
         _debug(f"[{qname}] measured through tick {next_tick - 1} "
                f"({detail['elapsed_s']}s, {detail['events']} events)")
 
@@ -565,6 +664,26 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
                                                             0))
         for (kern, backend), v in sorted(_zk.KERNEL_DISPATCH_COUNTS.items())
         if v - kernel_paths_before.get((kern, backend), 0)}
+    if workers > 1:
+        detail["exchange"] = _exchange_detail(ch, workers, exchange_before)
+    if growth and growth_log:
+        # decay = median of the first-quarter interval throughputs /
+        # median of the last quarter — the quantity the growth acceptance
+        # bound (<= 2x) gates; per-interval causes are flight-recorded
+        # above
+        q = max(1, len(growth_log) // 4)
+        early = sorted(g["interval_events_per_s"]
+                       for g in growth_log[:q])[q // 2]
+        late_w = growth_log[-q:]
+        late = sorted(g["interval_events_per_s"] for g in late_w)[
+            len(late_w) // 2]
+        detail["growth"] = growth_log
+        detail["growth_summary"] = {
+            "intervals": len(growth_log),
+            "early_events_per_s": early,
+            "late_events_per_s": late,
+            "decay": round(early / late, 3) if late else None,
+            "final_trace_cap_rows": growth_log[-1]["trace_cap_rows"]}
     detail.update(elapsed_s=round(elapsed, 3), events=measured, ticks=ticks,
                   replayed_intervals=max(0, len(samples) - expected))
     return eps
@@ -714,9 +833,126 @@ def _child_platform() -> tuple[str, dict]:
     return platform, info
 
 
+def last_json_object(text: str):
+    """Last parseable ``{``-prefixed stdout line, or None — the child
+    protocol shared by the sweep supervisor and tools/lint_all.py's
+    multichip front (one copy: a protocol change lands in both)."""
+    parsed = None
+    for line in text.splitlines():
+        if line.lstrip().startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                pass
+    return parsed
+
+
+def _workers_sweep(workers_list, out_path=None) -> int:
+    """``--workers-sweep 1,2,4,8``: run the compiled measurement once per
+    worker count (each in a fresh child process over a virtual CPU device
+    mesh sized for the largest W) and emit ONE JSON object with per-query
+    scaling efficiency plus the exchange skew/overflow observables — the
+    MULTICHIP_r* protocol. ``--sweep-out PATH`` also writes it to a file.
+
+    Children run the normal bench protocol (BENCH_QUERIES/BENCH_EVENTS/
+    BENCH_BATCH knobs apply), so per-W numbers are directly comparable to
+    the single-worker BENCH_r* lines."""
+    maxw = max(workers_list)
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 1080))
+    started = time.time()
+    runs: dict = {}
+    for w in workers_list:
+        flags = os.environ.get("XLA_FLAGS", "")
+        # force the mesh to max(W) even when the env already carries the
+        # flag: an inherited smaller value would cap the device count below
+        # the largest swept W and kill those children at make_mesh
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        flags = (flags.strip() +
+                 f" --xla_force_host_platform_device_count={maxw}").strip()
+        env = dict(os.environ, BENCH_CHILD="1", BENCH_PLATFORM="cpu",
+                   BENCH_WORKERS=str(w), JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=flags)
+        child_budget = max(120.0, (budget - (time.time() - started)) /
+                           max(1, len(workers_list) - len(runs)))
+        env["BENCH_TIME_BUDGET_S"] = str(child_budget)
+        # hard backstop past the child's own SIGALRM budget (which can't
+        # fire inside a wedged C call): the REMAINING budget plus compile
+        # slack, not the sweep's full initial budget — a second wedged
+        # child must not wait out another full-budget window
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=child_budget + 120)
+        except subprocess.TimeoutExpired as e:
+            # a wedged child (stuck XLA compile) must not discard the
+            # already-completed per-W runs — record and move on
+            runs[str(w)] = {"error": "child timed out after "
+                            f"{child_budget + 120:.0f}s",
+                            "stdout": (e.stdout or "")[-300:] if
+                            isinstance(e.stdout, str) else None}
+            continue
+        parsed = last_json_object(p.stdout)
+        runs[str(w)] = (parsed if parsed is not None
+                        else {"error": "no JSON line",
+                              "stderr": p.stderr[-500:]})
+    # per-query scaling efficiency vs the smallest swept worker count
+    base_w = str(min(workers_list))
+    base_q = ((runs.get(base_w) or {}).get("detail", {}) or {}).get(
+        "queries", {})
+    scaling: dict = {}
+    for w in workers_list:
+        d = (runs.get(str(w)) or {}).get("detail", {}) or {}
+        for qn, qd in (d.get("queries") or {}).items():
+            eps = qd.get("events_per_s")
+            base = (base_q.get(qn) or {}).get("events_per_s")
+            if eps and base:
+                scaling.setdefault(qn, {})[str(w)] = {
+                    "events_per_s": eps,
+                    "speedup": round(eps / base, 3),
+                    "efficiency": round(eps / base / (w / min(workers_list)),
+                                        3)}
+    obj = {
+        "protocol": "workers-sweep",
+        "workers": workers_list,
+        "host_cores": os.cpu_count(),
+        "queries": os.environ.get("BENCH_QUERIES", "q3,q4,q8"),
+        "events_per_query": os.environ.get("BENCH_EVENTS", "default"),
+        "scaling": scaling,
+        "runs": runs,
+    }
+    line = json.dumps(obj)
+    print(line)
+    sys.stdout.flush()
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(json.dumps(obj, indent=1) + "\n")
+    return 0
+
+
+def _flag_operand(flag: str) -> str:
+    """The operand after ``flag`` in argv, with a usage error (not an
+    IndexError, and not a silently-swallowed next flag) when missing."""
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+        print(f"bench.py: {flag} needs a value "
+              f"(e.g. {flag} {'1,2,4,8' if 'workers' in flag else 'F.json'})",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return sys.argv[i + 1]
+
+
 def main() -> int:
     if "--slo" in sys.argv:  # env form so child processes inherit it
         os.environ["BENCH_SLO"] = "1"
+    if "--workers-sweep" in sys.argv:
+        ws = sorted({int(x)
+                     for x in _flag_operand("--workers-sweep").split(",")
+                     if x})
+        out_path = None
+        if "--sweep-out" in sys.argv:
+            out_path = _flag_operand("--sweep-out")
+        return _workers_sweep(ws, out_path)
     inline_cpu = (os.environ.get("BENCH_PLATFORM") == "cpu" or
                   "xla_force_host_platform_device_count"
                   in os.environ.get("XLA_FLAGS", ""))

@@ -54,14 +54,19 @@ def default_pass_manager() -> PassManager:
                         incremental_pass])
 
 
-def analyze(circuit, workers: Optional[int] = None) -> List[Finding]:
+def analyze(circuit, workers: Optional[int] = None,
+            strict_shard: bool = False) -> List[Finding]:
     """Run all passes over a built circuit; returns findings sorted by
-    severity. Pure — no logging, no metrics, no raising."""
+    severity. Pure — no logging, no metrics, no raising.
+
+    ``strict_shard=True`` escalates P003 (mid-circuit unshard) to ERROR —
+    the CI form of the zero-unshard invariant."""
     if workers is None:
         from dbsp_tpu.circuit.runtime import Runtime
 
         workers = Runtime.worker_count()
-    return default_pass_manager().run(circuit, workers=workers)
+    return default_pass_manager().run(circuit, workers=workers,
+                                      strict_shard=strict_shard)
 
 
 def verify_circuit(circuit, workers: Optional[int] = None, registry=None,
@@ -79,12 +84,19 @@ def verify_circuit(circuit, workers: Optional[int] = None, registry=None,
     # CircuitServer around the controller — and each would otherwise walk
     # the graph and log every WARN again. Counting still happens per call
     # so whichever gate carries the pipeline's registry gets the metrics.
+    import os
+
+    # DBSP_TPU_STRICT_SHARD=1: deploy-time form of --strict-shard. The
+    # flag is part of the memo key — a cached non-strict analysis must
+    # not be served after the env changes (a stale WARN-level result
+    # would let a deploy proceed that strict mode should refuse).
+    strict = os.environ.get("DBSP_TPU_STRICT_SHARD") == "1"
     cached = getattr(circuit, "_verify_cache", None)
-    if cached is not None and cached[0] == workers:
+    if cached is not None and cached[0] == (workers, strict):
         findings = cached[1]
     else:
-        findings = analyze(circuit, workers=workers)
-        circuit._verify_cache = (workers, findings)
+        findings = analyze(circuit, workers=workers, strict_shard=strict)
+        circuit._verify_cache = ((workers, strict), findings)
         for f in findings:
             if f.severity == WARN:
                 logger.warning("%s", f.render())

@@ -104,6 +104,19 @@ def _defect_circuits():
     c.nodes[0].child._index_in_parent = 7  # re-parented by hand
     gallery.append(("W004 nested-clock inconsistency", c))
 
+    # P003 — mid-circuit unshard immediately re-sharded (the zero-unshard
+    # invariant; WARN by default, ERROR under --strict-shard). Hand-built:
+    # the sugar elides both ops on a 1-worker build, so the gallery wires
+    # the workers>1 node shapes directly.
+    from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+
+    c = RootCircuit()
+    s, _h = add_input_zset(c, [jnp.int64], [jnp.int64])
+    u = c.add_unary_operator(UnshardOp(), s)
+    u.schema = s.schema
+    c.add_unary_operator(ExchangeOp(4), u).output()
+    gallery.append(("P003 mid-circuit unshard (analyzed at workers=4)", c))
+
     return gallery
 
 
@@ -115,12 +128,15 @@ def main(argv=None) -> int:
     ap.add_argument("target", help="q0..q22 | all | defects")
     ap.add_argument("--workers", type=int, default=1,
                     help="worker count to analyze for (default 1)")
+    ap.add_argument("--strict-shard", action="store_true",
+                    help="escalate P003 (mid-circuit unshard) to ERROR — "
+                    "the machine-enforced zero-unshard invariant")
     args = ap.parse_args(argv)
 
     from dbsp_tpu.analysis import ERROR, analyze, format_findings
 
     if args.target == "defects":
-        targets = [(label, c, 4 if label.startswith("P001") else
+        targets = [(label, c, 4 if label.startswith(("P001", "P003")) else
                     args.workers) for label, c in _defect_circuits()]
     elif args.target == "all":
         targets = [(n, _build_query(n), args.workers)
@@ -133,7 +149,8 @@ def main(argv=None) -> int:
 
     any_error = False
     for label, circuit, workers in targets:
-        findings = analyze(circuit, workers=workers)
+        findings = analyze(circuit, workers=workers,
+                           strict_shard=args.strict_shard)
         any_error |= any(f.severity == ERROR for f in findings)
         print(f"== {label} ==")
         print(format_findings(findings))

@@ -72,9 +72,15 @@ class Finding:
 
 
 def make_finding(rule_id: str, circuit: Circuit, node: Optional[Node],
-                 message: str, fix_hint: Optional[str] = None) -> Finding:
+                 message: str, fix_hint: Optional[str] = None,
+                 severity: Optional[str] = None) -> Finding:
+    """``severity`` overrides the rule's registered default — used by
+    mode-escalated rules (P003 is WARN normally, ERROR under
+    ``strict_shard``)."""
     rule = RULES[rule_id]
-    return Finding(rule_id=rule_id, severity=rule.severity,
+    return Finding(rule_id=rule_id,
+                   severity=severity if severity is not None
+                   else rule.severity,
                    node_path=node_path(circuit, node), message=message,
                    fix_hint=fix_hint if fix_hint is not None
                    else rule.fix_hint)
@@ -108,9 +114,13 @@ class AnalysisContext:
     circuit.
     """
 
-    def __init__(self, circuit: Circuit, workers: int = 1):
+    def __init__(self, circuit: Circuit, workers: int = 1,
+                 strict_shard: bool = False):
         self.root = circuit
         self.workers = workers
+        # --strict-shard: escalate the zero-unshard invariant (P003) from
+        # WARN to ERROR — CI mode for circuits that must scale out
+        self.strict_shard = strict_shard
         self.schemas: Dict[Tuple[int, int], Optional[tuple]] = {}
         self._consumers: Dict[int, List[List[int]]] = {}
         for c, n in self.walk():
@@ -178,8 +188,10 @@ class PassManager:
         self.passes.append(p)
         return self
 
-    def run(self, circuit: Circuit, workers: int = 1) -> List[Finding]:
-        ctx = AnalysisContext(circuit, workers=workers)
+    def run(self, circuit: Circuit, workers: int = 1,
+            strict_shard: bool = False) -> List[Finding]:
+        ctx = AnalysisContext(circuit, workers=workers,
+                              strict_shard=strict_shard)
         # graph-level waivers (Stream.waive_lint): filtered centrally so
         # every rule honors them without each pass re-checking
         waived = {node_path(c, n): n.lint_waive

@@ -15,9 +15,11 @@ Placement facts used here are build-time graph metadata, not runtime data:
 placement decision whose exchange/collapse was elided on a 1-worker mesh
 — the same build at workers > 1 would have placed the stream, so what-if
 analysis must not flag it), and "host-resident by construction" (the
-output of an ``UnshardOp``). Only the root circuit is
-checked — nested/recursive children are host-driven and unsharded by
-construction (recursive() collapses its inputs first).
+output of an ``UnshardOp``). Only the root circuit is checked —
+nested/recursive children are shard-lifted by their OWN sugar
+(join/distinct/aggregate re-shard inside the child, recursive() shards
+its imports), so their placement is correct by construction rather than
+analyzable from root-level metadata.
 """
 
 from __future__ import annotations
@@ -41,6 +43,19 @@ register_rule(
     "same key: every row pays an all_to_all that cannot move it.",
     "drop the extra .shard(); the circuit cache shares one exchange per "
     "stream when built through the sugar")
+register_rule(
+    "P003", "warn", "mid-circuit-unshard",
+    "an unshard() on a multi-worker mesh whose result is re-sharded or "
+    "consumed by a shard-lifted operator (trace feeding join/aggregate/"
+    "distinct/rolling, or a linear aggregate): the circuit collapses to "
+    "one worker mid-graph — every downstream row pays an all-gather plus "
+    "a re-distribution, and the W-way multiplier is lost for that "
+    "subgraph. WARN by default; ERROR under --strict-shard (the "
+    "machine-enforced zero-unshard invariant).",
+    "drop the .unshard() — join/aggregate/distinct, recursive children "
+    "and rolling (radix) aggregates are all shard-lifted; keep unshard "
+    "only for genuinely host-resident consumers (topk/window order "
+    "statistics) or waive with Stream.waive_lint('P003')")
 
 
 def _placed(circuit, idx: int) -> bool:
@@ -55,15 +70,32 @@ def _placed(circuit, idx: int) -> bool:
             or isinstance(node.operator, UnshardOp))
 
 
+def _p003_shardable_trace(circuit, trace_idx: int, consumers) -> bool:
+    """True when the TraceOp at ``trace_idx`` feeds at least one
+    shard-lifted consumer — i.e. a trace(shard=False) that exists only
+    because its consumer USED to be host-bound. Order statistics (topk)
+    and range partitioning (window / range join) are genuinely
+    host-or-per-level shapes and stay legitimate."""
+    from dbsp_tpu.operators.aggregate import AggregateOp
+    from dbsp_tpu.operators.distinct import DistinctOp
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.timeseries.rolling import RollingAggregateOp
+
+    lifted = (JoinOp, AggregateOp, DistinctOp, RollingAggregateOp)
+    return any(isinstance(circuit.nodes[c].operator, lifted)
+               for c in consumers[trace_idx])
+
+
 def sharding_pass(ctx: AnalysisContext) -> List[Finding]:
     from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
     from dbsp_tpu.operators.join import JoinOp
-    from dbsp_tpu.operators.shard_op import ExchangeOp
+    from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
     from dbsp_tpu.operators.trace_op import TraceOp
 
     out: List[Finding] = []
     circuit = ctx.root
     nn = len(circuit.nodes)
+    consumers = ctx.consumers(circuit)
     for n in circuit.nodes:
         op = n.operator
         # stale input indices are a W004 finding (wellformed pass); this
@@ -78,6 +110,49 @@ def sharding_pass(ctx: AnalysisContext) -> List[Finding]:
                 "exchange input is already key-sharded"))
         if ctx.workers <= 1:
             continue
+        # P003 — the zero-unshard invariant: a mid-circuit collapse whose
+        # result goes right back onto the mesh (re-exchange / linear
+        # aggregate) or feeds a trace consumed by a shard-lifted operator.
+        # Only ACTUAL UnshardOp nodes are judged (a workers>1 build);
+        # host_intent markers from 1-worker builds stay exempt — a node
+        # may legitimately carry both placement intents (dual consumption).
+        if isinstance(op, UnshardOp):
+            from dbsp_tpu.circuit.nested import SubcircuitOp
+            # transitive: placement-preserving transforms between the
+            # collapse and the re-distribution (unshard -> map -> shard)
+            # carry the defect through — walk the consumer closure across
+            # them instead of judging direct consumers only (the
+            # pass-through predicate is SHARED with _schema_zero's
+            # backward walk so the two checks cannot drift)
+            from dbsp_tpu.operators.z1 import _placement_thru
+
+            seen = {n.index}
+            frontier = list(consumers[n.index])
+            fired = False
+            while frontier and not fired:
+                c = frontier.pop()
+                if c in seen:
+                    continue
+                seen.add(c)
+                cop = circuit.nodes[c].operator
+                # SubcircuitOp: recursive/nested children are shard-lifted
+                # by construction — importing a collapsed stream is the
+                # exact pre-lift regression shape
+                fire = isinstance(cop, (ExchangeOp, LinearAggregateOp,
+                                        SubcircuitOp)) or \
+                    (isinstance(cop, TraceOp) and
+                     _p003_shardable_trace(circuit, c, consumers))
+                if fire:
+                    out.append(make_finding(
+                        "P003", circuit, n,
+                        f"unshard() output feeds {cop.name!r} "
+                        f"({ctx.workers} workers): the circuit collapses "
+                        "to one worker mid-graph and immediately "
+                        "re-distributes",
+                        severity="error" if ctx.strict_shard else None))
+                    fired = True
+                elif _placement_thru(cop):
+                    frontier.extend(consumers[c])
         if isinstance(op, (TraceOp, LinearAggregateOp)):
             if n.inputs and not _placed(circuit, n.inputs[0]):
                 src = circuit.nodes[n.inputs[0]]

@@ -134,6 +134,9 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "last_outputs": "derived",
         "step_times_ns": "derived",
         "overflow_replays": "derived",
+        # exchange-bucket overflow subset of the replays (skew hazard
+        # observability; mirrored process-wide in parallel/exchange.py)
+        "exchange_overflows": "derived",
         "host_overhead_ns": "derived",
         "tick_causes": "derived",
         "_pending_causes": "derived",

@@ -22,7 +22,6 @@ from typing import Any, Callable, List, Optional, Tuple
 from dbsp_tpu.circuit.builder import (Circuit, CircuitError, CircuitEvent,
                                       Stream)
 from dbsp_tpu.circuit.operator import ImportOperator, Operator
-from dbsp_tpu.zset.batch import Batch
 
 
 class Delta0(ImportOperator):
@@ -92,17 +91,41 @@ class ChildCircuit(Circuit):
         if parent_stream.circuit is not self.parent:
             raise CircuitError(
                 "import_stream takes a stream of the immediate parent")
+        op = Delta0(zero_factory, hold=hold)
         if zero_factory is None:
             schema = getattr(parent_stream, "schema", None)
             if schema is None:
                 raise CircuitError(
                     "import_stream needs schema metadata or zero_factory")
-            zero_factory = lambda: Batch.empty(*schema)  # noqa: E731
-        op = Delta0(zero_factory, hold=hold)
+            # placement-following zero: the zeros emitted on later child
+            # ticks must carry the SAME placement as the imported parent
+            # value (a mixed sharded/unsharded merge downstream is a build
+            # error), so the default zero copies the lead axis off the
+            # value itself — an unsharded host-resident import on a
+            # multi-worker mesh (P003-waived shapes) stays unsharded
+            key_dtypes, val_dtypes = schema
+
+            def zero_factory():
+                from dbsp_tpu.zset.batch import Batch
+
+                v = op.value
+                if v is not None and hasattr(v, "weights"):
+                    lead = ((v.weights.shape[0],) if v.sharded else ())
+                else:
+                    from dbsp_tpu.circuit.runtime import Runtime
+
+                    w = Runtime.worker_count()
+                    lead = (w,) if w > 1 else ()
+                return Batch.empty(key_dtypes, val_dtypes, lead=lead)
+
+            op.zero_factory = zero_factory
         node = self._add_node(op, "import", [])
         self.imports.append((parent_stream.node_index, op))
         s = Stream(self, node.index)
         s.schema = getattr(parent_stream, "schema", None)
+        # placement survives the clock-domain crossing: the import emits the
+        # parent's batches (or same-placement zeros) unchanged
+        s.key_sharded = getattr(parent_stream, "key_sharded", False)
         return s
 
     def export(self, child_stream: Stream) -> int:

@@ -45,12 +45,19 @@ class Runtime:
 
     _tls = _CurrentRuntime()
 
-    def __init__(self, workers: int = 1, mesh=None):
+    def __init__(self, workers: int = 1, mesh=None,
+                 build_only: bool = False):
+        """``build_only=True`` skips device-mesh construction: the runtime
+        can BUILD a workers-N circuit graph (the sugar only reads
+        ``workers``) but not step it. Static analysis uses this to
+        materialize the real N-worker node shapes — exchanges, unshards —
+        on hosts with fewer than N devices (the P003 sweep in
+        tools/lint_all.py builds every query this way)."""
         from dbsp_tpu.parallel.mesh import make_mesh
 
         self.workers = workers
         self.mesh = mesh if mesh is not None else (
-            make_mesh(workers) if workers > 1 else None)
+            make_mesh(workers) if workers > 1 and not build_only else None)
 
     @staticmethod
     def current() -> Optional["Runtime"]:

@@ -1249,7 +1249,19 @@ class CExchange(CNode):
     a static per-worker capacity. The all_to_all's raw output capacity is
     W x cap_local (worst-case skew); the compiled path re-buckets to
     ``caps['exchange']`` with a requirement check instead of the host path's
-    per-eval scalar sync."""
+    per-eval scalar sync. Rows past the static bucket would fall off the
+    ``with_cap`` slice — the requirement check turns that into an overflow
+    REPLAY (grow + re-run the interval), counted on
+    ``dbsp_tpu_exchange_overflow_total`` under kind=exchange, never
+    silent data loss."""
+
+    # worst-worker live rows at the last validation — the observable the
+    # skew gauges export (occupancy ratio = last_required / cap)
+    last_required: int = 0
+
+    def note_requirement(self, key: str, required: int) -> None:
+        if key == "exchange":
+            self.last_required = required
 
     def eval(self, ctx, state, inputs):
         from dbsp_tpu.parallel.exchange import exchange_local

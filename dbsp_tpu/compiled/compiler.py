@@ -292,6 +292,10 @@ class CompiledHandle:
         # obs registry exports this as
         # dbsp_tpu_compiled_overflow_replays_total)
         self.overflow_replays = 0
+        # the subset caused by exchange/input bucket overflow (skew past a
+        # static per-worker capacity) — exported as
+        # dbsp_tpu_exchange_overflow_total and in bench detail
+        self.exchange_overflows = 0
         # -- tail attribution + incremental-snapshot bookkeeping ------------
         # host_overhead_ns: wall time of each between-tick host phase (obs
         # exports dbsp_tpu_compiled_tick_host_overhead_seconds{phase});
@@ -1037,7 +1041,23 @@ class CompiledHandle:
         State since the last validated snapshot is invalid — callers MUST
         follow with :meth:`restore` of a validated snapshot (which re-pads
         it to the new capacities)."""
+        exchange_hit = False
         for cn, key, required in overflow.items:
+            # exchange-bucket overflow: a skewed tick routed more rows to a
+            # worker than the static per-worker capacity — the replay that
+            # follows is the data-loss save; count it (obs + bench export).
+            # Per-KIND detection counts each overflowed site; the handle's
+            # exchange_overflows counts REPLAYS (once per grow, matching
+            # overflow_replays' unit even when one interval overflows
+            # several exchange buckets).
+            if isinstance(cn, cnodes.CExchange) or \
+                    (isinstance(cn, cnodes.CInput) and key == "input"):
+                from dbsp_tpu.parallel.exchange import count_exchange_overflow
+
+                count_exchange_overflow(
+                    "exchange" if isinstance(cn, cnodes.CExchange)
+                    else "input")
+                exchange_hit = True
             factor = max(headroom, project_ratio * 1.3) \
                 if key in cn.MONOTONE_CAPS else headroom
             # max: a capacity key can overflow at several sites in one
@@ -1045,6 +1065,8 @@ class CompiledHandle:
             # later, smaller item shrink the grown cap
             cn.caps[key] = max(cn.caps[key],
                                bucket_cap(int(required * factor)))
+        if exchange_hit:
+            self.exchange_overflows += 1
         self._enforce_ladders()
         self._step_jit = None
         self._scan_jits = {}
@@ -1209,6 +1231,14 @@ class CompiledHandle:
             except CompiledOverflow as e:
                 overhead["validate"].append(time.perf_counter_ns() - h0)
                 self.overflow_replays += 1
+                if any(isinstance(cn, cnodes.CExchange) or
+                       (isinstance(cn, cnodes.CInput) and k == "input")
+                       for cn, k, _ in e.items):
+                    # skew past a static per-worker bucket: the replay IS
+                    # the no-data-loss path; attribute it distinctly so
+                    # flight/incident evidence separates exchange growth
+                    # from ordinary trace-capacity growth
+                    self._note_cause("exchange_overflow")
                 self.grow(e, project_ratio=project_ratio)
                 self.restore(snap)
                 self._note_cause("retrace")

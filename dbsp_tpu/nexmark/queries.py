@@ -116,15 +116,17 @@ def q5(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     by_window = counts.index_by(
         lambda k, v: (k[0],), (jnp.int64,),
         val_fn=lambda k, v: (k[1], v[0]), val_dtypes=(jnp.int64, jnp.int64),
-        name="q5-by-window")
+        name="q5-by-window", preserves_first_key=True)
     maxes = by_window.aggregate(Max(1), name="q5-max")
     hot = by_window.join_index(
         maxes,
         lambda k, cv, mv: (k, (cv[0], cv[1], mv[0])),
-        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q5-join")
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q5-join",
+        preserves_first_key=True)
     winners = hot.filter_rows(lambda k, v: v[1] == v[2], name="q5-winners")
     return winners.map_rows(lambda k, v: ((k[0], v[0]), ()),
-                            (jnp.int64, jnp.int64), (), name="q5-project")
+                            (jnp.int64, jnp.int64), (), name="q5-project",
+                            preserves_first_key=True)
 
 
 Q7_WINDOW_MS = 10_000
@@ -170,7 +172,7 @@ def q8(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
         lambda k, v: (k[0], (v[M.P_DATE] // Q8_WINDOW_MS) * Q8_WINDOW_MS),
         (jnp.int64, jnp.int64),
         val_fn=lambda k, v: (v[M.P_NAME],), val_dtypes=(jnp.int32,),
-        name="q8-persons")
+        name="q8-persons", preserves_first_key=True)
     a_keyed = auctions.index_by(
         lambda k, v: (v[M.A_SELLER],
                       (v[M.A_DATE] // Q8_WINDOW_MS) * Q8_WINDOW_MS),
@@ -179,7 +181,8 @@ def q8(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
         name="q8-auctions")
     joined = p_keyed.join_index(
         a_keyed, lambda k, pv, av: (k, (pv[0],)),
-        (jnp.int64, jnp.int64), (jnp.int32,), name="q8-join")
+        (jnp.int64, jnp.int64), (jnp.int32,), name="q8-join",
+        preserves_first_key=True)
     return joined.distinct()
 
 
@@ -191,20 +194,21 @@ def q4(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     by_auction = auctions.index_by(
         lambda k, v: (k[0],), M.AUCTION_KEY,
         val_fn=lambda k, v: (v[M.A_CATEGORY], v[M.A_DATE], v[M.A_EXPIRES]),
-        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q4-auctions")
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q4-auctions",
+        preserves_first_key=True)
     joined = bids.join_index(
         by_auction,
         lambda k, bv, av: (
             (k[0], av[0]),
             (bv[M.B_PRICE], bv[M.B_DATE], av[1], av[2])),
         [jnp.int64, jnp.int64], [jnp.int64, jnp.int64, jnp.int64, jnp.int64],
-        name="q4-join")
+        name="q4-join", preserves_first_key=True)
     in_window = joined.filter_rows(
         lambda k, v: (v[1] >= v[2]) & (v[1] <= v[3]), name="q4-window")
     # max price per (auction, category)
     per_auction = in_window.map_rows(
         lambda k, v: (k, (v[0],)), (jnp.int64, jnp.int64), (jnp.int64,),
-        name="q4-price").aggregate(Max(0), name="q4-max")
+        name="q4-price", preserves_first_key=True).aggregate(Max(0), name="q4-max")
     # average of those maxima per category
     by_category = per_auction.index_by(
         lambda k, v: (k[1],), (jnp.int64,),
@@ -227,7 +231,8 @@ def _winning_bids(auctions: Stream, bids: Stream) -> Stream:
     by_auction = auctions.index_by(
         lambda k, v: (k[0],), M.AUCTION_KEY,
         val_fn=lambda k, v: (v[M.A_SELLER], v[M.A_DATE], v[M.A_EXPIRES]),
-        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q9-auctions")
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q9-auctions",
+        preserves_first_key=True)
     joined = bids.join_index(
         by_auction,
         lambda k, bv, av: (
@@ -236,7 +241,7 @@ def _winning_bids(auctions: Stream, bids: Stream) -> Stream:
              bv[M.B_DATE], av[1], av[2])),
         (jnp.int64,),
         (jnp.int64, jnp.int64, jnp.int64, jnp.int64, jnp.int64, jnp.int64,
-         jnp.int64), name="q9-join")
+         jnp.int64), name="q9-join", preserves_first_key=True)
     in_window = joined.filter_rows(
         lambda k, v: (v[4] >= v[5]) & (v[4] <= v[6]), name="q9-window")
     ranked = in_window.map_rows(
@@ -250,7 +255,8 @@ def q9(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     """Winning bid of each auction: (auction, price, ts, bidder)."""
     return _winning_bids(auctions, bids).map_rows(
         lambda k, v: (k, (v[0], -v[1], v[2])),
-        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q9-project")
+        (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64), name="q9-project",
+        preserves_first_key=True)
 
 
 def q6(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
@@ -452,21 +458,22 @@ def q17(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     (count, min, max, avg price)."""
     keyed = bids.map_rows(
         lambda k, v: ((k[0], v[M.B_DATE] // DAY_MS), (v[M.B_PRICE],)),
-        (jnp.int64, jnp.int64), (jnp.int64,), name="q17-key")
+        (jnp.int64, jnp.int64), (jnp.int64,), name="q17-key",
+        preserves_first_key=True)
     cnt = keyed.aggregate(Count(), name="q17-count")
     mn = keyed.aggregate(Min(0), name="q17-min")
     mx = keyed.aggregate(Max(0), name="q17-max")
     avg = keyed.aggregate(Average(0), name="q17-avg")
     j1 = cnt.join_index(mn, lambda k, a, b: (k, (a[0], b[0])),
                         (jnp.int64, jnp.int64), (jnp.int64, jnp.int64),
-                        name="q17-j1")
+                        name="q17-j1", preserves_first_key=True)
     j2 = j1.join_index(mx, lambda k, a, b: (k, (a[0], a[1], b[0])),
                        (jnp.int64, jnp.int64),
-                       (jnp.int64, jnp.int64, jnp.int64), name="q17-j2")
+                       (jnp.int64, jnp.int64, jnp.int64), name="q17-j2", preserves_first_key=True)
     return j2.join_index(avg, lambda k, a, b: (k, (a[0], a[1], a[2], b[0])),
                          (jnp.int64, jnp.int64),
                          (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
-                         name="q17-j3")
+                         name="q17-j3", preserves_first_key=True)
 
 
 def q18(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
@@ -484,7 +491,8 @@ def q19(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     ranked = bids.index_by(
         lambda k, v: (k[0],), M.BID_KEY,
         val_fn=lambda k, v: (v[M.B_PRICE], v[M.B_DATE], v[M.B_BIDDER]),
-        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q19-rank")
+        val_dtypes=(jnp.int64, jnp.int64, jnp.int64), name="q19-rank",
+        preserves_first_key=True)
     return ranked.topk(10, largest=True, name="q19-top10")
 
 
@@ -496,12 +504,13 @@ def q20(persons: Stream, auctions: Stream, bids: Stream) -> Stream:
     by_id = cat.index_by(
         lambda k, v: (k[0],), M.AUCTION_KEY,
         val_fn=lambda k, v: (v[M.A_ITEM].astype(jnp.int64), v[M.A_SELLER]),
-        val_dtypes=(jnp.int64, jnp.int64), name="q20-auctions")
+        val_dtypes=(jnp.int64, jnp.int64), name="q20-auctions",
+        preserves_first_key=True)
     return bids.join_index(
         by_id, lambda k, bv, av: (k, (bv[M.B_BIDDER], bv[M.B_PRICE],
                                       av[0], av[1])),
         (jnp.int64,), (jnp.int64, jnp.int64, jnp.int64, jnp.int64),
-        name="q20-join")
+        name="q20-join", preserves_first_key=True)
 
 
 def q21(persons: Stream, auctions: Stream, bids: Stream) -> Stream:

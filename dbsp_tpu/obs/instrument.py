@@ -95,6 +95,32 @@ def export_kernel_dispatch(registry: MetricsRegistry) -> None:
     registry.register_collector(_collect)
 
 
+def export_exchange_overflows(registry: MetricsRegistry) -> None:
+    """Register a collector mirroring the process-wide exchange
+    bucket-overflow detections (``parallel/exchange.py::
+    EXCHANGE_OVERFLOW_COUNTS``) as
+    ``dbsp_tpu_exchange_overflow_total{kind}``: each count is one validated
+    interval whose per-worker exchange (or sharded-input) bucket overflowed
+    under skew and was re-run at grown capacity by the overflow-replay
+    machinery — the replay saves the rows; the counter makes it visible."""
+    if getattr(registry, "_exchange_overflows_exported", False):
+        return
+    registry._exchange_overflows_exported = True
+    counter = registry.counter(
+        "dbsp_tpu_exchange_overflow_total",
+        "Exchange bucket overflows detected by the capacity-requirement "
+        "check and repaired by overflow replay (kind = exchange | input)",
+        labels=("kind",))
+
+    def _collect() -> None:
+        from dbsp_tpu.parallel.exchange import EXCHANGE_OVERFLOW_COUNTS
+
+        for kind, n in list(EXCHANGE_OVERFLOW_COUNTS.items()):
+            counter.labels(kind=kind).set_total(n)
+
+    registry.register_collector(_collect)
+
+
 def _gid_str(gid: Tuple[int, ...]) -> str:
     return ".".join(map(str, gid))
 
@@ -125,6 +151,7 @@ class CircuitInstrumentation:
         registry.register_collector(self._collect_graph)
         export_consolidate_paths(registry)
         export_kernel_dispatch(registry)
+        export_exchange_overflows(registry)
         circuit.register_scheduler_event_handler(self._on_event)
         # mark exchange operators so they accumulate rows/bytes moved —
         # this costs one scalar device->host sync per exchange per tick
@@ -221,6 +248,23 @@ class CircuitInstrumentation:
                                 "exchanges", labels=("node",)).labels(
                                     node=nid).set_total(
                                         getattr(op, "bytes_moved", 0))
+                    occ = getattr(op, "last_occupancy", None)
+                    if occ and len(occ) > 1:
+                        occ_gauge = reg.gauge(
+                            "dbsp_tpu_exchange_worker_occupancy_rows",
+                            "Live rows landed on each worker by the last "
+                            "observed exchange eval (the skew input)",
+                            labels=("node", "worker"))
+                        for wi, n in enumerate(occ):
+                            occ_gauge.labels(node=nid,
+                                             worker=str(wi)).set(n)
+                        reg.gauge(
+                            "dbsp_tpu_exchange_skew_ratio",
+                            "Max/mean worker occupancy of the last "
+                            "observed exchange eval (1.0 = balanced, "
+                            "W = one worker holds everything)",
+                            labels=("node",)).labels(node=nid).set(
+                                op.skew_ratio)
                 elif isinstance(op, WatermarkMonotonic):
                     if op._wm is not None:
                         reg.gauge("dbsp_tpu_timeseries_watermark_timestamp",
@@ -290,6 +334,7 @@ class CompiledInstrumentation:
         registry.register_collector(self._collect)
         export_consolidate_paths(registry)
         export_kernel_dispatch(registry)
+        export_exchange_overflows(registry)
         if spans is not None:
             driver.spans = spans  # driver records tick/validate spans
 
@@ -332,6 +377,25 @@ class CompiledInstrumentation:
         if stats:
             self.maintain_rows_total.set_total(stats.get("rows_moved", 0))
         for cn in ch.cnodes:
+            if isinstance(cn, cnodes.CExchange):
+                # compiled skew observable: worst-worker rows at the last
+                # validation vs the static per-worker bucket (occupancy
+                # near 1.0 = the next skewed tick overflows and replays)
+                nid = str(cn.node.index)
+                cap = cn.caps.get("exchange", 0)
+                self.registry.gauge(
+                    "dbsp_tpu_exchange_required_rows",
+                    "Worst-worker live rows through this compiled "
+                    "exchange at the last validation",
+                    labels=("node",)).labels(node=nid).set(
+                        cn.last_required)
+                if cap:
+                    self.registry.gauge(
+                        "dbsp_tpu_exchange_bucket_occupancy_ratio",
+                        "last_required / static per-worker exchange "
+                        "capacity (>= 1.0 would overflow and replay)",
+                        labels=("node",)).labels(node=nid).set(
+                            cn.last_required / cap)
             if not isinstance(cn, cnodes._Leveled):
                 continue
             nid = str(cn.node.index)

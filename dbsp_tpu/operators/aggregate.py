@@ -506,9 +506,13 @@ def aggregate(self: Stream, agg, name=None) -> Stream:
         # delta-only accumulators are not 2-d-incremental.
         from dbsp_tpu.operators.nested_ops import NestedAggregateOp
 
-        out = self.circuit.add_unary_operator(
-            NestedAggregateOp(agg, schema, self.circuit, name), self)
+        # shard-lifted: group keys co-locate by first-key hash so each
+        # worker aggregates complete groups; no-op on one worker
+        src = self.shard()
+        out = src.circuit.add_unary_operator(
+            NestedAggregateOp(agg, schema, src.circuit, name), src)
         out.schema = (tuple(schema[0]), tuple(agg.out_dtypes))
+        out.key_sharded = getattr(src, "key_sharded", False)
         return out
     if isinstance(agg, LinearAggregator):
         src = self.shard()  # co-locate keys (no-op on one worker)

@@ -152,24 +152,37 @@ def _with_schema(out: Stream, like: Stream) -> Stream:
     return out
 
 
+def _co_sharded(out: Stream, *ins: Stream) -> Stream:
+    """Exchange fast-path metadata: a per-worker union/negation of streams
+    that are ALL hash-partitioned on their first key column is itself
+    partitioned the same way (rows never move), so a downstream shard()
+    elides its all_to_all."""
+    out.key_sharded = all(getattr(s, "key_sharded", False) for s in ins)
+    return out
+
+
 @stream_method
 def plus(self: Stream, other: Stream) -> Stream:
-    return _with_schema(
-        self.circuit.add_binary_operator(Plus(), self, other), self)
+    return _co_sharded(_with_schema(
+        self.circuit.add_binary_operator(Plus(), self, other), self),
+        self, other)
 
 
 @stream_method
 def minus(self: Stream, other: Stream) -> Stream:
-    return _with_schema(
-        self.circuit.add_binary_operator(Minus(), self, other), self)
+    return _co_sharded(_with_schema(
+        self.circuit.add_binary_operator(Minus(), self, other), self),
+        self, other)
 
 
 @stream_method
 def neg(self: Stream) -> Stream:
-    return _with_schema(self.circuit.add_unary_operator(Neg(), self), self)
+    return _co_sharded(_with_schema(
+        self.circuit.add_unary_operator(Neg(), self), self), self)
 
 
 @stream_method
 def sum_with(self: Stream, others: Sequence[Stream]) -> Stream:
-    return _with_schema(
-        self.circuit.add_nary_operator(SumN(), [self, *others]), self)
+    return _co_sharded(_with_schema(
+        self.circuit.add_nary_operator(SumN(), [self, *others]), self),
+        self, *others)

@@ -121,9 +121,14 @@ def distinct(self: Stream) -> Stream:
         from dbsp_tpu.operators.registry import require_schema
 
         schema = require_schema(self, "distinct (nested)")
-        out = self.circuit.add_unary_operator(
-            NestedDistinctOp(schema, self.circuit), self)
+        # shard-lifted: co-locate equal rows (equal full rows share the
+        # first key column) so each worker's per-row corner spines hold
+        # every occurrence of its rows; no-op on one worker
+        src = self.shard()
+        out = src.circuit.add_unary_operator(
+            NestedDistinctOp(schema, src.circuit), src)
         out.schema = schema
+        out.key_sharded = getattr(src, "key_sharded", False)
         return out
     t = self.trace()
     out = self.circuit.add_unary_operator(DistinctOp(), t)

@@ -195,13 +195,23 @@ def _set_schema(s: Stream, key_dtypes, val_dtypes) -> Stream:
 
 @stream_method
 def map_rows(self: Stream, fn: RowFn, key_dtypes, val_dtypes=(),
-             name: str = "map", preserves_order: bool = False) -> Stream:
+             name: str = "map", preserves_order: bool = False,
+             preserves_first_key: bool = False) -> Stream:
     """General columnar map; declares the output schema (transform outputs
-    are cast to it, so declared and device dtypes cannot drift)."""
+    are cast to it, so declared and device dtypes cannot drift).
+
+    ``preserves_first_key=True`` asserts every output row's FIRST key
+    column equals the input row's first key column (e.g. re-keying on the
+    same leading column, projecting value columns). Rows then stay on
+    their hash-assigned worker, so the stream keeps its ``key_sharded``
+    placement and a downstream shard() elides its all_to_all — the
+    exchange fast path."""
     out = self.circuit.add_unary_operator(
         MapOp(fn, name, preserves_order,
               out_schema=(tuple(jnp.dtype(d) for d in key_dtypes),
                           tuple(jnp.dtype(d) for d in val_dtypes))), self)
+    if preserves_first_key:
+        out.key_sharded = getattr(self, "key_sharded", False)
     return _set_schema(out, key_dtypes, val_dtypes)
 
 
@@ -227,10 +237,14 @@ def flat_map_rows(self: Stream, fn, fanout: int, key_dtypes, val_dtypes=(),
 @stream_method
 def index_by(self: Stream, key_fn: Callable[[Cols, Cols], Cols],
              key_dtypes, val_fn: Callable[[Cols, Cols], Cols] = None,
-             val_dtypes=None, name: str = "index") -> Stream:
+             val_dtypes=None, name: str = "index",
+             preserves_first_key: bool = False) -> Stream:
     """Re-key a Z-set (reference: ``index_with``, operator/index.rs:61).
 
     The resulting batch's key columns are what joins/aggregates group by.
+    ``preserves_first_key=True``: the new first key column is the old one
+    (``key_fn`` returns ``(k[0], ...)``), so hash placement survives and
+    downstream exchanges elide (see :func:`map_rows`).
     """
     if val_fn is None:
         val_fn = lambda k, v: (*k, *v)  # noqa: E731
@@ -242,4 +256,5 @@ def index_by(self: Stream, key_fn: Callable[[Cols, Cols], Cols],
         if val_dtypes is None:
             val_dtypes = (*schema[0], *schema[1])
     fn = lambda k, v: (key_fn(k, v), val_fn(k, v))  # noqa: E731
-    return map_rows(self, fn, key_dtypes, val_dtypes, name=name)
+    return map_rows(self, fn, key_dtypes, val_dtypes, name=name,
+                    preserves_first_key=preserves_first_key)

@@ -163,12 +163,19 @@ class JoinOp(BinaryOperator):
 
 @stream_method
 def join_index(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
-               out_val_dtypes, name: str = "join") -> Stream:
+               out_val_dtypes, name: str = "join",
+               preserves_first_key: bool = False) -> Stream:
     """Incremental equi-join on the streams' key columns.
 
     ``fn(key_cols, left_val_cols, right_val_cols)`` maps each matching pair
     to output key/value columns (join.rs:200 ``join_index`` semantics; plain
     ``join`` == identity keys).
+
+    ``preserves_first_key=True``: every output row's first key column is
+    the probed join key's first column (``fn`` emits ``(k[0], ...)`` keys).
+    Both inputs are co-partitioned by that column's hash, so the output is
+    born partitioned and downstream exchanges elide — the fast path that
+    keeps join -> aggregate chains on-worker.
     """
     from dbsp_tpu.circuit.builder import CircuitError
     from dbsp_tpu.operators.registry import require_schema
@@ -184,19 +191,34 @@ def join_index(self: Stream, other: Stream, fn: JoinFn, out_key_dtypes,
     out_schema = (tuple(out_key_dtypes), tuple(out_val_dtypes))
     if getattr(self.circuit, "nested_incremental", False):
         # inside a recursive() child: joins are incremental over the
-        # (epoch, iteration) product lattice and own their state
+        # (epoch, iteration) product lattice and own their state.
+        # Shard-lifted: both sides co-locate by first-key hash (equal join
+        # keys share the first column) so each worker's corner spines hold
+        # its key-slice's full history; no-op on one worker.
+        left = self.shard()
+        right = other.shard()
         from dbsp_tpu.operators.nested_ops import NestedJoinOp
 
-        out = self.circuit.add_binary_operator(
-            NestedJoinOp(fn, len(ls[0]), (ls, rs), out_schema, self.circuit,
-                         name=f"nested-{name}"), self, other)
+        out = left.circuit.add_binary_operator(
+            NestedJoinOp(fn, len(ls[0]), (ls, rs), out_schema, left.circuit,
+                         name=f"nested-{name}"), left, right)
         out.schema = out_schema
+        if preserves_first_key:
+            # same fast path as the root-clock branch below: the output is
+            # born partitioned by the probe key's first column, so the
+            # nested distinct/aggregate sugar's .shard() elides instead of
+            # paying an all_to_all per child-clock iteration
+            out.key_sharded = (getattr(left, "key_sharded", False)
+                               and getattr(right, "key_sharded", False))
         return out
     lt = self.trace()
     rt = other.trace()
     out = self.circuit.add_binary_operator(
         JoinOp(fn, len(ls[0]), out_schema, name), lt, rt)
     out.schema = out_schema
+    if preserves_first_key:
+        out.key_sharded = (getattr(lt, "key_sharded", False)
+                           and getattr(rt, "key_sharded", False))
     return out
 
 

@@ -52,7 +52,6 @@ historical times.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Tuple
 
 import jax
@@ -63,11 +62,20 @@ from dbsp_tpu.circuit.builder import Stream
 from dbsp_tpu.circuit.operator import BinaryOperator, UnaryOperator
 from dbsp_tpu.operators.aggregate import GroupGather, _unique_keys
 from dbsp_tpu.operators.join import JoinCore, JoinFn
+from dbsp_tpu.parallel.lift import lifted, worker_scalar
 from dbsp_tpu.trace.spine import Spine
 from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 
 ITER_DTYPE = jnp.int64
+
+# Sharded execution ([W, cap] batches inside a shard-lifted recursive
+# child): every jitted kernel below keeps its single-worker body and gains
+# a ``lifted`` dispatch — the factory builds the per-worker function, the
+# SPMD wrapper squeezes the worker axis, and host-side grow-on-demand
+# capacity checks take the WORST worker (np.max over the [W] totals). The
+# child-clock iteration rides in as a ``worker_scalar`` runtime argument so
+# iterating the fixedpoint never recompiles the SPMD programs.
 
 
 # ---------------------------------------------------------------------------
@@ -75,8 +83,7 @@ ITER_DTYPE = jnp.int64
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _slice_iter_level(level: Batch, it, out_cap: int):
+def _slice_iter_level_impl(level: Batch, it, out_cap: int):
     """Rows of an (iter, row...)-keyed level with iter == it, re-keyed to the
     row columns (iter stripped). Returns (cols..., weights, total)."""
     ik = level.keys[0]
@@ -90,11 +97,26 @@ def _slice_iter_level(level: Batch, it, out_cap: int):
     return cols, w, total
 
 
+_slice_iter_level = jax.jit(_slice_iter_level_impl,
+                            static_argnames=("out_cap",))
+
+
+def _slice_iter_level_factory(out_cap: int):
+    return lambda level, it: _slice_iter_level_impl(level, it, out_cap)
+
+
 class _IterSlicer:
     """Grow-on-demand driver extracting one iteration's slice per level."""
 
     def __init__(self):
         self.caps = {}
+
+    @staticmethod
+    def _launch(level: Batch, it: int, cap: int):
+        if level.sharded:
+            return lifted(_slice_iter_level_factory, cap)(
+                level, worker_scalar(it, ITER_DTYPE))
+        return _slice_iter_level(level, it, cap)
 
     def __call__(self, spine: Spine, it: int, nk: int,
                  out_schema) -> Optional[Batch]:
@@ -104,16 +126,16 @@ class _IterSlicer:
         outs, totals, caps = [], [], []
         for level in spine.batches:
             cap = self.caps.get(level.cap, 64)
-            cols, w, total = _slice_iter_level(level, it, cap)
+            cols, w, total = self._launch(level, it, cap)
             outs.append((cols, w))
             totals.append(total)
             caps.append(cap)
         for i, t in enumerate(jax.device_get(totals)):
-            t = int(t)
+            t = int(np.max(t))  # worst worker on sharded levels
             if t > caps[i]:
                 cap = bucket_cap(t)
                 self.caps[spine.batches[i].cap] = cap
-                cols, w, _ = _slice_iter_level(spine.batches[i], it, cap)
+                cols, w, _ = self._launch(spine.batches[i], it, cap)
                 outs[i] = (cols, w)
         batches = [Batch(cols[:nk], cols[nk:], w) for cols, w in outs]
         out = batches[0] if len(batches) == 1 else \
@@ -185,6 +207,11 @@ _join_level_iter_le = jax.jit(_join_level_iter_le_impl,
                               static_argnames=("nk", "fn", "out_cap"))
 
 
+def _join_level_iter_le_factory(nk: int, fn: JoinFn, out_cap: int):
+    return lambda delta, level, it: _join_level_iter_le_impl(
+        delta, level, it, nk, fn, out_cap)
+
+
 class _MaskedJoinCore:
     """Grow-on-demand driver for iteration-masked joins against prev-epoch
     tagged spines (same shape as join.JoinCore)."""
@@ -194,13 +221,19 @@ class _MaskedJoinCore:
         self.fn = fn
         self.caps = {}
 
+    def _launch(self, delta: Batch, level: Batch, it: int, cap: int):
+        if delta.sharded:
+            return lifted(_join_level_iter_le_factory, self.nk, self.fn,
+                          cap)(delta, level, worker_scalar(it, ITER_DTYPE))
+        return _join_level_iter_le(delta, level,
+                                   jnp.asarray(it, ITER_DTYPE), self.nk,
+                                   self.fn, cap)
+
     def join_levels(self, delta: Batch, levels, it) -> List[Batch]:
         outs, totals, caps = [], [], []
-        iarr = jnp.asarray(it, ITER_DTYPE)
         for level in levels:
             cap = self.caps.get(level.cap, max(64, delta.cap))
-            out, total = _join_level_iter_le(delta, level, iarr, self.nk,
-                                             self.fn, cap)
+            out, total = self._launch(delta, level, it, cap)
             outs.append(out)
             totals.append(total)
             caps.append(cap)
@@ -211,8 +244,7 @@ class _MaskedJoinCore:
             if t > caps[i]:
                 cap = bucket_cap(t)
                 self.caps[levels[i].cap] = cap
-                outs[i], _ = _join_level_iter_le(delta, levels[i], iarr,
-                                                 self.nk, self.fn, cap)
+                outs[i], _ = self._launch(delta, levels[i], it, cap)
         return outs
 
 
@@ -317,7 +349,8 @@ class NestedJoinOp(BinaryOperator):
             self._epoch_b.append((it, db))
 
         if not outs:
-            return Batch.empty(*self.out_schema)
+            return Batch.empty(*self.out_schema,
+                               lead=tuple(da.weights.shape[:-1]))
         out = outs[0].consolidate() if len(outs) == 1 else \
             concat_batches(outs).consolidate()
         return out.shrink_to_fit()
@@ -340,8 +373,7 @@ class NestedJoinOp(BinaryOperator):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("q_cap",))
-def _corner_weights(parts, it, q_cap: int):
+def _corner_weights_impl(parts, it, q_cap: int):
     """From prev-spine gather parts of (row -> (iter, w)) pairs: P(i),
     P(i-1), and the mask of rows with weight at exactly iteration i."""
     p_i = jnp.zeros((q_cap,), jnp.int64)
@@ -362,8 +394,22 @@ def _corner_weights(parts, it, q_cap: int):
     return p_i, p_im1, at_i
 
 
-@partial(jax.jit, static_argnames=("q_cap",))
-def _cur_weights(parts, q_cap: int):
+_corner_weights_jit = jax.jit(_corner_weights_impl,
+                              static_argnames=("q_cap",))
+
+
+def _corner_weights_factory(q_cap: int):
+    return lambda parts, it: _corner_weights_impl(parts, it, q_cap)
+
+
+def _corner_weights(parts, it, q_cap: int):
+    if parts[0][2].ndim > 1:  # sharded gather parts
+        return lifted(_corner_weights_factory, q_cap)(
+            parts, worker_scalar(it, ITER_DTYPE))
+    return _corner_weights_jit(parts, it, q_cap)
+
+
+def _cur_weights_impl(parts, q_cap: int):
     """Current-epoch accumulated weight per query row (iters < now)."""
     c = jnp.zeros((q_cap,), jnp.int64)
     for qrow, vals, w in parts:
@@ -372,8 +418,20 @@ def _cur_weights(parts, q_cap: int):
     return c
 
 
-@jax.jit
-def _row_weights_from(batch: Batch, qcols):
+_cur_weights_jit = jax.jit(_cur_weights_impl, static_argnames=("q_cap",))
+
+
+def _cur_weights_factory(q_cap: int):
+    return lambda parts: _cur_weights_impl(parts, q_cap)
+
+
+def _cur_weights(parts, q_cap: int):
+    if parts[0][2].ndim > 1:
+        return lifted(_cur_weights_factory, q_cap)(parts)
+    return _cur_weights_jit(parts, q_cap)
+
+
+def _row_weights_from_impl(batch: Batch, qcols):
     """Per query row: the batch's net weight for that exact row (rows are
     unique in a consolidated batch, so the [lo, hi) range is 0/1 wide)."""
     lo = kernels.lex_probe(batch.cols, qcols, side="left")
@@ -383,8 +441,20 @@ def _row_weights_from(batch: Batch, qcols):
     return jnp.where(found, w, 0)
 
 
-@jax.jit
-def _distinct_out(qcols, qlive, p_i, p_im1, c_im1, dw):
+_row_weights_from_jit = jax.jit(_row_weights_from_impl)
+
+
+def _row_weights_from_factory():
+    return _row_weights_from_impl
+
+
+def _row_weights_from(batch: Batch, qcols):
+    if batch.sharded:
+        return lifted(_row_weights_from_factory)(batch, qcols)
+    return _row_weights_from_jit(batch, qcols)
+
+
+def _distinct_out_impl(qcols, qlive, p_i, p_im1, c_im1, dw):
     c_i = c_im1 + dw
     out = (jnp.where(p_i + c_i > 0, 1, 0) - jnp.where(p_i > 0, 1, 0)
            - jnp.where(p_im1 + c_im1 > 0, 1, 0)
@@ -392,6 +462,20 @@ def _distinct_out(qcols, qlive, p_i, p_im1, c_im1, dw):
     out = jnp.where(qlive, out, 0)
     cols, w = kernels.compact(qcols, out, out != 0)
     return cols, w
+
+
+_distinct_out_jit = jax.jit(_distinct_out_impl)
+
+
+def _distinct_out_factory():
+    return _distinct_out_impl
+
+
+def _distinct_out(qcols, qlive, p_i, p_im1, c_im1, dw):
+    if qlive.ndim > 1:
+        return lifted(_distinct_out_factory)(qcols, qlive, p_i, p_im1,
+                                             c_im1, dw)
+    return _distinct_out_jit(qcols, qlive, p_i, p_im1, c_im1, dw)
 
 
 def _corner_agg_impl(parts, it, q_cap: int, agg, nv: int):
@@ -446,12 +530,23 @@ def _corner_agg_impl(parts, it, q_cap: int, agg, nv: int):
     return tuple(corner_vals), tuple(corner_present)
 
 
-_corner_agg = jax.jit(_corner_agg_impl, static_argnames=("q_cap", "agg",
-                                                         "nv"))
+_corner_agg_jit = jax.jit(_corner_agg_impl, static_argnames=("q_cap", "agg",
+                                                             "nv"))
 
 
-@jax.jit
-def _corner_agg_out(qkeys, qlive, corner_vals, corner_present):
+def _corner_agg_factory(q_cap: int, agg, nv: int):
+    return lambda parts, it: _corner_agg_impl(parts, it, q_cap, agg, nv)
+
+
+def _corner_agg(parts, it: int, q_cap: int, agg, nv: int):
+    if parts[0][3].ndim > 1:  # sharded gather parts
+        return lifted(_corner_agg_factory, q_cap, agg, nv)(
+            parts, worker_scalar(it, ITER_DTYPE))
+    return _corner_agg_jit(parts, jnp.asarray(it, ITER_DTYPE), q_cap, agg,
+                           nv)
+
+
+def _corner_agg_out_impl(qkeys, qlive, corner_vals, corner_present):
     """2-d output delta from the four corner aggregates:
     +A(z(e,i)) - A(z(e-1,i)) - A(z(e,i-1)) + A(z(e-1,i-1)); identical
     values cancel in the consolidation."""
@@ -472,6 +567,20 @@ def _corner_agg_out(qkeys, qlive, corner_vals, corner_present):
                  for c in vals)
     cols, w = kernels.consolidate_cols((*keys, *vals), w)
     return cols, w
+
+
+_corner_agg_out_jit = jax.jit(_corner_agg_out_impl)
+
+
+def _corner_agg_out_factory():
+    return _corner_agg_out_impl
+
+
+def _corner_agg_out(qkeys, qlive, corner_vals, corner_present):
+    if qlive.ndim > 1:
+        return lifted(_corner_agg_out_factory)(qkeys, qlive, corner_vals,
+                                               corner_present)
+    return _corner_agg_out_jit(qkeys, qlive, corner_vals, corner_present)
 
 
 class NestedAggregateOp(UnaryOperator):
@@ -578,9 +687,10 @@ class NestedAggregateOp(UnaryOperator):
                 0, nv, with_tag=False)
 
         if not parts:
-            return Batch.empty(*self.out_schema)
+            return Batch.empty(*self.out_schema,
+                               lead=tuple(delta.weights.shape[:-1]))
         corner_vals, corner_present = _corner_agg(
-            tuple(parts), jnp.asarray(it, ITER_DTYPE), q_cap, self.agg, nv)
+            tuple(parts), it, q_cap, self.agg, nv)
         cols, w = _corner_agg_out(qkeys, qlive, corner_vals, corner_present)
         out = Batch(cols[:nk], cols[nk:], w).shrink_to_fit()
 
@@ -658,13 +768,13 @@ class NestedDistinctOp(UnaryOperator):
 
         prev_parts = self._prev_gather(qcols, qlive, self.prev.batches, q_cap)
         if prev_parts is None:
-            p_i = p_im1 = jnp.zeros((q_cap,), jnp.int64)
-            at_i = jnp.zeros((q_cap,), jnp.bool_)
+            p_i = p_im1 = jnp.zeros(qlive.shape, jnp.int64)
+            at_i = jnp.zeros(qlive.shape, jnp.bool_)
         else:
             p_i, p_im1, at_i = _corner_weights(tuple(prev_parts), it, q_cap)
 
         cur_parts = self._cur_gather(qcols, qlive, self.cur.batches, q_cap)
-        c_im1 = jnp.zeros((q_cap,), jnp.int64) if cur_parts is None else \
+        c_im1 = jnp.zeros(qlive.shape, jnp.int64) if cur_parts is None else \
             _cur_weights(tuple(cur_parts), q_cap)
 
         dw = _row_weights_from(flat_delta, qcols)

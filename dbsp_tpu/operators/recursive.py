@@ -23,8 +23,7 @@ from typing import Callable
 from dbsp_tpu.circuit.builder import Circuit, CircuitError, Stream
 from dbsp_tpu.circuit.nested import ChildCircuit, subcircuit
 from dbsp_tpu.operators.registry import stream_method
-from dbsp_tpu.operators.z1 import Z1
-from dbsp_tpu.zset.batch import Batch
+from dbsp_tpu.operators.z1 import Z1, _zero_like_factory
 
 
 def recursive_streams(parent: Circuit, inputs, f):
@@ -39,16 +38,23 @@ def recursive_streams(parent: Circuit, inputs, f):
     from dbsp_tpu.operators.registry import require_schema
 
     schemas = [require_schema(s, "recursive_streams") for s in inputs]
-    inputs = [s.unshard() for s in inputs]  # nested ops are not shard-lifted
+    # SHARD-LIFTED: each relation's rows co-locate by hash of its first key
+    # column, the fixedpoint inner circuit evaluates per worker key-slice
+    # ([W, cap] batches through the nested operators' lifted kernels), and
+    # only the convergence check reduces across workers (a condition
+    # batch's live_count() sums the worker axis). No-op on a 1-worker mesh.
+    inputs = [s.shard() for s in inputs]
 
     def ctor(child: ChildCircuit):
         child.nested_incremental = True
         i0s = [child.import_stream(s) for s in inputs]
         fbs = []
-        for schema in schemas:
-            fb = child.add_feedback(
-                Z1(lambda _s=schema: Batch.empty(*_s)))
+        for schema, i0 in zip(schemas, i0s):
+            # worker-aware zero: the z^-1 seed must carry the same [W, cap]
+            # placement as the deltas it merges with
+            fb = child.add_feedback(Z1(_zero_like_factory(schema)))
             fb.stream.schema = schema
+            fb.stream.key_sharded = getattr(i0, "key_sharded", False)
             fbs.append(fb)
         steps = f(child, [fb.stream for fb in fbs])
         if len(steps) != len(inputs):
@@ -70,9 +76,12 @@ def recursive_streams(parent: Circuit, inputs, f):
 
     exports, _ = subcircuit(parent, ctor, iterative=True)
     outs = []
-    for i, schema in enumerate(schemas):
+    for i, (schema, i0) in enumerate(zip(schemas, inputs)):
         out = exports.apply(lambda t, _i=i: t[_i], name=f"export{i}")
         out.schema = schema
+        # the exported integral accumulates distinct deltas that the nested
+        # distinct re-sharded by first-key hash — placement survives
+        out.key_sharded = getattr(i0, "key_sharded", False)
         outs.append(out)
     return outs
 
@@ -97,14 +106,18 @@ def recursive(parent: Circuit, input_stream: Stream,
     from dbsp_tpu.operators.registry import require_schema
 
     schema = require_schema(input_stream, "recursive")
-    # nested operators are not shard-lifted: collapse a sharded input first
-    input_stream = input_stream.unshard()
+    # SHARD-LIFTED (see recursive_streams): the fixedpoint child evaluates
+    # per worker key-slice; the nested join/distinct sugar re-shards
+    # re-keyed intermediates inside the child, so no mid-circuit unshard
+    # remains. No-op on a 1-worker mesh.
+    input_stream = input_stream.shard()
 
     def ctor(child: ChildCircuit):
         child.nested_incremental = True
         i0 = child.import_stream(input_stream)
-        fb = child.add_feedback(Z1(lambda: Batch.empty(*schema)))
+        fb = child.add_feedback(Z1(_zero_like_factory(schema)))
         fb.stream.schema = schema
+        fb.stream.key_sharded = getattr(i0, "key_sharded", False)
         step = f(child, fb.stream)
         if getattr(step, "schema", None) != schema:
             raise CircuitError(
@@ -126,6 +139,7 @@ def recursive(parent: Circuit, input_stream: Stream,
     exports, _ = subcircuit(parent, ctor, iterative=True)
     out = exports.apply(lambda t: t[0], name="export0")
     out.schema = schema
+    out.key_sharded = getattr(input_stream, "key_sharded", False)
     return out
 
 

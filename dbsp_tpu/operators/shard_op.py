@@ -36,24 +36,51 @@ class _MovedRowsMixin:
     """Rows/bytes-moved accounting shared by shard and unshard.
 
     Accumulates ONLY when instrumentation flips ``obs_enabled``
-    (obs/instrument.py) — the live-row count is one extra scalar
-    device->host sync per tick on this path."""
+    (obs/instrument.py) — the live-row count is one extra device->host
+    sync per tick on this path (a [W] vector for sharded outputs, which
+    also yields the per-worker occupancy the skew gauges export)."""
 
     obs_enabled = False
 
     def _init_obs(self) -> None:
         self.rows_moved = 0
         self.bytes_moved = 0
+        # last eval's per-worker live rows ([n] for unsharded outputs) and
+        # the max/mean skew ratio derived from it — obs/instrument.py
+        # exports these as dbsp_tpu_exchange_worker_occupancy_rows{worker}
+        # and dbsp_tpu_exchange_skew_ratio
+        self.last_occupancy: list = []
 
     def _note_moved(self, out: Batch) -> None:
         if self.obs_enabled:
-            n = int(out.live_count())
+            import jax
+            import jax.numpy as jnp
+
+            if out.sharded:
+                per = jax.device_get(jnp.sum(out.weights != 0, axis=-1))
+                self.last_occupancy = [int(x) for x in per]
+                n = int(sum(self.last_occupancy))
+            else:
+                n = int(out.live_count())
+                self.last_occupancy = [n]
             self.rows_moved += n
             self.bytes_moved += n * _row_bytes(out)
 
+    @property
+    def skew_ratio(self) -> float:
+        """max/mean worker occupancy of the last observed eval (1.0 =
+        perfectly balanced; W = everything on one worker)."""
+        occ = self.last_occupancy
+        total = sum(occ)
+        if len(occ) <= 1 or total == 0:
+            return 1.0
+        return max(occ) / (total / len(occ))
+
     def metadata(self):
         return {"rows_moved": self.rows_moved,
-                "bytes_moved": self.bytes_moved}
+                "bytes_moved": self.bytes_moved,
+                "occupancy": list(self.last_occupancy),
+                "skew_ratio": round(self.skew_ratio, 3)}
 
 
 class ExchangeOp(_MovedRowsMixin, UnaryOperator):
@@ -83,10 +110,12 @@ class ExchangeOp(_MovedRowsMixin, UnaryOperator):
 
 class UnshardOp(_MovedRowsMixin, UnaryOperator):
     """Collapse a sharded stream to host-resident 1-D batches (all-gather +
-    consolidate). Inserted by operators that are not yet shard-lifted
-    (topk / rolling / window) so they run with single-worker semantics
-    inside a multi-worker circuit — correctness first, parallelism where
-    implemented (the reference's gather(), communication/gather.rs:41)."""
+    consolidate) — the reference's gather() (communication/gather.rs:41).
+    Since the shard-lift of recursive children and the rolling radix path,
+    NO operator sugar inserts this mid-circuit (analyzer rule P003 keeps
+    it that way); it remains for output boundaries, range-partitioned
+    traces (``trace(shard=False)``, join_range) and explicit user
+    ``.unshard()`` calls."""
 
     name = "unshard"
 

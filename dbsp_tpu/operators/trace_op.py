@@ -87,8 +87,9 @@ def trace(self: Stream, shard: bool = True) -> Stream:
     worker's spine holds a disjoint key slice — the reference's stateful
     operators call shard() on their inputs the same way (shard.rs:89,
     join.rs:268-270). ``shard=False`` instead collapses the stream to a
-    host-resident trace (for consumers not yet lifted over the mesh:
-    topk / rolling / window)."""
+    host-resident trace — only for consumers whose access pattern is not
+    hash-local (range partitioning: join_range); hash-keyed consumers
+    (join/aggregate/distinct/topk/window/rolling) are all shard-lifted."""
     from dbsp_tpu.operators.registry import require_schema
 
     src = self.shard() if shard else self.unshard()

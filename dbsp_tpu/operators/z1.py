@@ -77,6 +77,9 @@ def delay(self: Stream, zero_factory: Optional[Callable[[], Any]] = None
     fb = self.circuit.add_feedback(Z1(zf))
     fb.connect(self)
     fb.stream.schema = getattr(self, "schema", None)
+    # a delay emits the input's own batches one tick later (or a
+    # same-placement zero): partitioning survives
+    fb.stream.key_sharded = getattr(self, "key_sharded", False)
     return fb.stream
 
 
@@ -94,6 +97,9 @@ def integrate(self: Stream, zero_factory: Optional[Callable[[], Any]] = None
         _PlusNamed("integrate"), self, fb.stream)
     fb.connect(acc)
     acc.schema = getattr(self, "schema", None)
+    # the running sum merges per worker; partitioning survives
+    acc.key_sharded = getattr(self, "key_sharded", False)
+    fb.stream.key_sharded = acc.key_sharded
     return acc
 
 
@@ -106,6 +112,7 @@ def differentiate(self: Stream,
     delayed = self.delay(zero_factory)
     out = self.circuit.add_binary_operator(Minus(), self, delayed)
     out.schema = getattr(self, "schema", None)
+    out.key_sharded = getattr(self, "key_sharded", False)
     return out
 
 
@@ -124,4 +131,35 @@ def _schema_zero(stream: Stream) -> Callable[[], Any]:
             "stream has no schema metadata; pass zero_factory= explicitly "
             "(needed by delay/integrate/differentiate to produce the t=0 "
             "value)")
+    # placement-aware default: a stream explicitly collapsed to the host
+    # (unshard() — the P003-waived host-resident shape) carries 1-D
+    # batches even on a W>1 mesh, and Z1 emits its zero at clock_start
+    # BEFORE any value is seen, so the placement must be decided at build
+    # time — a [W, cap] zero against the stream's 1-D batches is a
+    # mixed-placement merge downstream. Placement-preserving transforms
+    # between the unshard and the delay (map/filter/...) carry the 1-D
+    # shape through, so walk back across them, not just one hop.
+    from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+
+    node, seen = stream.node, set()
+    while node.index not in seen:
+        seen.add(node.index)
+        op = node.operator
+        if isinstance(op, UnshardOp):
+            key_dtypes, val_dtypes = schema
+            return lambda: Batch.empty(key_dtypes, val_dtypes)
+        if isinstance(op, ExchangeOp) or not _placement_thru(op) \
+                or not node.inputs:
+            break
+        node = stream.circuit.nodes[node.inputs[0]]
     return _zero_like_factory(schema)
+
+
+def _placement_thru(op) -> bool:
+    """Ops whose output batches keep their (first) input's lead-axis
+    placement — the backward-walk pass-through set for _schema_zero."""
+    from dbsp_tpu.operators.basic import Minus, Neg, Plus, SumN
+    from dbsp_tpu.operators.filter_map import FilterOp, FlatMapOp, MapOp
+
+    return isinstance(op, (FilterOp, MapOp, FlatMapOp, Neg, Plus, Minus,
+                           SumN, Z1))

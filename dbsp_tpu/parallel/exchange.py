@@ -36,6 +36,23 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch
 
 
+# Process-wide exchange bucket-overflow detections, by site kind
+# ("exchange" = a CExchange's static per-worker output capacity, "input" =
+# a sharded CInput's per-worker share capacity). The compiled step runs
+# optimistically: a skewed tick can route more rows to one worker than the
+# static bucket holds, and the surplus would silently fall off the
+# ``with_cap`` slice — the requirement check catches it at the next
+# validation, the overflow-replay machinery re-runs the interval at grown
+# capacity, and THIS counter (exported as
+# ``dbsp_tpu_exchange_overflow_total{kind}``, mirrored in bench detail)
+# makes each such save visible instead of silent.
+EXCHANGE_OVERFLOW_COUNTS: dict = {}
+
+
+def count_exchange_overflow(kind: str, n: int = 1) -> None:
+    EXCHANGE_OVERFLOW_COUNTS[kind] = EXCHANGE_OVERFLOW_COUNTS.get(kind, 0) + n
+
+
 def _hash_key(col: jnp.ndarray) -> jnp.ndarray:
     """splitmix64-style mix of the first key column (any int dtype)."""
     z = col.astype(jnp.uint64) * jnp.uint64(0x9E3779B97F4A7C15)

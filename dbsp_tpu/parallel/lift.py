@@ -52,6 +52,18 @@ def lifted(factory, *statics):
     return _lifted_jit(current_mesh(), factory, tuple(statics))
 
 
+def worker_scalar(v, dtype=None):
+    """Replicate a host scalar to a [W] device array so it can ride through
+    a ``lifted`` call as a runtime argument: ``shard_map``'s
+    ``P(workers)`` spec splits it to a per-worker [1] slice, and the spmd
+    body's squeeze hands each worker a 0-d scalar. The alternative — a
+    static argument — would recompile the SPMD program per value (e.g. one
+    compile per child-clock iteration in nested operators)."""
+    import jax.numpy as jnp
+
+    return jnp.full((current_mesh().devices.size,), v, dtype)
+
+
 def op_kernel(op):
     """Factory for instance-bound kernels: the operator instance is the
     (hashable, stable) static identity; its ``_inner`` is the pure body."""

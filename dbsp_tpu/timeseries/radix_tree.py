@@ -51,9 +51,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from dbsp_tpu.operators.aggregate import Aggregator, _reduce_groups
+from dbsp_tpu.parallel.lift import lifted
 from dbsp_tpu.trace.spine import Spine
 from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, bucket_cap
+
+# Sharded execution: under a multi-worker mesh the rolling operator routes
+# rows by the partition column's hash, so every partition's history lives
+# wholly on one worker and the tree decomposes into W independent
+# per-worker trees over [W, cap] level batches. Each jitted kernel below
+# keeps its 1-D body and dispatches through ``lifted`` when its operands
+# carry a worker axis; grow-on-demand capacity checks take the worst
+# worker. Maintenance and queries therefore never leave the mesh — the
+# host drives the same loop, over per-worker slices.
 
 
 # ---------------------------------------------------------------------------
@@ -87,21 +97,34 @@ def _range_gather_impl(qp, qlo, qhi, qlive, level: Batch, out_cap: int):
 _range_gather = jax.jit(_range_gather_impl, static_argnames=("out_cap",))
 
 
+def _range_gather_factory(out_cap: int):
+    return lambda qp, qlo, qhi, qlive, level: _range_gather_impl(
+        qp, qlo, qhi, qlive, level, out_cap)
+
+
 class RangeGather:
     """Grow-on-demand driver for vectorized [lo, hi] range gathers over a
     spine's batches; one batched overflow sync per call. Counts gathered
-    slot capacity (tests assert the O(log) query-cost scaling)."""
+    slot capacity (tests assert the O(log) query-cost scaling). Sharded
+    levels gather per worker; capacity checks take the worst worker."""
 
     def __init__(self):
         self.caps: Dict[int, int] = {}
         self.rows_gathered = 0
+
+    @staticmethod
+    def _launch(qp, qlo, qhi, qlive, level: Batch, cap: int):
+        if level.sharded:
+            return lifted(_range_gather_factory, cap)(qp, qlo, qhi, qlive,
+                                                      level)
+        return _range_gather(qp, qlo, qhi, qlive, level, cap)
 
     def __call__(self, qp, qlo, qhi, qlive, levels: Sequence[Batch],
                  q_cap: int):
         parts, totals, caps = [], [], []
         for level in levels:
             cap = self.caps.get(level.cap, max(64, q_cap))
-            out = _range_gather(qp, qlo, qhi, qlive, level, cap)
+            out = self._launch(qp, qlo, qhi, qlive, level, cap)
             parts.append(out[:4])
             totals.append(out[4])
             caps.append(cap)
@@ -113,7 +136,7 @@ class RangeGather:
             if t > caps[i]:
                 cap = bucket_cap(t)
                 self.caps[levels[i].cap] = cap
-                out = _range_gather(qp, qlo, qhi, qlive, levels[i], cap)
+                out = self._launch(qp, qlo, qhi, qlive, levels[i], cap)
                 parts[i] = out[:4]
         self.rows_gathered += int(sum(np.max(t) for t in tvals))
         return [(qrow, (t, v), w) for qrow, t, v, w in parts]
@@ -204,15 +227,15 @@ class RadixTimeIndex:
         """
         bits = self.radix_bits
         spine = self.levels[L - 1]
-        q_cap = p.shape[0]
+        q_cap = p.shape[-1]  # last axis: [q] or sharded [W, q]
         qlive = p != kernels.sentinel_for(p.dtype)
         clo = pref << bits
         chi = ((pref + 1) << bits) - 1
         gathered = self._child_gather[L - 1](p, clo, chi, qlive,
                                              child_levels, q_cap)
         if gathered is None:
-            new_vals = (jnp.zeros((q_cap,), self.agg.out_dtypes[0]),)
-            new_present = jnp.zeros((q_cap,), jnp.bool_)
+            new_vals = (jnp.zeros(p.shape, self.agg.out_dtypes[0]),)
+            new_present = jnp.zeros(p.shape, jnp.bool_)
         else:
             # reduce on the value column; the position column rides along
             # in the parts only to keep rows distinct while netting.
@@ -225,9 +248,9 @@ class RadixTimeIndex:
         old = self._old_gather[L - 1](p, pref, pref, qlive, spine.batches,
                                       q_cap)
         if old is None:
-            old_vals = (kernels.sentinel_fill((q_cap,),
+            old_vals = (kernels.sentinel_fill(p.shape,
                                               self.agg.out_dtypes[0]),)
-            old_present = jnp.zeros((q_cap,), jnp.bool_)
+            old_present = jnp.zeros(p.shape, jnp.bool_)
         else:
             parts = tuple((qrow, (t, v), w) for qrow, (t, v), w in old)
             old_vals, old_present = _reduce_groups(parts, _KeepCol1(), q_cap)
@@ -272,8 +295,8 @@ class RadixTimeIndex:
 
         def reduce(parts, agg):
             if not parts:
-                return (jnp.zeros((q_cap,), self.agg.out_dtypes[0]),
-                        jnp.zeros((q_cap,), jnp.bool_))
+                return (jnp.zeros(qp.shape, self.agg.out_dtypes[0]),
+                        jnp.zeros(qp.shape, jnp.bool_))
             vals, present = _reduce_groups(tuple(parts), _OnCol1(agg), q_cap)
             return vals[0], present
 
@@ -343,12 +366,8 @@ class _KeepCol1(Aggregator):
 # ---------------------------------------------------------------------------
 
 
-from functools import partial as _partial
-
-
-@_partial(jax.jit, static_argnames=("combine", "q_cap"))
-def _combine_partials(raw_val, raw_present, buck_val, buck_present,
-                      combine: Aggregator, q_cap: int):
+def _combine_partials_impl(raw_val, raw_present, buck_val, buck_present,
+                           combine: Aggregator, q_cap: int):
     """Fold the raw-fringe partial and the bucket partial per query row with
     the combine semigroup (absent partials are masked by weight 0)."""
     seg = jnp.concatenate([jnp.arange(q_cap, dtype=jnp.int32)] * 2)
@@ -359,8 +378,25 @@ def _combine_partials(raw_val, raw_present, buck_val, buck_present,
     return out[0], raw_present | buck_present
 
 
-@jax.jit
-def _unique_prefixes(p, pref, live):
+_combine_partials_jit = jax.jit(_combine_partials_impl,
+                                static_argnames=("combine", "q_cap"))
+
+
+def _combine_partials_factory(combine: Aggregator, q_cap: int):
+    return lambda rv, rp, bv, bp: _combine_partials_impl(rv, rp, bv, bp,
+                                                         combine, q_cap)
+
+
+def _combine_partials(raw_val, raw_present, buck_val, buck_present,
+                      combine: Aggregator, q_cap: int):
+    if raw_present.ndim > 1:  # sharded query rows
+        return lifted(_combine_partials_factory, combine, q_cap)(
+            raw_val, raw_present, buck_val, buck_present)
+    return _combine_partials_jit(raw_val, raw_present, buck_val,
+                                 buck_present, combine, q_cap)
+
+
+def _unique_prefixes_impl(p, pref, live):
     """Distinct live (p, prefix) pairs, compacted to the front. Inputs are
     sorted by (p, t) and prefixing is monotone in t, so (p, pref) stays
     sorted and distinctness is an adjacent-equality check."""
@@ -373,19 +409,34 @@ def _unique_prefixes(p, pref, live):
     return cols[0], cols[1]
 
 
+_unique_prefixes_jit = jax.jit(_unique_prefixes_impl)
+
+
+def _unique_prefixes_factory():
+    return _unique_prefixes_impl
+
+
+def _unique_prefixes(p, pref, live):
+    if live.ndim > 1:
+        return lifted(_unique_prefixes_factory)(p, pref, live)
+    return _unique_prefixes_jit(p, pref, live)
+
+
 def _trim(p, pref):
     """Re-bucket compacted (p, pref) columns to the live count (one sync) —
-    keeps every per-level kernel sized by touched prefixes."""
-    n = int(jnp.sum(p != kernels.sentinel_for(p.dtype)))
+    keeps every per-level kernel sized by touched prefixes. Sharded
+    columns bucket by the worst worker (every slice shares one cap)."""
+    live = p != kernels.sentinel_for(p.dtype)
+    n = int(jnp.max(jnp.sum(live, axis=-1))) if p.ndim > 1 \
+        else int(jnp.sum(live))
     cap = bucket_cap(max(n, 1))
     if cap < p.shape[-1]:
         p, pref = p[..., :cap], pref[..., :cap]
     return p, pref
 
 
-@jax.jit
-def _bucket_diff(p, pref, qlive, new_vals, new_present, old_vals,
-                 old_present):
+def _bucket_diff_impl(p, pref, qlive, new_vals, new_present, old_vals,
+                      old_present):
     """Retract/insert delta batch for the (p, prefix) bucket rows."""
     changed = (new_present != old_present) | \
         ~kernels._col_eq(new_vals.astype(old_vals.dtype), old_vals)
@@ -396,3 +447,20 @@ def _bucket_diff(p, pref, qlive, new_vals, new_present, old_vals,
     w = jnp.concatenate([ins, ret]).astype(jnp.int64)
     cols, w = kernels.consolidate_cols((*keys, *vals), w)
     return Batch(cols[:2], cols[2:], w)
+
+
+_bucket_diff_jit = jax.jit(_bucket_diff_impl)
+
+
+def _bucket_diff_factory():
+    return _bucket_diff_impl
+
+
+def _bucket_diff(p, pref, qlive, new_vals, new_present, old_vals,
+                 old_present):
+    if qlive.ndim > 1:
+        return lifted(_bucket_diff_factory)(p, pref, qlive, new_vals,
+                                            new_present, old_vals,
+                                            old_present)
+    return _bucket_diff_jit(p, pref, qlive, new_vals, new_present,
+                            old_vals, old_present)

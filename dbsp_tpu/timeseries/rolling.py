@@ -273,7 +273,7 @@ class RollingAggregateOp(UnaryOperator):
         # input row at exactly (p, t') is live — a non-empty window alone is
         # not enough (the retraction of (p, t') must retract its output even
         # though neighbours still populate the window).
-        if self.tree is not None and not delta.sharded:
+        if self.tree is not None:
             self.tree.update(delta, view.spine.batches)
             new_vals, _range_present = self.tree.query(
                 ap, at - self.range_ms, at, alive, view.spine.batches, a_cap)
@@ -347,12 +347,9 @@ def partitioned_rolling_aggregate(self: Stream, agg: Aggregator,
     # every partition's window lives wholly on one worker and per-worker
     # rolling unions exactly (reference: rolling_aggregate.rs:235
     # self-shards by partition the same way). The radix-tree fast path is
-    # host-driven per tick and not yet lifted — sharded runs use the
-    # window-recompute path (use_tree is ignored under a mesh).
-    from dbsp_tpu.circuit.runtime import Runtime
-
-    if Runtime.worker_count() > 1:
-        use_tree = False
+    # shard-lifted too — per-worker trees over the partition key-slices
+    # (timeseries/radix_tree.py module doc), so use_tree keeps its meaning
+    # at any worker count.
     t = self.trace()
     out = self.circuit.add_unary_operator(
         RollingAggregateOp(agg, range_ms, schema, name, use_tree=use_tree), t)

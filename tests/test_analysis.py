@@ -360,7 +360,7 @@ def test_nexmark_and_demo_circuits_have_no_errors():
 def test_rule_catalog_is_complete():
     ids = {r.rule_id for r in rule_catalog()}
     assert {"W001", "W002", "W003", "W004", "S001", "S002", "P001", "P002",
-            "I001", "I002"} <= ids
+            "P003", "I001", "I002"} <= ids
     for r in rule_catalog():
         assert r.severity in (ERROR, WARN) and r.catches and r.fix_hint
 

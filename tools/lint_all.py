@@ -14,8 +14,15 @@ the tier-1 test in tests/test_analysis.py):
    SHA-256 of their checked-out sources (a drifted ``.so`` is a red lint).
 3. **Analyzer self-check** — build every Nexmark query circuit plus a set
    of representative demo circuits and run the static analyzer
-   (dbsp_tpu/analysis) over each: any ERROR finding is a lint failure
-   (the zero-false-positive contract — known-good circuits must verify).
+   (dbsp_tpu/analysis) over each at workers 1/4/8 WITH --strict-shard:
+   any ERROR finding is a lint failure (the zero-false-positive contract
+   — known-good circuits must verify; a reintroduced mid-circuit
+   unshard() is a P003 ERROR at workers>1).
+4. **Multichip** (CLI only; DBSP_TPU_LINT_MULTICHIP=0 skips) —
+   ``dryrun_multichip(8)`` 8 == 1 bit-identity plus the
+   ``bench.py --workers-sweep`` mini-protocol, in subprocesses. The
+   import-based tier-1 consumers (tests/test_analysis.py) run the static
+   fronts only; tests/test_multichip.py carries the runtime coverage.
 
 Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
 1 when any front fails.
@@ -115,19 +122,100 @@ def run_analyzer_selfcheck() -> list:
     from dbsp_tpu.analysis.__main__ import (_build_query,
                                             _nexmark_query_names)
 
+    from dbsp_tpu.circuit.runtime import Runtime
+
     violations = []
     targets = [(n, _build_query(n)) for n in _nexmark_query_names()]
     targets += list(_demo_circuits())
     for name, circuit in targets:
-        # workers=4 is the what-if sweep: a single-worker build carries
+        # workers=4/8 are the what-if sweeps: a single-worker build carries
         # placement intent (elided exchanges), so probing a larger mesh
         # must stay free of false P001 errors too
-        for workers in (1, 4):
-            for f in analyze(circuit, workers=workers):
+        for workers in (1, 4, 8):
+            for f in analyze(circuit, workers=workers, strict_shard=True):
                 if f.severity == ERROR:
                     violations.append(
                         f"analyzer false positive on {name} "
                         f"(workers={workers}): {f.render()}")
+    # The machine-enforced zero-unshard invariant: REBUILD every target
+    # under an 8-worker build-only Runtime so the sugar materializes the
+    # real multi-worker node shapes (a 1-worker build elides unshard() to
+    # intent metadata, which P003 cannot see — a reintroduced mid-circuit
+    # unshard would sail through the what-if sweep above). build_only
+    # skips mesh construction, so this runs on any host.
+    prev = Runtime._swap(Runtime(8, build_only=True))
+    try:
+        targets8 = [(n, _build_query(n)) for n in _nexmark_query_names()]
+        targets8 += list(_demo_circuits())
+    finally:
+        Runtime._swap(prev)
+    for name, circuit in targets8:
+        for f in analyze(circuit, workers=8, strict_shard=True):
+            if f.severity == ERROR:
+                violations.append(
+                    f"analyzer error on the REAL 8-worker build of {name}: "
+                    f"{f.render()}")
+    return violations
+
+
+def run_multichip() -> list:
+    """4. **Multichip dryrun + workers-sweep mini-protocol** (subprocess;
+    CLI runs it by default, ``DBSP_TPU_LINT_MULTICHIP=0`` skips — the
+    import-based tier-1 consumers get the same coverage from
+    tests/test_multichip.py instead of paying it twice):
+
+    * ``dryrun_multichip(8)`` — the full sharded q4 circuit, host and
+      compiled, 8 == 1 bit-identical (the zero-unshard invariant's
+      runtime half; the static half is P003 in the analyzer sweep);
+    * ``bench.py --workers-sweep 1,8`` at mini scale — the MULTICHIP
+      protocol end-to-end: per-W children, scaling JSON, exchange
+      skew/overflow export.
+    """
+    import json
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_MULTICHIP", "1") == "0":
+        print("lint_all: multichip: skipped (DBSP_TPU_LINT_MULTICHIP=0)")
+        return []
+    violations = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return ["dryrun_multichip(8) timed out after 900s"]
+    if p.returncode != 0:
+        violations.append(
+            f"dryrun_multichip(8) failed (8 == 1 broken?):\n"
+            f"{p.stdout[-800:]}\n{p.stderr[-800:]}")
+    env2 = dict(os.environ, BENCH_QUERIES="q4", BENCH_QUERY="q4",
+                BENCH_EVENTS="30000", BENCH_BATCH="3000",
+                BENCH_TIME_BUDGET_S="600")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "bench.py"),
+             "--workers-sweep", "1,8"],
+            cwd=_ROOT, env=env2, capture_output=True, text=True,
+            timeout=900)
+    except subprocess.TimeoutExpired:
+        violations.append("workers-sweep mini-protocol timed out (900s)")
+        return violations
+    from bench import last_json_object
+
+    obj = last_json_object(p.stdout)
+    if obj is None:
+        violations.append(
+            f"workers-sweep mini-protocol emitted no JSON:\n"
+            f"{p.stdout[-400:]}\n{p.stderr[-400:]}")
+    else:
+        q4 = (obj.get("scaling") or {}).get("q4", {})
+        if "8" not in q4:
+            violations.append(
+                f"workers-sweep mini-protocol missing W=8 q4 scaling "
+                f"entry: {json.dumps(obj.get('scaling'))[:400]}")
     return violations
 
 
@@ -136,7 +224,8 @@ def main() -> int:
               ("check_hotpath", run_check_hotpath),
               ("check_state", run_check_state),
               ("check_native", run_check_native),
-              ("analyzer_selfcheck", run_analyzer_selfcheck)]
+              ("analyzer_selfcheck", run_analyzer_selfcheck),
+              ("multichip", run_multichip)]
     failed = 0
     for name, fn in fronts:
         violations = fn()
