@@ -73,15 +73,23 @@ size sample per validated interval plus a ``growth_summary`` decay figure
 dispatch on CPU (one dispatch per validation interval — the 10M-event
 growth run uses both with a coarse BENCH_VALIDATE_EVERY).
 
+Attribution: ``--profile`` (env BENCH_PROFILE=1) runs a segmented
+operator profile of each query's final steady state (dbsp_tpu.obs
+.opprofile — per-node wall time + rows, asserted bit-identical to the
+fused step program, engine rewound) and embeds the top-operator table as
+detail["profile"]; BENCH_PROFILE_TICKS sizes the run (default 4),
+BENCH_PROFILE_OUT writes each full report JSON (``%q`` expands to the
+query name — tools/roofline.py --per-node consumes it).
+
 Env knobs: BENCH_EVENTS (per query; default 750_000 on CPU — >=100 ticks
 at the CPU batch — 2_000_000 on TPU), BENCH_BATCH (events/tick, default
 7_500 on CPU / 100_000 on TPU), BENCH_QUERIES, BENCH_QUERY (headline
 override), BENCH_WARM_TICKS (default 4), BENCH_PLATFORM (cpu|tpu|probe,
 default probe), BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE
 (compiled|host), BENCH_VALIDATE_EVERY (default 8), BENCH_WORKERS,
-BENCH_SCAN, BENCH_GROWTH, BENCH_SLO / --slo (SLO gate; thresholds from
-DBSP_TPU_SLO_P99_TICK_MS / _TICK_P50_MULTIPLE / _WATERMARK_LAG /
-_OVERFLOW_REPLAYS).
+BENCH_SCAN, BENCH_GROWTH, BENCH_PROFILE / --profile, BENCH_SLO / --slo
+(SLO gate; thresholds from DBSP_TPU_SLO_P99_TICK_MS /
+_TICK_P50_MULTIPLE / _WATERMARK_LAG / _OVERFLOW_REPLAYS).
 """
 
 import json
@@ -648,6 +656,31 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
                                                       f" {e}"[:200]}
         finally:
             _sh.rmtree(ckdir, ignore_errors=True)
+    # Operator attribution (dbsp_tpu.obs.opprofile — EXPLAIN ANALYZE for
+    # the compiled engine): --profile / BENCH_PROFILE=1 runs a segmented
+    # measured profile of the final steady state — per-node wall time +
+    # rows asserted bit-identical to the fused program, engine rewound —
+    # and embeds the top-operator table per query. BENCH_PROFILE_OUT
+    # writes the full report JSON (%q -> query name) for
+    # tools/roofline.py --per-node. Opt-in: segmentation compiles one
+    # program per node and runs ~overhead x the fused tick.
+    if os.environ.get("BENCH_PROFILE") and samples:
+        from dbsp_tpu.obs import opprofile
+
+        try:
+            n_prof = int(os.environ.get("BENCH_PROFILE_TICKS", "4"))
+            report = opprofile.measured_profile(ch, n=n_prof, t0=m0 + ticks)
+            detail["profile"] = opprofile.summarize_for_bench(report)
+            out = os.environ.get("BENCH_PROFILE_OUT")
+            if out:
+                with open(out.replace("%q", qname), "w") as f:
+                    json.dump(report, f, indent=1)
+        except opprofile.ProfileDivergence:
+            raise  # segmented != fused: a real engine bug, never swallowed
+        except opprofile.ProfileError as e:
+            # profiling-unsupported here (sharded mesh) — note it, keep
+            # the measurement
+            detail["profile"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     expected = (ticks // validate_every + (1 if ticks % validate_every else 0)
                 ) if scan else ticks
     # consolidation-regime dispatch decisions this query exercised (see
@@ -945,6 +978,8 @@ def _flag_operand(flag: str) -> str:
 def main() -> int:
     if "--slo" in sys.argv:  # env form so child processes inherit it
         os.environ["BENCH_SLO"] = "1"
+    if "--profile" in sys.argv:  # env form so child processes inherit it
+        os.environ["BENCH_PROFILE"] = "1"
     if "--workers-sweep" in sys.argv:
         ws = sorted({int(x)
                      for x in _flag_operand("--workers-sweep").split(",")

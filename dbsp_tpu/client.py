@@ -8,14 +8,32 @@ handles for data/control endpoints.
 from __future__ import annotations
 
 import json
+import os
 import urllib.request
 from typing import Dict, List, Optional
 
 
-def _req(url: str, data: Optional[bytes] = None, method: str = "GET"):
+def default_timeout_s() -> float:
+    """Per-request client timeout. Server-side work behind these routes is
+    compile-bound (an overflow grow retraces + recompiles the whole step
+    program inside one /step), and XLA:CPU compile latency scales with
+    host cores — the historical flat 30 s fit an 8-core dev box but times
+    out mid-recompile on a 2-core container. Budget for an 8-core-
+    equivalent 30 s, scaled up on smaller hosts and floored at 30 s;
+    ``DBSP_TPU_CLIENT_TIMEOUT_S`` overrides outright."""
+    env = os.environ.get("DBSP_TPU_CLIENT_TIMEOUT_S")
+    if env:
+        return float(env)
+    cores = os.cpu_count() or 1
+    return 30.0 * max(1.0, 8.0 / cores)
+
+
+def _req(url: str, data: Optional[bytes] = None, method: str = "GET",
+         timeout: Optional[float] = None):
     req = urllib.request.Request(url, data=data, method=method)
     try:
-        with urllib.request.urlopen(req, timeout=30) as r:
+        with urllib.request.urlopen(
+                req, timeout=timeout or default_timeout_s()) as r:
             body = r.read()
     except urllib.error.HTTPError as e:
         try:
@@ -39,7 +57,8 @@ class PipelineHandle:
         return _req(self.base + "/stats")
 
     def metrics(self) -> str:
-        with urllib.request.urlopen(self.base + "/metrics", timeout=30) as r:
+        with urllib.request.urlopen(self.base + "/metrics",
+                                    timeout=default_timeout_s()) as r:
             return r.read().decode()
 
     def trace(self) -> dict:
@@ -70,7 +89,31 @@ class PipelineHandle:
         q = "" if with_window else "?window=0"
         return _req(f"{self.base}/incidents{q}")
 
-    def profile(self) -> dict:
+    def profile(self, ticks: Optional[int] = None) -> dict:
+        """Operator-level attribution report — the shared schema both
+        engines emit (``opprofile.PROFILE_SCHEMA``; README §Observability
+        profile-mode matrix). ``ticks=None`` is free: continuous
+        measurement on a host pipeline, static per-node XLA cost analysis
+        on a compiled one. ``ticks=N`` arms the compiled MEASURED mode —
+        the server quiesces, runs N segmented ticks (per-node wall time +
+        rows, asserted bit-identical to the fused program), rewinds, and
+        reports; expect it to take N segmented ticks' worth of wall time
+        plus per-node compiles on the first call."""
+        q = f"?ticks={ticks}" if ticks is not None else ""
+        return _req(f"{self.base}/profile{q}")
+
+    def profile_dot(self, ticks: Optional[int] = None) -> str:
+        """Graphviz rendering of :meth:`profile` (the reference's
+        ``dump_profile`` .dot shape): nodes shaded by time share."""
+        q = f"&ticks={ticks}" if ticks is not None else ""
+        with urllib.request.urlopen(f"{self.base}/profile?format=dot{q}",
+                                    timeout=default_timeout_s()) as r:
+            return r.read().decode()
+
+    def dump_profile(self) -> dict:
+        """Legacy one-shot profiler dump (``/dump_profile``): per-operator
+        totals on host pipelines, node inventory + tick latency on
+        compiled ones. :meth:`profile` is the unified replacement."""
         return _req(self.base + "/dump_profile")
 
     def push(self, collection: str, rows: List[list], deletes: bool = False
@@ -103,7 +146,7 @@ class PipelineHandle:
     def _read_step(self, view: str) -> tuple[Dict[tuple, int], int]:
         with urllib.request.urlopen(
                 f"{self.base}/output_endpoint/{view}?format=json",
-                timeout=30) as r:
+                timeout=default_timeout_s()) as r:
             step = int(r.headers.get("X-Dbsp-Step", -1))
             out: Dict[tuple, int] = {}
             for line in r.read().decode().splitlines():
@@ -198,13 +241,21 @@ class Connection:
     def metrics(self) -> str:
         """Fleet-wide Prometheus exposition: every deployed pipeline's
         registry under a ``pipeline="<name>"`` label."""
-        with urllib.request.urlopen(self.base + "/metrics", timeout=30) as r:
+        with urllib.request.urlopen(self.base + "/metrics", timeout=default_timeout_s()) as r:
             return r.read().decode()
 
     def health(self) -> dict:
         """Fleet health: worst per-pipeline SLO state plus per-pipeline
         {health, status, mode, fallback_reason} detail."""
         return _req(self.base + "/health")
+
+    def profile_pipeline(self, name: str,
+                         ticks: Optional[int] = None) -> dict:
+        """Manager-side attribution report: GET
+        /pipelines/<name>/profile (same semantics as
+        :meth:`PipelineHandle.profile`)."""
+        q = f"?ticks={ticks}" if ticks is not None else ""
+        return _req(f"{self.base}/pipelines/{name}/profile{q}")
 
     def checkpoint_pipeline(self, name: str) -> dict:
         """Manager-side checkpoint trigger: POST

@@ -384,6 +384,22 @@ class CNode:
         self.op = op
         self.caps: Dict[str, int] = {}
 
+    def profile_meta(self) -> Dict[str, object]:
+        """Graph metadata the operator profiler (obs/opprofile.py) joins
+        onto this node's attribution row: enough to name the node in a
+        report without walking the circuit again."""
+        meta: Dict[str, object] = {
+            "caps": dict(self.caps),
+            "inputs": [int(i) for i in self.node.inputs],
+            "sharded": bool(getattr(self, "lead", ())),
+        }
+        if isinstance(self, _Leveled) and hasattr(self, "level_keys"):
+            meta["trace_levels"] = len(self.level_keys)
+            slot = getattr(self, "_slot_cap", None)
+            if slot:
+                meta["slot_cap"] = int(slot)
+        return meta
+
     def init_state(self):
         return None
 
@@ -1290,3 +1306,24 @@ class CUnshard(CNode):
 
         union = gather_local(inputs[0])
         return None, union.masked(lax.axis_index(WORKER_AXIS) == 0)
+
+
+# ---------------------------------------------------------------------------
+# pytree registration for the inter-node value types
+# ---------------------------------------------------------------------------
+# Inside the FUSED step program CView/CMaybe only ever live within one
+# trace, so they never needed to be pytrees. The segmented profiler
+# (obs/opprofile.py) compiles each node's eval as its OWN jit program, so
+# these values cross jit boundaries there — registering them makes that
+# legal without changing anything on the fused path (no tree_map in
+# compiler.py ever receives one: states, feeds, and outputs carry only
+# Batches and arrays).
+
+jax.tree_util.register_pytree_node(
+    CView,
+    lambda v: ((v.delta, v.pre, v.post), None),
+    lambda _, c: CView(*c))
+jax.tree_util.register_pytree_node(
+    CMaybe,
+    lambda v: ((v.valid, v.value), None),
+    lambda _, c: CMaybe(*c))

@@ -1286,6 +1286,31 @@ class CompiledHandle:
                 Runtime._swap(prev)
         return b.consolidate()
 
+    # -- operator attribution (EXPLAIN ANALYZE) -------------------------------
+    def profile_ticks(self, n: int = 8, t0: int = 0,
+                      feeds_list=None, spans=None,
+                      registry=None) -> dict:
+        """Measured per-node attribution: run ``n`` ticks with the step
+        split into per-node jit segments (wall time + rows per node),
+        assert the segmented run bit-identical to the fused program, and
+        REWIND — production state and counters are untouched (see
+        :mod:`dbsp_tpu.obs.opprofile` for the protocol and its caveats).
+        ``t0`` is the tick index to profile from (matters under a
+        ``gen_fn``: inputs are functions of the tick). Returns the shared
+        ``/profile`` report (``opprofile.PROFILE_SCHEMA``)."""
+        from dbsp_tpu.obs.opprofile import measured_profile
+
+        return measured_profile(self, n=n, t0=t0, feeds_list=feeds_list,
+                                spans=spans, registry=registry)
+
+    def profile_static(self, feeds: Optional[Dict] = None) -> dict:
+        """Compile-time attribution: per-node XLA cost analysis (flops /
+        analytic bytes — the ROOFLINE §1 accounting applied per node)
+        joined with graph metadata. No timing, no state mutation."""
+        from dbsp_tpu.obs.opprofile import static_profile
+
+        return static_profile(self, feeds=feeds)
+
     def output(self, handle_or_op) -> Optional[Batch]:
         """Latest output batch for an output handle (device; un-fetched).
 

@@ -192,6 +192,29 @@ class CompiledCircuitDriver:
         if self._retained:
             self._flush()
 
+    def profile_ticks(self, n: int = 8, spans=None, registry=None) -> dict:
+        """Measured operator attribution at the driver's current position:
+        flush the open deferred-validation interval (so the snapshot sits
+        at a validated tick boundary), then run the segmented protocol —
+        per-node timing, bit-identity assert, rewind — via
+        :meth:`CompiledHandle.profile_ticks`. The caller owns quiescence:
+        the ``/profile`` route invokes this under the controller's step
+        lock so no serving tick is in flight.
+
+        Workload: the open interval's retained feeds (captured BEFORE the
+        flush clears them) replay as the profiled ticks' inputs, so a
+        cadence > 1 pipeline profiles real recent deltas. At the default
+        serve cadence of 1 nothing is retained and the profile runs EMPTY
+        ticks — on a delta-proportional engine that attributes fixed
+        per-node overhead, not the serving workload, and the report says
+        so (``measured["idle_inputs"]``)."""
+        feeds_list = [dict(f) for _, f in self._retained] or None
+        self.flush()
+        return self.ch.profile_ticks(n, t0=self._tick,
+                                     feeds_list=feeds_list,
+                                     spans=spans if spans is not None
+                                     else self.spans, registry=registry)
+
     def restore_checkpoint(self, tick: int, retained) -> None:
         """Resume from a restored checkpoint (dbsp_tpu.checkpoint): the
         engine states were already applied to ``self.ch`` at the

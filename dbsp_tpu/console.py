@@ -11,8 +11,9 @@ collections, and peek output views — all over the existing REST surfaces
 its execution mode (``host`` rows carry the recorded compiled->host
 fallback reason as a tooltip), its SLO health (ok/degraded/unhealthy from
 the flight-recorder watchdog, obs/slo.py), and the latest incident's
-attributed cause; the Incidents/Flight buttons fetch the corresponding
-pipeline-server routes."""
+attributed cause; the Incidents/Flight/Profile buttons fetch the
+corresponding pipeline-server routes (Profile = the unified operator-
+attribution report, obs/opprofile.py)."""
 
 CONSOLE_HTML = r"""<!doctype html>
 <html>
@@ -84,6 +85,7 @@ CONSOLE_HTML = r"""<!doctype html>
     <button onclick="readIncidents()">Incidents</button>
     <button onclick="readFlight()">Flight</button>
     <button onclick="readFleetHealth()">Fleet health</button>
+    <button onclick="readProfile()">Profile</button>
     <pre id="io">-</pre>
   </section>
 </main>
@@ -227,6 +229,13 @@ async function readFlight() {
 }
 async function readFleetHealth() {
   show(await j('/health'));
+}
+// operator attribution (dbsp_tpu.obs.opprofile): the unified /profile
+// report — continuous per-operator timings on host pipelines, static
+// per-node XLA cost analysis on compiled ones (append ?ticks=N on the
+// pipeline port for the quiesced measured mode)
+async function readProfile() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/profile`));
 }
 const val = id => document.getElementById(id).value;
 const post = b => ({ method: 'POST', body: JSON.stringify(b) });

@@ -143,6 +143,36 @@ class CircuitServer:
                         self._json({"error": "profiler not enabled"}, 400)
                     else:
                         self._reply(200, server.profiler.dump_json().encode())
+                elif route == "/profile":
+                    # operator-level EXPLAIN ANALYZE — the shared report
+                    # schema both engines emit (obs/opprofile.py). ?ticks=N
+                    # arms the compiled MEASURED mode (segmented per-node
+                    # timing, bit-identity asserted, engine rewound);
+                    # ?format=dot renders graphviz like the reference's
+                    # dump_profile.
+                    if server.profiler is None:
+                        return self._json({"error": "profiler not enabled"},
+                                          400)
+                    from dbsp_tpu.obs.opprofile import (ProfileDivergence,
+                                                        report_dot)
+
+                    qs = parse_qs(url.query)
+                    ticks = int(qs["ticks"][0]) if "ticks" in qs else None
+                    try:
+                        report = server.profile_report(ticks=ticks)
+                    except ProfileDivergence as e:
+                        # segmented != fused is a real engine bug — a 500,
+                        # never silently degraded
+                        return self._json(
+                            {"error": f"ProfileDivergence: {e}"}, 500)
+                    except Exception as e:  # noqa: BLE001 — API error
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 400)
+                    if qs.get("format", ["json"])[0] == "dot":
+                        self._reply(200, report_dot(report).encode(),
+                                    "text/vnd.graphviz")
+                    else:
+                        self._json(report)
                 elif route.startswith("/output_endpoint/"):
                     name = route.rsplit("/", 1)[1]
                     try:
@@ -231,6 +261,21 @@ class CircuitServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def profile_report(self, ticks=None) -> dict:
+        """The unified ``/profile`` report, quiesced: holds the
+        controller's step lock (no serving tick in flight — the measured
+        mode snapshots, runs hypothetical ticks, and rewinds) and flushes
+        any open deferred-validation interval first. Spans land operator
+        slices in the existing ``/trace`` window; the registry receives
+        the gated per-node metric families only when a MEASURED profile
+        actually runs (opprofile.export_node_metrics)."""
+        with self.controller._step_lock:
+            self.controller._flush_driver_locked()
+            return self.profiler.profile_report(
+                ticks=ticks,
+                spans=self.obs.spans if self.obs is not None else None,
+                registry=self.obs.registry if self.obs is not None else None)
 
     def prometheus(self) -> str:
         """The /metrics payload: the obs registry's canonical exposition

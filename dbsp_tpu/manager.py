@@ -27,6 +27,7 @@ Routes:
   POST /programs/<name>/compile      enqueue {"version": N} (409 if stale)
   DELETE /programs/<name>            (409 while a pipeline references it)
   GET  /pipelines, /pipelines/<name>
+  GET  /pipelines/<name>/profile     operator attribution (?ticks=N measured)
   POST /pipelines                    deploy {"name", "program"}
   POST /pipelines/<name>/shutdown
   POST /pipelines/<name>/checkpoint  write one durable generation now
@@ -357,7 +358,28 @@ class PipelineManager:
                 return json.loads(self.rfile.read(n) or b"{}")
 
             def do_GET(self):
-                parts = self.path.rstrip("/").split("/")
+                from urllib.parse import parse_qs, urlparse
+
+                url = urlparse(self.path)
+                parts = url.path.rstrip("/").split("/")
+                if len(parts) == 4 and parts[1] == "pipelines" and \
+                        parts[3] == "profile":
+                    # operator attribution for one deployed pipeline —
+                    # proxied to its embedded server's quiesced report
+                    # (in-process: same CircuitServer.profile_report the
+                    # pipeline port serves at /profile)
+                    with mgr.lock:
+                        p = mgr.pipelines.get(parts[2])
+                    if p is None or p.server is None:
+                        return self._json({"error": "not found"}, 404)
+                    qs = parse_qs(url.query)
+                    ticks = int(qs["ticks"][0]) if "ticks" in qs else None
+                    try:
+                        return self._json(p.server.profile_report(
+                            ticks=ticks))
+                    except Exception as e:  # noqa: BLE001 — API error
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 500)
                 if self.path in ("/", ""):
                     from dbsp_tpu.console import CONSOLE_HTML
 

@@ -145,6 +145,15 @@ class Metric:
     def _default(self) -> _Child:
         return self._child(())
 
+    def clear_children(self) -> None:
+        """Drop every label-set child. For gauge families whose HELP
+        contract is "the LAST <event>" (e.g. the per-node profile
+        gauges): re-exporting without clearing would leave children from
+        the previous event serving stale values next to fresh ones.
+        Never call on counters — monotone families must not regress."""
+        with self._lock:
+            self._children.clear()
+
     def samples(self) -> List[Tuple[Tuple[str, ...], _Child]]:
         """(label values, child-SNAPSHOT) pairs in insertion order. Copies
         are taken under the metric lock so a scrape concurrent with

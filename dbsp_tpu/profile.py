@@ -22,8 +22,19 @@ the *incident capture* layer: the flight recorder keeps the recent tick
 stream with attributed causes always in memory (``/flight``) and the SLO
 watchdog freezes breach windows into self-contained ``/incidents``
 reports. Oracle (monitor.py), measurement (this file + instrument.py),
-and incident capture are separable concerns; all can attach to one
-circuit simultaneously and none depends on another.
+incident capture, and *attribution* are separable concerns; all can
+attach to one circuit simultaneously and none depends on another.
+
+Attribution on the COMPILED path: the fused XLA step program has no
+per-operator eval events for this profiler to time, so operator-level
+EXPLAIN ANALYZE lives in ``dbsp_tpu.obs.opprofile`` — static per-node XLA
+cost analysis plus an on-demand SEGMENTED measured mode
+(``CompiledHandle.profile_ticks(n)``) asserted bit-identical to the fused
+program. Both engines answer through one report schema
+(``opprofile.PROFILE_SCHEMA``): :meth:`CPUProfiler.profile_report` here
+and :meth:`CompiledProfiler.profile_report` below emit the same rows, and
+the ``/profile`` route serves whichever engine the pipeline runs (README
+§Observability profile-mode matrix).
 
 Durability note: checkpoint/restore activity (``dbsp_tpu.checkpoint``)
 shows up in the incident-capture layer, not here — ``checkpoint`` flight
@@ -86,6 +97,35 @@ class CPUProfiler:
     def dump_json(self) -> str:
         return json.dumps({"steps": self.steps, "operators": self.profile()})
 
+    def profile_report(self, ticks=None, spans=None, registry=None) -> dict:
+        """The shared ``/profile`` report (``opprofile.PROFILE_SCHEMA``):
+        the same rows as :meth:`profile` under the schema both engines
+        emit, so host and compiled pipelines answer one question the same
+        way. The host profiler measures continuously off the scheduler
+        events — ``ticks``/``spans``/``registry`` exist for signature
+        parity with :meth:`CompiledProfiler.profile_report` and are
+        ignored."""
+        from dbsp_tpu.obs.opprofile import PROFILE_SCHEMA
+
+        total_ns = sum(self.elapsed_ns.values()) or 1
+        rows = []
+        for gid, ns in sorted(self.elapsed_ns.items(), key=lambda kv: -kv[1]):
+            node = self._node(gid)
+            rows.append({
+                "node": ".".join(map(str, gid)),
+                "name": node.operator.name,
+                "kind": type(node.operator).__name__,
+                "total_ms": round(ns / 1e6, 3),
+                "evals": self.counts[gid],
+                "share": round(ns / total_ns, 4),
+                "meta": dict(node.operator.metadata(),
+                             inputs=[".".join(map(str, (*gid[:-1], i)))
+                                     for i in node.inputs]),
+            })
+        return {"schema": PROFILE_SCHEMA, "mode": "host",
+                "steps": self.steps, "attribution": "measured",
+                "operators": rows, "measured": None}
+
     def dump_dot(self) -> str:
         """Graphviz rendering: nodes annotated with time, edges = dataflow
         (reference: per-worker .dot profiles)."""
@@ -146,3 +186,40 @@ class CompiledProfiler:
                            "mode": "compiled",
                            "tick_latency": self._latency(),
                            "operators": self.profile()})
+
+    def profile_report(self, ticks=None, spans=None, registry=None) -> dict:
+        """The shared ``/profile`` report (``opprofile.PROFILE_SCHEMA``) for
+        the compiled engine — operator-level EXPLAIN ANALYZE over the fused
+        step program. ``ticks`` picks the attribution mode:
+
+        * ``ticks=N`` (or ``DBSP_TPU_PROFILE=segment`` armed) — MEASURED:
+          the driver flushes its open deferred-validation interval, then
+          runs N segmented ticks (per-node wall time + rows), asserts
+          bit-identity against the fused program, and rewinds
+          (``opprofile.measured_profile``). The caller must have quiesced
+          the circuit thread (the ``/profile`` route holds the controller
+          step lock).
+        * ``ticks=None`` unarmed — STATIC: per-node XLA cost analysis from
+          one side-effect-free probe tick (``opprofile.static_profile``).
+
+        Sharded circuits cannot be segmented; both modes degrade to the
+        graph-metadata report rather than failing the route, with the
+        refusal recorded under ``"degraded"``. A measured-mode
+        bit-identity failure is NOT degraded — that is a real engine
+        divergence and must surface."""
+        from dbsp_tpu.obs import opprofile
+
+        if ticks is None:
+            ticks = opprofile.env_default_ticks()
+        ch = self.driver.ch
+        try:
+            if ticks:
+                return self.driver.profile_ticks(int(ticks), spans=spans,
+                                                 registry=registry)
+            return opprofile.static_profile(ch)
+        except opprofile.ProfileDivergence:
+            raise
+        except opprofile.ProfileError as e:
+            report = opprofile.graph_profile(ch)
+            report["degraded"] = str(e)
+            return report

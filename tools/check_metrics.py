@@ -24,6 +24,16 @@ tests/test_obs.py::test_metrics_lint):
    dimension means growing the allowlist deliberately, with its value set
    in mind.
 
+4. **Per-node families register only through the opprofile gate.** The
+   ``dbsp_tpu_compiled_node_*`` families carry a ``node`` label whose
+   value set is one series PER CIRCUIT NODE — bounded only because
+   ``obs/opprofile.py::export_node_metrics`` top-N-caps it and registers
+   nothing until a measured profile actually runs. A registration of a
+   ``_node_`` family anywhere else would bypass both caps, so it is a
+   violation outside ``dbsp_tpu/obs/opprofile.py``. Waivable like the
+   hotpath rules: a ``# metrics: ok`` comment on the registration line
+   acknowledges a deliberately-bounded exception.
+
 Usage: ``python tools/check_metrics.py [root]`` — prints violations and
 exits 1 when any are found.
 """
@@ -53,6 +63,13 @@ _FORMAT_PATTERNS = (
 
 # a literal that IS a metric name (subject to the naming convention)
 _METRIC_LITERAL = re.compile(r"^dbsp_tpu_[a-z0-9_]+$")
+
+# rule 4: per-node metric families (one series per circuit node) — only
+# obs/opprofile.py::export_node_metrics may register these (it top-N caps
+# the label set and gates registration on a profile actually running)
+_NODE_FAMILY = re.compile(r"^dbsp_tpu_compiled_node_")
+_NODE_GATE = os.path.join("obs", "opprofile.py")
+_WAIVER = "# metrics: ok"
 
 _REGISTER_METHODS = {"counter": "counter", "gauge": "gauge",
                      "histogram": "histogram", "summary": "summary"}
@@ -99,6 +116,8 @@ def check_tree(pkg_root: str) -> list:
             violations.append(f"{rel}:{e.lineno}: unparsable: {e.msg}")
             continue
         in_obs = _is_obs(path, pkg_root)
+        src_lines = src.splitlines()
+        is_node_gate = os.path.relpath(path, pkg_root) == _NODE_GATE
         for node in ast.walk(tree):
             # (1) exposition formatting outside obs/
             if not in_obs and isinstance(node, ast.Constant) and \
@@ -133,6 +152,18 @@ def check_tree(pkg_root: str) -> list:
                                 "per-key/per-tick label values are "
                                 "forbidden; grow the allowlist only for "
                                 "enumerable dimensions")
+                    # (4) per-node families only via the opprofile gate
+                    if _NODE_FAMILY.match(name) and not is_node_gate:
+                        span = src_lines[node.lineno - 1:
+                                         (node.end_lineno or node.lineno)]
+                        if not any(_WAIVER in ln for ln in span):
+                            violations.append(
+                                f"{rel}:{node.lineno}: per-node family "
+                                f"{name!r} registered outside the "
+                                "obs/opprofile.py gate — node-labeled "
+                                "series must stay top-N capped and "
+                                "profile-gated (export_node_metrics); "
+                                f"waive deliberately with {_WAIVER!r}")
             # (2b) any metric-shaped literal: convention minus the kind rule
             elif isinstance(node, ast.Constant) and \
                     isinstance(node.value, str) and \

@@ -5,13 +5,20 @@ Three fronts (each independently runnable; this bundles them for CI and
 the tier-1 test in tests/test_analysis.py):
 
 1. ``tools/check_metrics.py``  — Prometheus formatting stays in obs/,
-   metric names follow the convention.
+   metric names follow the convention, label names stay on the closed
+   allowlist, per-node families only via the opprofile gate.
 2. ``tools/check_hotpath.py``  — no host round-trips in operator eval
    bodies / jitted functions; no load-bearing asserts in circuit/ and io/.
 2b. ``tools/check_state.py``   — every serving-state field is claimed by
    the checkpoint schema registry (restore can never silently drop state).
 2c. ``tools/build_native.py``  — cached native binaries carry the
    SHA-256 of their checked-out sources (a drifted ``.so`` is a red lint).
+2d. ``tools/gen_metrics_doc.py --check`` — the committed METRICS.md
+   matches the tree's metric registration sites (catalog drift is red).
+2e. **Dashboard lint** — deploy/grafana_dashboard.json parses, every
+   panel has targets, and every metric a target expr references exists
+   (registration sites for ``dbsp_tpu_*``, the obs/export.py legacy
+   exposition for ``dbsp_*``).
 3. **Analyzer self-check** — build every Nexmark query circuit plus a set
    of representative demo circuits and run the static analyzer
    (dbsp_tpu/analysis) over each at workers 1/4/8 WITH --strict-shard:
@@ -23,6 +30,11 @@ the tier-1 test in tests/test_analysis.py):
    ``bench.py --workers-sweep`` mini-protocol, in subprocesses. The
    import-based tier-1 consumers (tests/test_analysis.py) run the static
    fronts only; tests/test_multichip.py carries the runtime coverage.
+5. **Profiler dryrun** (CLI only; DBSP_TPU_LINT_PROFILE=0 skips) —
+   ``opprofile.dryrun("q4")`` in a subprocess: one measured segmented
+   profile end to end, red on schema drift, segmented/fused divergence,
+   or attribution below 90% — the operator profiler cannot silently rot.
+   The import-based tier-1 consumer is tests/test_opprofile.py.
 
 Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
 1 when any front fails.
@@ -62,6 +74,72 @@ def run_check_native() -> list:
     from tools.build_native import check_tree
 
     return check_tree(_ROOT)
+
+
+def run_gen_metrics_doc() -> list:
+    from tools.gen_metrics_doc import check_drift
+
+    return check_drift()
+
+
+def _legacy_metric_names() -> set:
+    """The ``dbsp_*`` (pre-obs) exposition names, derived from the one
+    code path that renders them — never a second hand-kept list."""
+    from dbsp_tpu.obs.export import legacy_controller_lines
+
+    stats = {"steps": 0,
+             "inputs": {"x": {"total_records": 0, "buffered_records": 0}},
+             "outputs": {"x": {"total_records": 0}}}
+    names = set()
+    for line in legacy_controller_lines(stats):
+        if line and not line.startswith("#"):
+            names.add(line.split("{")[0].split(" ")[0])
+    return names
+
+
+def run_check_dashboard() -> list:
+    """2e. Grafana dashboard lint: the committed dashboard JSON parses,
+    every panel carries at least one target expr, and every metric name
+    an expr references actually exists — ``dbsp_tpu_*`` against the
+    tree's registration sites (tools/gen_metrics_doc.py), legacy
+    ``dbsp_*`` against the obs/export.py legacy exposition. A renamed or
+    dropped metric family turns its dashboard panel red here instead of
+    silently flatlining in Grafana."""
+    import json
+    import re as _re
+
+    from tools.gen_metrics_doc import collect
+
+    path = os.path.join(_ROOT, "deploy", "grafana_dashboard.json")
+    rel = os.path.relpath(path, _ROOT)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{rel}: {type(e).__name__}: {e}"]
+    known = set(collect(PKG)) | _legacy_metric_names()
+    violations = []
+    panels = doc.get("panels") or []
+    if not panels:
+        violations.append(f"{rel}: no panels")
+    for panel in panels:
+        title = panel.get("title", "<untitled>")
+        targets = panel.get("targets") or []
+        if not targets:
+            violations.append(f"{rel}: panel {title!r} has no targets")
+        for t in targets:
+            expr = t.get("expr", "")
+            names = _re.findall(r"dbsp_[a-z0-9_]+", expr)
+            if not names:
+                violations.append(f"{rel}: panel {title!r} target "
+                                  f"references no dbsp metric: {expr!r}")
+            for n in names:
+                if n not in known:
+                    violations.append(
+                        f"{rel}: panel {title!r} references unknown "
+                        f"metric {n!r} (not a registration site under "
+                        "dbsp_tpu/ nor a legacy exposition name)")
+    return violations
 
 
 def _demo_circuits():
@@ -219,13 +297,41 @@ def run_multichip() -> list:
     return violations
 
 
+def run_profile_dryrun() -> list:
+    """5. **Profiler dryrun** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_PROFILE=0`` skips — tests/test_opprofile.py carries
+    the import-based tier-1 coverage): ``opprofile.dryrun("q4")`` runs
+    one measured segmented profile end to end and raises on schema
+    drift, segmented/fused divergence, or attribution below 90%."""
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_PROFILE", "1") == "0":
+        print("lint_all: profile_dryrun: skipped (DBSP_TPU_LINT_PROFILE=0)")
+        return []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "from dbsp_tpu.obs.opprofile import dryrun; dryrun('q4')"],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return ["opprofile.dryrun('q4') timed out after 900s"]
+    if p.returncode != 0:
+        return [f"opprofile.dryrun('q4') failed (profiler rotted?):\n"
+                f"{p.stdout[-800:]}\n{p.stderr[-800:]}"]
+    return []
+
+
 def main() -> int:
     fronts = [("check_metrics", run_check_metrics),
               ("check_hotpath", run_check_hotpath),
               ("check_state", run_check_state),
               ("check_native", run_check_native),
+              ("gen_metrics_doc", run_gen_metrics_doc),
+              ("check_dashboard", run_check_dashboard),
               ("analyzer_selfcheck", run_analyzer_selfcheck),
-              ("multichip", run_multichip)]
+              ("multichip", run_multichip),
+              ("profile_dryrun", run_profile_dryrun)]
     failed = 0
     for name, fn in fronts:
         violations = fn()
