@@ -11,6 +11,7 @@ import json
 import os
 import urllib.request
 from typing import Dict, List, Optional
+from urllib.parse import quote
 
 
 def default_timeout_s() -> float:
@@ -26,6 +27,14 @@ def default_timeout_s() -> float:
         return float(env)
     cores = os.cpu_count() or 1
     return 30.0 * max(1.0, 8.0 / cores)
+
+
+def _lineage_qs(view: str, key) -> str:
+    """?view=&key= query prefix for the /lineage routes, percent-encoded
+    — bare-string key columns ('a b', 'x&y') are part of parse_key's
+    contract and must survive URL interpolation."""
+    key = key if isinstance(key, str) else ",".join(map(str, key))
+    return f"?view={quote(view, safe='')}&key={quote(key, safe=',')}"
 
 
 def _req(url: str, data: Optional[bytes] = None, method: str = "GET",
@@ -109,6 +118,33 @@ class PipelineHandle:
         with urllib.request.urlopen(f"{self.base}/profile?format=dot{q}",
                                     timeout=default_timeout_s()) as r:
             return r.read().decode()
+
+    def why(self, view: str, key, n: Optional[int] = None) -> dict:
+        """Row-level lineage (EXPLAIN WHY, README §Observability): why is
+        the row whose key columns start with ``key`` in ``view``? Returns
+        the backward provenance DAG (``dbsp_tpu.lineage/v1``): per-hop
+        supporting rows with Z-set weights down to concrete input-table
+        rows (``report["inputs"]``). ``key`` is a tuple/list of column
+        literals (or a preformatted csv string); ``n`` caps rows per hop.
+        Read-only and quiesced server-side; resolving past untraced
+        sources needs the pipeline's lineage taps
+        (``DBSP_TPU_LINEAGE_TAP=1`` / config ``lineage_taps``)."""
+        q = _lineage_qs(view, key) + (f"&n={n}" if n is not None else "")
+        return _req(self.base + "/lineage" + q)
+
+    def why_dot(self, view: str, key) -> str:
+        """Graphviz rendering of :meth:`why`'s lineage DAG."""
+        with urllib.request.urlopen(
+                f"{self.base}/lineage{_lineage_qs(view, key)}&format=dot",
+                timeout=default_timeout_s()) as r:
+            return r.read().decode()
+
+    def debug_bundle(self) -> dict:
+        """The one-shot diagnostics bundle (``GET /debug``) — status +
+        stats + SLO health + incident summaries + flight summary + the
+        last profile/lineage reports + analysis findings, in one JSON:
+        the "attach this to the bug report" artifact."""
+        return _req(self.base + "/debug")
 
     def dump_profile(self) -> dict:
         """Legacy one-shot profiler dump (``/dump_profile``): per-operator
@@ -256,6 +292,13 @@ class Connection:
         :meth:`PipelineHandle.profile`)."""
         q = f"?ticks={ticks}" if ticks is not None else ""
         return _req(f"{self.base}/pipelines/{name}/profile{q}")
+
+    def lineage_pipeline(self, name: str, view: str, key,
+                         n: Optional[int] = None) -> dict:
+        """Manager-side lineage query: GET /pipelines/<name>/lineage
+        (same semantics as :meth:`PipelineHandle.why`)."""
+        q = _lineage_qs(view, key) + (f"&n={n}" if n is not None else "")
+        return _req(f"{self.base}/pipelines/{name}/lineage{q}")
 
     def checkpoint_pipeline(self, name: str) -> dict:
         """Manager-side checkpoint trigger: POST

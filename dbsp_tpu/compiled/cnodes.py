@@ -844,37 +844,20 @@ class CDistinct(CNode):
 
 def range_gather_levels(qp, qlo, qhi, qlive, levels: Sequence[Batch],
                         out_cap: int):
-    """Per-row [lo, hi] time-range gather over K trace levels into ONE
-    shared buffer (the same offset-scatter scheme as :func:`gather_levels`
-    — the range twin of the equality gather, shared by rolling aggregates;
-    kernel: timeseries/rolling.py::_range_gather_level_impl). Returns
-    ((qrow, t, vals, w), unclamped total)."""
-    from dbsp_tpu.timeseries.rolling import _range_gather_level_impl
+    """Per-row [lo, hi] time-range gather over K trace levels in ONE fused
+    cursor launch — the range twin of :func:`gather_levels` through the
+    SAME shared entry point (cursor.gather_ladder with distinct lo/hi
+    probe columns + the time key column gathered back; shared with
+    timeseries/rolling.py's host RangeGather). Returns
+    ((qrow, t, vals, w), unclamped total); dead slots carry qrow == q_cap
+    (the trash segment) + sentinel cols."""
+    from dbsp_tpu.zset import cursor
 
     assert levels
-    j = jnp.arange(out_cap, dtype=jnp.int32)
-    qbuf = jnp.full((out_cap,), jnp.int32(-1))
-    tbuf = vbufs = wbuf = None
-    offset = jnp.asarray(0, jnp.int32)
-    req = jnp.asarray(0, jnp.int64)
-    for lvl in levels:
-        qrow, t, vals, w, total = _range_gather_level_impl(
-            qp, qlo, qhi, qlive, lvl, out_cap)
-        req = req + total.astype(jnp.int64)
-        t32 = jnp.minimum(total, out_cap).astype(jnp.int32)
-        idx = jnp.where(j < t32, j + offset, out_cap)
-        if tbuf is None:
-            tbuf = kernels.sentinel_fill((out_cap,), t.dtype)
-            vbufs = tuple(kernels.sentinel_fill((out_cap,), c.dtype)
-                          for c in vals)
-            wbuf = jnp.zeros((out_cap,), w.dtype)
-        qbuf = qbuf.at[idx].set(qrow, mode="drop")
-        tbuf = tbuf.at[idx].set(t, mode="drop")
-        vbufs = tuple(b.at[idx].set(c, mode="drop")
-                      for b, c in zip(vbufs, vals))
-        wbuf = wbuf.at[idx].set(jnp.where(j < t32, w, 0), mode="drop")
-        offset = jnp.minimum(offset + t32, out_cap)
-    return (qbuf, tbuf, vbufs, wbuf), req
+    (qrow, cols, w), total = cursor.gather_ladder(
+        (qp, qlo), qlive, tuple(levels), out_cap, qhi_keys=(qp, qhi),
+        gather_keys=1)
+    return (qrow, cols[0], cols[1:], w), total.astype(jnp.int64)
 
 
 class CRangeJoin(CNode):

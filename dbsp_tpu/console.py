@@ -78,7 +78,9 @@ CONSOLE_HTML = r"""<!doctype html>
     <textarea id="irows">[[1, 3, 250], [2, 3, 100], [3, 7, 40]]</textarea>
     <button onclick="pushRows()">Push</button>
     <label>output view</label><input id="ocoll" value="totals"/>
+    <label>row key (csv, for Why)</label><input id="okey" value="3"/>
     <button onclick="readView()">Read</button>
+    <button onclick="readWhy()">Why</button>
     <button onclick="readStats()">Stats</button>
     <button onclick="readMetrics()">Metrics</button>
     <button onclick="readFleetMetrics()">Fleet metrics</button>
@@ -86,6 +88,7 @@ CONSOLE_HTML = r"""<!doctype html>
     <button onclick="readFlight()">Flight</button>
     <button onclick="readFleetHealth()">Fleet health</button>
     <button onclick="readProfile()">Profile</button>
+    <button onclick="readDebug()">Debug</button>
     <pre id="io">-</pre>
   </section>
 </main>
@@ -236,6 +239,17 @@ async function readFleetHealth() {
 // pipeline port for the quiesced measured mode)
 async function readProfile() {
   show(await j(`http://127.0.0.1:${val('ioport')}/profile`));
+}
+// row-level lineage (dbsp_tpu.obs.lineage): why is this row in my view?
+// — the backward provenance DAG down to concrete input-table rows
+async function readWhy() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/lineage?view=` +
+      `${encodeURIComponent(val('ocoll'))}&key=` +
+      `${encodeURIComponent(val('okey'))}`));
+}
+// the one-shot diagnostics bundle: attach this JSON to the bug report
+async function readDebug() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/debug`));
 }
 const val = id => document.getElementById(id).value;
 const post = b => ({ method: 'POST', body: JSON.stringify(b) });

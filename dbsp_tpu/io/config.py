@@ -93,7 +93,8 @@ def controller_config(cfg: dict) -> ControllerConfig:
     applied as the default is worse than an error)."""
     fields = {f.name for f in dataclasses.fields(ControllerConfig)}
     known_sections = {"inputs", "outputs", "name", "workers", "description",
-                      "slo"}  # slo: watchdog objectives (obs/slo.py)
+                      "slo",  # watchdog objectives (obs/slo.py)
+                      "lineage_taps"}  # raw-input provenance (obs/lineage.py)
     unknown = set(cfg) - fields - known_sections
     if unknown:
         raise ConfigError(
@@ -163,5 +164,15 @@ def build_controller(handle, catalog, cfg) -> Controller:
     """Controller + endpoints from one declarative config."""
     cfg = load_config(cfg)
     ctl = Controller(handle, catalog, controller_config(cfg))
+    # opt-in lineage taps honored HERE, not only on the manager deploy
+    # path — a key the allowlist accepts but nothing applies is exactly
+    # the silent failure controller_config's rejection exists to prevent
+    # (enable_taps is idempotent; the manager path also calls it)
+    circuit = getattr(handle, "circuit", None)
+    if circuit is not None:
+        from dbsp_tpu.obs import lineage
+
+        if lineage.taps_env_enabled(cfg):
+            lineage.enable_taps(circuit)
     attach_endpoints(ctl, cfg)
     return ctl

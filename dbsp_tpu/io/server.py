@@ -79,30 +79,7 @@ class CircuitServer:
                 route = url.path.rstrip("/")
                 c = server.controller
                 if route == "/status":
-                    # mode + SLO health ride along so one poll answers
-                    # "is this pipeline serving, on which path, within
-                    # its objectives" (the compiled->host fallback cliff
-                    # must be visible here, not only in a counter)
-                    out = {"state": c.state,
-                           "mode": getattr(c.handle, "mode", "host"),
-                           # durability: the tick recovery would resume
-                           # from (None = no checkpoint yet/configured)
-                           "last_checkpoint_tick": getattr(
-                               c, "last_checkpoint_tick", None),
-                           "checkpoints": getattr(c, "checkpoints", 0)}
-                    ck_err = getattr(c, "checkpoint_error", None)
-                    if ck_err:
-                        out["checkpoint_error"] = ck_err
-                    if server.obs is not None:
-                        server.obs.watch()
-                        out["slo"] = server.obs.slo.status_dict()
-                        # the watchdog's latched copy, NOT a ring scan: the
-                        # one-shot deploy-time event ages out of the ring
-                        # on a long-running pipeline
-                        fb = server.obs.slo.fallback_reason
-                        if fb is not None:
-                            out["fallback_reason"] = fb
-                    self._json(out)
+                    self._json(server.status_dict())
                 elif route == "/flight":
                     if server.obs is None:
                         self._json({"error": "flight recorder not "
@@ -173,6 +150,27 @@ class CircuitServer:
                                     "text/vnd.graphviz")
                     else:
                         self._json(report)
+                elif route == "/lineage":
+                    # row-level lineage (EXPLAIN WHY, obs/lineage.py):
+                    # backward provenance slice of one output row —
+                    # ?view=<output>&key=<csv> [&n=<rows/hop>]
+                    # [&format=dot]; read-only, quiesced under the
+                    # controller step lock.
+                    from dbsp_tpu.obs import lineage as _lineage
+
+                    code, payload, dot = _lineage.http_query(
+                        server.lineage_report, parse_qs(url.query))
+                    if dot:
+                        self._reply(code, payload.encode(),
+                                    "text/vnd.graphviz")
+                    else:
+                        self._json(payload, code)
+                elif route == "/debug":
+                    # the one-shot diagnostics bundle — "attach this to
+                    # the bug report": status + SLO + incidents + flight
+                    # summary + last profile/lineage + analysis findings,
+                    # composed purely from the existing surfaces
+                    self._json(server.debug_bundle())
                 elif route.startswith("/output_endpoint/"):
                     name = route.rsplit("/", 1)[1]
                     try:
@@ -262,6 +260,73 @@ class CircuitServer:
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    def status_dict(self) -> dict:
+        """The /status body: serving state + mode + SLO health in one
+        poll (the compiled->host fallback cliff must be visible here,
+        not only in a counter); /debug embeds the same dict."""
+        c = self.controller
+        out = {"state": c.state,
+               "mode": getattr(c.handle, "mode", "host"),
+               # durability: the tick recovery would resume from
+               # (None = no checkpoint yet/configured)
+               "last_checkpoint_tick": getattr(
+                   c, "last_checkpoint_tick", None),
+               "checkpoints": getattr(c, "checkpoints", 0)}
+        ck_err = getattr(c, "checkpoint_error", None)
+        if ck_err:
+            out["checkpoint_error"] = ck_err
+        if self.obs is not None:
+            self.obs.watch()
+            out["slo"] = self.obs.slo.status_dict()
+            # the watchdog's latched copy, NOT a ring scan: the one-shot
+            # deploy-time event ages out of the ring on a long-running
+            # pipeline
+            fb = self.obs.slo.fallback_reason
+            if fb is not None:
+                out["fallback_reason"] = fb
+        return out
+
+    def lineage_report(self, view: str, key, max_rows=None) -> dict:
+        """The ``/lineage`` backward provenance slice, quiesced: holds
+        the controller's step lock (no serving tick in flight — the
+        compiled provider decodes a snapshot of the live states) and
+        flushes any open deferred-validation interval first. Counts the
+        gated lineage metrics and records one flight event per query;
+        never mutates serving state."""
+        from dbsp_tpu.obs import lineage
+
+        kwargs = {} if max_rows is None else {"max_rows": max_rows}
+        with self.controller._step_lock:
+            self.controller._flush_driver_locked()
+            report = lineage.slice_pipeline(
+                self.controller.handle, self.controller.catalog, view, key,
+                **kwargs)
+        if self.obs is not None:
+            lineage.observe_query(self.obs.registry, self.obs.flight,
+                                  report)
+        self._last_lineage = report
+        return report
+
+    def debug_bundle(self) -> dict:
+        """One JSON for the bug report: status, stats, SLO health, the
+        captured incidents (summaries), a flight-ring summary, the last
+        profile/lineage reports served (None until one ran — composing
+        a measured profile here would quiesce the pipeline unasked), and
+        the static-analysis findings."""
+        c = self.controller
+        out = {"status": self.status_dict(),
+               "stats": c.stats(),
+               "analysis": [f.to_dict() for f in self.analysis_findings],
+               "profile": getattr(self, "_last_profile", None),
+               "lineage": getattr(self, "_last_lineage", None)}
+        if self.obs is not None:
+            # status_dict() already ran the watchdog and embedded the SLO
+            # dict — alias it rather than polling + serializing it twice
+            out["slo"] = out["status"].get("slo")
+            out["incidents"] = self.obs.slo.incidents(with_window=False)
+            out["flight"] = self.obs.flight.to_dict(limit=64)
+        return out
+
     def profile_report(self, ticks=None) -> dict:
         """The unified ``/profile`` report, quiesced: holds the
         controller's step lock (no serving tick in flight — the measured
@@ -272,10 +337,12 @@ class CircuitServer:
         actually runs (opprofile.export_node_metrics)."""
         with self.controller._step_lock:
             self.controller._flush_driver_locked()
-            return self.profiler.profile_report(
+            report = self.profiler.profile_report(
                 ticks=ticks,
                 spans=self.obs.spans if self.obs is not None else None,
                 registry=self.obs.registry if self.obs is not None else None)
+        self._last_profile = report  # /debug embeds the last served report
+        return report
 
     def prometheus(self) -> str:
         """The /metrics payload: the obs registry's canonical exposition

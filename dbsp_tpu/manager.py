@@ -28,6 +28,7 @@ Routes:
   DELETE /programs/<name>            (409 while a pipeline references it)
   GET  /pipelines, /pipelines/<name>
   GET  /pipelines/<name>/profile     operator attribution (?ticks=N measured)
+  GET  /pipelines/<name>/lineage     row lineage (?view=&key=, obs/lineage.py)
   POST /pipelines                    deploy {"name", "program"}
   POST /pipelines/<name>/shutdown
   POST /pipelines/<name>/checkpoint  write one durable generation now
@@ -139,6 +140,13 @@ class Pipeline:
 
         findings = verify_circuit(handle.circuit, workers=workers,
                                   registry=self.obs.registry)
+        # opt-in lineage taps (obs/lineage.py): retain raw input-table
+        # integrals so GET /lineage resolves output rows down to concrete
+        # input rows on tables no trace covers directly
+        from dbsp_tpu.obs import lineage as _lineage
+
+        if _lineage.taps_env_enabled(self.config):
+            _lineage.enable_taps(handle.circuit)
         catalog = Catalog()
         for tname, (h, dts) in handles.items():
             catalog.register_input(tname, h, tuple(dts))
@@ -362,6 +370,31 @@ class PipelineManager:
 
                 url = urlparse(self.path)
                 parts = url.path.rstrip("/").split("/")
+                if len(parts) == 4 and parts[1] == "pipelines" and \
+                        parts[3] == "lineage":
+                    # row-level lineage for one deployed pipeline —
+                    # proxied to its embedded server's quiesced slicer
+                    # through the SAME query handler the pipeline port
+                    # uses (obs/lineage.py http_query: view/key/n/dot
+                    # parsing cannot drift between the two surfaces)
+                    from dbsp_tpu.obs import lineage as _lineage
+
+                    with mgr.lock:
+                        p = mgr.pipelines.get(parts[2])
+                    if p is None or p.server is None:
+                        return self._json({"error": "not found"}, 404)
+                    code, payload, dot = _lineage.http_query(
+                        p.server.lineage_report, parse_qs(url.query))
+                    if dot:
+                        body = payload.encode()
+                        self.send_response(code)
+                        self.send_header("Content-Type",
+                                         "text/vnd.graphviz")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    return self._json(payload, code)
                 if len(parts) == 4 and parts[1] == "pipelines" and \
                         parts[3] == "profile":
                     # operator attribution for one deployed pipeline —

@@ -1,0 +1,1583 @@
+"""Row-level lineage ("EXPLAIN WHY"): backward provenance slicing.
+
+Answers the question every operator of an IVM system eventually asks:
+**"why is this row in my view?"** — which input rows, through which
+operators, with what Z-set weights, produced a given output row. Z-set
+weights are a provenance-polynomial evaluation under the counting
+semiring (Green, Karvounarakis & Tannen, *Provenance Semirings*,
+PODS'07), and DBSP's integrated traces already hold the state a backward
+query needs (Budiu et al., *DBSP*, VLDB'23) — so a lineage query walks
+the circuit graph backward from the output node and, at each operator,
+computes the *support* of the target rows against integrated state:
+
+* **join** — probes both sides' integrated traces on the probed key
+  prefix (honoring the operator's partial-key ``nk``), re-evaluates the
+  join function on the matched pairs, and keeps the pairs that produce a
+  target row;
+* **aggregate / distinct / topk / rolling** — enumerates the target
+  groups' member rows (with weights) from the input integral;
+* **filter / map / flat_map** — computes the preimage by re-evaluating
+  the operator's own columnar transform on candidate rows;
+* **linear ops** (plus/minus/neg/sum, delay-free integrate sugar,
+  shard/unshard/exchange, trace, window) — pass through.
+
+Cost scales with the *integrated state* along the lineage path — a join
+hop hash-joins the two sides' integrals host-side (O(|L| + |R| +
+matches), grouped on the probed prefix), map/flat_map hops re-evaluate
+over the input integral — never with the tick history: no replay, no
+bisection. The query runs under the controller's step lock, so on very
+large integrals one slice stalls serving for its duration; cap state
+with the usual window/GC machinery before relying on live lineage.
+
+Two engines, one slicer: the host path reads ``Spine`` state directly
+(:class:`HostState`); the compiled path decodes the leveled device
+states host-side through PR 3's incremental ``CompiledHandle.snapshot()``
+(:class:`CompiledState`) and runs the same slicer READ-ONLY — a lineage
+query never mutates serving state (tests assert bit-identity of
+subsequent outputs), and sharded circuits slice per worker key-slice
+with no ``unshard()`` (state readers union the worker axis host-side;
+P003-clean by construction).
+
+Interior integrals (streams between stateful operators) are
+RECONSTRUCTED forward from the nearest authoritative state — trace
+spines, aggregate output spines, linear-aggregate accumulators — by
+re-evaluating the pure operators host-side. Raw input-table integrals
+come from (a) a trace directly on the source, or (b) the opt-in
+**lineage tap** (:func:`enable_taps` / ``DBSP_TPU_LINEAGE_TAP=1`` /
+pipeline-config ``lineage_taps``): a host-side spine each
+``ZSetInput`` folds its drained deltas into (both engines drain inputs
+through the same host handle, so one tap serves both; host checkpoints
+persist it via ``state_dict``). Without either, the slice stops at the
+deepest reconstructible frontier and flags the hop ``unresolved``.
+
+Correctness oracle: :func:`provenance_oracle` is an INDEPENDENT
+provenance-semiring full recompute on the host — every input row tagged
+with a set-of-row-ids aux (capped at ``prov_cap`` with an explicit
+``truncated`` flag), evaluated forward through the circuit — and
+:func:`check_against_oracle` asserts the backward slice's input leaves
+equal the oracle's provenance sets (tier-1 on q1-q8, both engines;
+``tools/lint_all.py``'s ``lineage_dryrun`` front keeps it red on
+divergence).
+
+Surfaces: server ``GET /lineage?view=&key=`` (+ ``?format=dot``),
+manager ``GET /pipelines/<name>/lineage``, client
+``PipelineHandle.why(view, key)``, a console "Why" button, the gated
+metric families ``dbsp_tpu_lineage_queries_total`` /
+``dbsp_tpu_lineage_seconds`` (registered ONLY here —
+``tools/check_metrics.py`` rule 5), a ``lineage`` flight event per
+query, and the ``python -m dbsp_tpu.obs.lineage`` CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+LINEAGE_SCHEMA = "dbsp_tpu.lineage/v1"
+
+# per-hop row cap in the served report (full counts always reported;
+# tests pass max_rows=None for uncapped oracle comparison)
+DEFAULT_MAX_ROWS = 64
+
+# provenance-set cap per output row in the oracle recompute — beyond it
+# the set carries an explicit truncated flag and agreement checks become
+# subset checks
+ORACLE_PROV_CAP = int(os.environ.get("DBSP_TPU_LINEAGE_PROV_CAP", "65536"))
+
+ZDict = Dict[tuple, int]
+
+
+class LineageError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# lineage taps (raw input-table integrals)
+# ---------------------------------------------------------------------------
+
+
+def enable_taps(circuit) -> int:
+    """Attach a lineage tap (host spine of everything drained) to every
+    ``ZSetInput`` source of ``circuit`` that doesn't have one. Returns the
+    number of taps attached. Opt-in: the tap integrates the input stream
+    host-side (state grows with the netted input, like any un-GC'd
+    trace), which is exactly the table a lineage query resolves to."""
+    from dbsp_tpu.operators.io_handles import ZSetInput
+    from dbsp_tpu.trace.spine import Spine
+
+    n = 0
+    for node in circuit.nodes:
+        op = node.operator
+        if isinstance(op, ZSetInput) and \
+                getattr(op, "lineage_tap", None) is None:
+            op.lineage_tap = Spine(op.key_dtypes, op.val_dtypes)
+            n += 1
+    return n
+
+
+def taps_env_enabled(config: Optional[dict] = None) -> bool:
+    """Deploy-time tap policy: ``DBSP_TPU_LINEAGE_TAP=1`` or the pipeline
+    config key ``lineage_taps``."""
+    if os.environ.get("DBSP_TPU_LINEAGE_TAP", "0") != "0":
+        return True
+    return bool((config or {}).get("lineage_taps"))
+
+
+# ---------------------------------------------------------------------------
+# engine state providers
+# ---------------------------------------------------------------------------
+
+
+def _zadd(into: ZDict, frm: ZDict) -> ZDict:
+    for r, w in frm.items():
+        nw = into.get(r, 0) + w
+        if nw:
+            into[r] = nw
+        else:
+            into.pop(r, None)
+    return into
+
+
+def _finalize_linear(agg, acc_z: ZDict, nk: int, out_dtypes) -> ZDict:
+    """Output integral of a linear aggregate from its accumulator rows
+    (key -> (acc..., count) with Z-set weights): net the accumulators per
+    key (linearity), then run the aggregator's own ``finalize`` so the
+    reconstruction is bit-equal to the engine's."""
+    import jax.numpy as jnp
+
+    per_key: Dict[tuple, List[int]] = {}
+    for row, w in acc_z.items():
+        k, vals = row[:nk], row[nk:]
+        acc = per_key.setdefault(k, [0] * len(vals))
+        for i, v in enumerate(vals):
+            acc[i] += int(v) * w
+    out: ZDict = {}
+    for k, acc in per_key.items():
+        cnt = acc[-1]
+        if cnt <= 0:
+            continue
+        fin = agg.finalize(
+            tuple(jnp.asarray([a], jnp.int64) for a in acc[:-1]),
+            jnp.asarray([cnt], jnp.int64))
+        row = k + tuple(int(np.asarray(c.astype(d))[0])
+                        for c, d in zip(fin, out_dtypes))
+        out[row] = out.get(row, 0) + 1
+    return out
+
+
+class HostState:
+    """Read-only integral access for the host engine: Spines directly."""
+
+    engine = "host"
+
+    def __init__(self, circuit):
+        self.circuit = circuit
+
+    def _op(self, idx):
+        return self.circuit.nodes[idx].operator
+
+    def trace_integral(self, idx: int) -> Optional[ZDict]:
+        from dbsp_tpu.operators.trace_op import TraceOp
+
+        op = self._op(idx)
+        if isinstance(op, TraceOp):
+            return op.spine.to_dict()
+        return None
+
+    def out_integral(self, idx: int) -> Optional[ZDict]:
+        from dbsp_tpu.operators.aggregate import AggregateOp
+        from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+        from dbsp_tpu.operators.topk import TopKOp
+        from dbsp_tpu.operators.upsert import UpsertInput
+        from dbsp_tpu.timeseries.rolling import RollingAggregateOp
+
+        op = self._op(idx)
+        if isinstance(op, (AggregateOp, TopKOp, RollingAggregateOp)):
+            return op.out_spine.to_dict()
+        if isinstance(op, LinearAggregateOp):
+            return _finalize_linear(op.agg, op.acc_spine.to_dict(),
+                                    len(op.key_dtypes), op.agg.out_dtypes)
+        if isinstance(op, UpsertInput):
+            return op.spine.to_dict()
+        return None
+
+    def source_integral(self, idx: int) -> Optional[ZDict]:
+        from dbsp_tpu.operators.trace_op import TraceOp
+        from dbsp_tpu.operators.upsert import UpsertInput
+
+        op = self._op(idx)
+        if isinstance(op, UpsertInput):
+            return op.spine.to_dict()
+        tap = getattr(op, "lineage_tap", None)
+        tap_z = tap.to_dict() if tap is not None else None
+        if tap_z:
+            return tap_z
+        # tap absent — or EMPTY, which may mean "freshly re-enabled after
+        # a restore that didn't carry it", not "no input yet": a trace
+        # DIRECTLY on the source holds the authoritative integral (e.g.
+        # q4's bids feed a join that traces them raw), so never trust an
+        # empty tap over it
+        for node in self.circuit.nodes:
+            if isinstance(node.operator, TraceOp) and node.inputs == [idx]:
+                return node.operator.spine.to_dict()
+        return tap_z
+
+    def window_bounds(self, idx: int):
+        return self._op(idx).prev  # WindowOp: last applied (a1, b1) or None
+
+    def watermark_value(self, idx: int):
+        return self._op(idx)._wm  # WatermarkMonotonic: int or None
+
+
+class CompiledState:
+    """Read-only integral access for the compiled engine: PR 3's
+    incremental ``snapshot()`` decodes the leveled device states
+    host-side. The snapshot is a deep copy — subsequent serving steps
+    donate the live states, never these buffers — so the slicer is
+    read-only by construction. Sharded states carry a leading worker
+    axis; ``Batch.to_dict`` unions the worker slices host-side (per
+    worker key-slice, no unshard node, P003-clean)."""
+
+    engine = "compiled"
+
+    def __init__(self, target):
+        from dbsp_tpu.compiled.compiler import CompiledHandle
+        from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+
+        if isinstance(target, CompiledCircuitDriver):
+            self.ch = target.ch
+        elif isinstance(target, CompiledHandle):
+            self.ch = target
+        else:
+            raise LineageError(
+                f"not a compiled engine target: {type(target).__name__}")
+        self.circuit = self.ch.circuit
+        self.snap = self.ch.snapshot()
+
+    def _state(self, idx: int):
+        return self.snap.get(str(idx))
+
+    def _cn(self, idx: int):
+        return self.ch.by_index.get(idx)
+
+    def trace_integral(self, idx: int) -> Optional[ZDict]:
+        from dbsp_tpu.compiled import cnodes
+
+        cn = self._cn(idx)
+        st = self._state(idx)
+        if not isinstance(cn, cnodes.CTrace) or st is None:
+            return None
+        levels, _base = st
+        out: ZDict = {}
+        for lvl in levels:
+            _zadd(out, lvl.to_dict())
+        return out
+
+    def out_integral(self, idx: int) -> Optional[ZDict]:
+        from dbsp_tpu.compiled import cnodes
+
+        cn = self._cn(idx)
+        st = self._state(idx)
+        if st is None:
+            return None
+        if isinstance(cn, cnodes.CAggregate):
+            return st[0].to_dict()
+        if isinstance(cn, cnodes.CLinearAggregate):
+            op = cn.op
+            return _finalize_linear(op.agg, st.to_dict(),
+                                    len(op.key_dtypes), op.agg.out_dtypes)
+        if isinstance(cn, (cnodes.CTopK, cnodes.CRolling, cnodes.CUpsertIn)):
+            return st.to_dict()
+        return None
+
+    def source_integral(self, idx: int) -> Optional[ZDict]:
+        from dbsp_tpu.compiled import cnodes
+
+        cn = self._cn(idx)
+        if isinstance(cn, cnodes.CUpsertIn):
+            return self.out_integral(idx)
+        op = self.circuit.nodes[idx].operator
+        tap = getattr(op, "lineage_tap", None)
+        tap_z = tap.to_dict() if tap is not None else None
+        if tap_z:
+            return tap_z
+        # see HostState.source_integral: compiled checkpoints persist
+        # cnode engine states, never the host-side tap — after a restore
+        # the re-enabled tap is EMPTY while the restored trace ladder is
+        # authoritative, so the direct trace wins over an empty tap
+        for node in self.circuit.nodes:
+            if node.inputs == [idx] and \
+                    isinstance(self._cn(node.index), cnodes.CTrace):
+                return self.trace_integral(node.index)
+        return tap_z
+
+    @staticmethod
+    def _scalar(x) -> int:
+        return int(np.asarray(x).reshape(-1)[0])
+
+    def window_bounds(self, idx: int):
+        st = self._state(idx)
+        if st is None:
+            return None
+        a0, b0, had = st
+        if not bool(np.asarray(had).reshape(-1)[0]):
+            return None
+        return (self._scalar(a0), self._scalar(b0))
+
+    def watermark_value(self, idx: int):
+        st = self._state(idx)
+        if st is None:
+            return None
+        wm, valid = st
+        if not bool(np.asarray(valid).reshape(-1)[0]):
+            return None
+        return self._scalar(wm)
+
+
+def state_for(handle_or_driver):
+    """The matching state provider for a stepping handle/driver."""
+    from dbsp_tpu.compiled.compiler import CompiledHandle
+    from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+
+    if isinstance(handle_or_driver, (CompiledCircuitDriver, CompiledHandle)):
+        return CompiledState(handle_or_driver)
+    return HostState(handle_or_driver.circuit)
+
+
+# ---------------------------------------------------------------------------
+# forward evaluation (reconstruction + provenance oracle)
+# ---------------------------------------------------------------------------
+
+
+class _Scalar:
+    """A non-batch (control) stream value: watermark / window bounds."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+class _Unsupported(LineageError):
+    pass
+
+
+def _cols_of(z: ZDict, dtypes):
+    """Column arrays (jnp, schema dtypes) + weights + the row list of a
+    host z-set — the bridge back into the operators' own columnar
+    transforms so forward reconstruction reuses the engine's exact fns."""
+    import jax.numpy as jnp
+
+    rows = list(z.keys())
+    ws = np.asarray([z[r] for r in rows], np.int64)
+    cols = tuple(
+        jnp.asarray(np.asarray([r[i] for r in rows]), d)
+        if rows else jnp.zeros((0,), d)
+        for i, d in enumerate(dtypes))
+    return rows, cols, ws
+
+
+def _pyval(x):
+    x = np.asarray(x)
+    if x.dtype.kind in "iub":
+        return int(x)
+    return float(x)
+
+
+def _rows_from_cols(cols, n: int) -> List[tuple]:
+    mats = [np.asarray(c) for c in cols]
+    return [tuple(_pyval(m[i]) for m in mats) for i in range(n)]
+
+
+class _Prov:
+    """Per-row provenance accumulator: id set + truncated flag."""
+
+    __slots__ = ("ids", "truncated")
+
+    def __init__(self, ids=(), truncated=False):
+        self.ids = frozenset(ids)
+        self.truncated = truncated
+
+    def union(self, other: "_Prov", cap: int) -> "_Prov":
+        ids = self.ids | other.ids
+        tr = self.truncated or other.truncated
+        if len(ids) > cap:
+            ids = frozenset(sorted(ids)[:cap])
+            tr = True
+        p = _Prov()
+        p.ids, p.truncated = ids, tr
+        return p
+
+
+def _punion(pm: Dict[tuple, _Prov], row: tuple, prov: _Prov, cap: int):
+    cur = pm.get(row)
+    pm[row] = prov if cur is None else cur.union(prov, cap)
+
+
+class Evaluator:
+    """Forward integral evaluation over the host circuit graph.
+
+    Two modes sharing one set of per-operator forward rules:
+
+    * **reconstruct** (``prov=False``, ``state`` given): integrals for
+      the backward slicer. Stateful nodes short-circuit to authoritative
+      engine state (trace spines, output spines, accumulators); only the
+      pure interior ops re-evaluate.
+    * **oracle** (``prov=True``, ``sources`` given): the provenance-
+      semiring full recompute — everything evaluates forward from the
+      input history, each row carrying the set of (source node, row) ids
+      that produced it (capped at ``prov_cap`` + truncated flag).
+    """
+
+    def __init__(self, circuit, state=None, sources: Optional[Dict] = None,
+                 prov: bool = False, prov_cap: int = ORACLE_PROV_CAP):
+        self.circuit = circuit
+        self.state = state
+        self.sources = sources or {}
+        self.prov = prov
+        self.prov_cap = prov_cap
+        self._memo: Dict[int, Any] = {}
+
+    # -- public -------------------------------------------------------------
+    def integral(self, idx: int):
+        """The node's integrated value: a ZDict (batch streams) or a
+        ``_Scalar`` (watermark/bounds). Raises :class:`_Unsupported` for
+        operators with no forward rule; ``None`` when the value is
+        unknowable (an untapped, untraced source)."""
+        if idx in self._memo:
+            v = self._memo[idx]
+            if isinstance(v, _Unsupported):
+                raise v
+            return v
+        try:
+            v = self._eval(idx)
+        except _Unsupported as e:
+            self._memo[idx] = e
+            raise
+        self._memo[idx] = v
+        return v
+
+    def prov_of(self, idx: int) -> Dict[tuple, _Prov]:
+        assert self.prov
+        self.integral(idx)
+        return self._provs.setdefault(idx, {})
+
+    # -- internals ----------------------------------------------------------
+    @property
+    def _provs(self) -> Dict[int, Dict[tuple, _Prov]]:
+        if not hasattr(self, "_provs_"):
+            self._provs_: Dict[int, Dict[tuple, _Prov]] = {}
+        return self._provs_
+
+    def _in_schema(self, idx: int):
+        schema = self.circuit.nodes[idx].schema
+        if schema is None:
+            raise _Unsupported(f"node {idx} has no schema metadata")
+        return (*schema[0], *schema[1])
+
+    def _eval(self, idx: int):
+        from dbsp_tpu.operators.aggregate import AggregateOp
+        from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+        from dbsp_tpu.operators.basic import (Apply, Minus, Neg, Plus,
+                                              SumN)
+        from dbsp_tpu.operators.distinct import DistinctOp
+        from dbsp_tpu.operators.filter_map import FilterOp, FlatMapOp, MapOp
+        from dbsp_tpu.operators.io_handles import (OutputOperator,
+                                                   ZSetInput)
+        from dbsp_tpu.operators.join import JoinOp
+        from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+        from dbsp_tpu.operators.topk import TopKOp
+        from dbsp_tpu.operators.trace_op import TraceOp
+        from dbsp_tpu.operators.upsert import UpsertInput
+        from dbsp_tpu.operators.z1 import Z1, _PlusNamed
+        from dbsp_tpu.timeseries.rolling import RollingAggregateOp
+        from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
+        from dbsp_tpu.timeseries.window import WindowOp
+
+        node = self.circuit.nodes[idx]
+        op = node.operator
+        ins = node.inputs
+
+        if isinstance(op, (ZSetInput, UpsertInput)):
+            return self._eval_source(idx, op)
+        if isinstance(op, TraceOp):
+            if not self.prov and self.state is not None:
+                z = self.state.trace_integral(idx)
+                if z is not None:
+                    return z
+            return self._pass(idx, ins[0])
+        if isinstance(op, OutputOperator):
+            return self._pass(idx, ins[0])
+        if isinstance(op, (ExchangeOp, UnshardOp)):
+            return self._pass(idx, ins[0])
+        if isinstance(op, _PlusNamed):
+            # integrate sugar (acc = s + z1(acc)): the *z-set* integral of
+            # the accumulator stream IS the integral of the delta input —
+            # follow the non-feedback edge
+            src = self._nonstrict_input(node)
+            return self._pass(idx, src)
+        if isinstance(op, Z1):
+            raise _Unsupported("z^-1 (delay) has no integral-level "
+                               "lineage rule")
+        if isinstance(op, WatermarkMonotonic):
+            return self._eval_watermark(idx, op, ins[0])
+        if isinstance(op, Apply):
+            v = self.integral(ins[0])
+            if isinstance(v, _Scalar):
+                return _Scalar(op.fn(v.value))
+            raise _Unsupported(f"apply({op.name}) over batch streams")
+        if isinstance(op, WindowOp):
+            return self._eval_window(idx, op, ins)
+        if isinstance(op, FilterOp):
+            return self._eval_filter(idx, op, ins[0])
+        if isinstance(op, MapOp):
+            return self._eval_map(idx, op, ins[0])
+        if isinstance(op, FlatMapOp):
+            return self._eval_flat_map(idx, op, ins[0])
+        if isinstance(op, JoinOp):
+            return self._eval_join(idx, op, ins)
+        if isinstance(op, (Plus, Minus, SumN, Neg)):
+            return self._eval_linear(idx, op, ins)
+        if isinstance(op, DistinctOp):
+            return self._eval_distinct(idx, ins[0])
+        if isinstance(op, AggregateOp):
+            if not self.prov and self.state is not None:
+                z = self.state.out_integral(idx)
+                if z is not None:
+                    return z
+            return self._eval_aggregate(idx, op.agg, len(op.key_dtypes),
+                                        op.agg.out_dtypes, ins[0])
+        if isinstance(op, LinearAggregateOp):
+            if not self.prov and self.state is not None:
+                z = self.state.out_integral(idx)
+                if z is not None:
+                    return z
+            return self._eval_linear_aggregate(idx, op, ins[0])
+        if isinstance(op, TopKOp):
+            if not self.prov and self.state is not None:
+                z = self.state.out_integral(idx)
+                if z is not None:
+                    return z
+            return self._eval_topk(idx, op, ins[0])
+        if isinstance(op, RollingAggregateOp):
+            if not self.prov and self.state is not None:
+                z = self.state.out_integral(idx)
+                if z is not None:
+                    return z
+            return self._eval_rolling(idx, op, ins[0])
+        raise _Unsupported(f"operator {op.name!r} "
+                           f"({type(op).__name__}) has no lineage rule")
+
+    def _nonstrict_input(self, node) -> int:
+        for i in node.inputs:
+            if self.circuit.nodes[i].kind != "strict_output":
+                return i
+        raise _Unsupported("feedback-only operator")
+
+    # -- per-op forward rules ------------------------------------------------
+    def _eval_source(self, idx, op):
+        from dbsp_tpu.operators.upsert import UpsertInput
+
+        z = self.sources.get(idx)
+        if z is None and self.state is not None:
+            z = self.state.source_integral(idx)
+        if z is None and isinstance(op, UpsertInput):
+            z = op.spine.to_dict()
+        if z is None:
+            return None
+        if self.prov:
+            pm = self._provs.setdefault(idx, {})
+            for r in z:
+                pm[r] = _Prov([(idx, r)])
+        return dict(z)
+
+    def _pass(self, idx, src):
+        v = self.integral(src)
+        if self.prov and isinstance(v, dict):
+            self._provs[idx] = dict(self._provs.setdefault(src, {}))
+        return None if v is None else (dict(v) if isinstance(v, dict) else v)
+
+    def _eval_watermark(self, idx, op, src):
+        if not self.prov and self.state is not None:
+            return _Scalar(self.state.watermark_value(idx))
+        z = self.integral(src)
+        if z is None:
+            raise _Unsupported("watermark over unknown input integral")
+        if not z:
+            return _Scalar(None)
+        sch = self._in_schema(src)
+        rows, cols, ws = _cols_of(z, sch)
+        nk = len(self.circuit.nodes[src].schema[0])
+        ts = np.asarray(op.ts_fn(cols[:nk], cols[nk:]))
+        live = ws != 0
+        if not live.any():
+            return _Scalar(None)
+        return _Scalar(int(ts[live].max()) - op.lateness)
+
+    def _eval_window(self, idx, op, ins):
+        trace_idx, bounds_idx = ins
+        z = self.integral(trace_idx)
+        if z is None:
+            return None
+        if not self.prov and self.state is not None:
+            bounds = self.state.window_bounds(idx)
+        else:
+            bv = self.integral(bounds_idx)
+            bounds = bv.value if isinstance(bv, _Scalar) else None
+        if bounds is None:
+            out: ZDict = {}
+        else:
+            a, b = bounds
+            out = {r: w for r, w in z.items() if a <= r[0] < b}
+        if self.prov:
+            src_pm = self._provs.setdefault(trace_idx, {})
+            self._provs[idx] = {r: src_pm[r] for r in out if r in src_pm}
+        return out
+
+    def _eval_filter(self, idx, op, src):
+        z = self.integral(src)
+        if z is None:
+            return None
+        sch = self._in_schema(src)
+        nk = len(self.circuit.nodes[src].schema[0])
+        rows, cols, ws = _cols_of(z, sch)
+        keep = np.asarray(op.pred(cols[:nk], cols[nk:]))
+        out = {r: z[r] for r, k in zip(rows, keep) if k}
+        if self.prov:
+            src_pm = self._provs.setdefault(src, {})
+            self._provs[idx] = {r: src_pm[r] for r in out if r in src_pm}
+        return out
+
+    def _map_images(self, op, src) -> Optional[List[Tuple[tuple, tuple]]]:
+        """(input row, image row) pairs of a MapOp over the input
+        integral — shared by forward evaluation and the backward
+        preimage."""
+        z = self.integral(src)
+        if z is None:
+            return None
+        sch = self._in_schema(src)
+        nk = len(self.circuit.nodes[src].schema[0])
+        rows, cols, _ws = _cols_of(z, sch)
+        if not rows:
+            return []
+        nkc, nvc = op.fn(cols[:nk], cols[nk:])
+        nkc, nvc = tuple(nkc), tuple(nvc)
+        if op.out_schema is not None:
+            kd, vd = op.out_schema
+            nkc = tuple(c.astype(d) for c, d in zip(nkc, kd))
+            nvc = tuple(c.astype(d) for c, d in zip(nvc, vd))
+        images = _rows_from_cols((*nkc, *nvc), len(rows))
+        return list(zip(rows, images))
+
+    def _eval_map(self, idx, op, src):
+        pairs = self._map_images(op, src)
+        if pairs is None:
+            return None
+        z = self.integral(src)
+        out: ZDict = {}
+        pm: Dict[tuple, _Prov] = {}
+        src_pm = self._provs.setdefault(src, {}) if self.prov else None
+        for r, img in pairs:
+            w = z[r]
+            nw = out.get(img, 0) + w
+            if nw:
+                out[img] = nw
+            else:
+                out.pop(img, None)
+            if src_pm is not None and r in src_pm:
+                _punion(pm, img, src_pm[r], self.prov_cap)
+        if self.prov:
+            self._provs[idx] = {r: p for r, p in pm.items() if r in out}
+        return out
+
+    def _flat_map_images(self, op, src):
+        """(input row, [image rows]) of a FlatMapOp over the integral."""
+        z = self.integral(src)
+        if z is None:
+            return None
+        sch = self._in_schema(src)
+        nk = len(self.circuit.nodes[src].schema[0])
+        rows, cols, _ws = _cols_of(z, sch)
+        if not rows:
+            return []
+        nkc, nvc, keep = op.fn(cols[:nk], cols[nk:])
+        nkc, nvc = tuple(nkc), tuple(nvc)
+        if op.out_schema is not None:
+            kd, vd = op.out_schema
+            nkc = tuple(c.astype(d) for c, d in zip(nkc, kd))
+            nvc = tuple(c.astype(d) for c, d in zip(nvc, vd))
+        keep = np.asarray(keep)
+        mats = [np.asarray(c) for c in (*nkc, *nvc)]
+        out = []
+        for i, r in enumerate(rows):
+            imgs = [tuple(_pyval(m[f, i]) for m in mats)
+                    for f in range(op.fanout) if keep[f, i]]
+            out.append((r, imgs))
+        return out
+
+    def _eval_flat_map(self, idx, op, src):
+        pairs = self._flat_map_images(op, src)
+        if pairs is None:
+            return None
+        z = self.integral(src)
+        out: ZDict = {}
+        pm: Dict[tuple, _Prov] = {}
+        src_pm = self._provs.setdefault(src, {}) if self.prov else None
+        for r, imgs in pairs:
+            w = z[r]
+            for img in imgs:
+                nw = out.get(img, 0) + w
+                if nw:
+                    out[img] = nw
+                else:
+                    out.pop(img, None)
+                if src_pm is not None and r in src_pm:
+                    _punion(pm, img, src_pm[r], self.prov_cap)
+        if self.prov:
+            self._provs[idx] = {r: p for r, p in pm.items() if r in out}
+        return out
+
+    def _join_pairs(self, op, lidx, ridx):
+        """Matched (l_row, r_row, out_row, w) quadruples of the full join
+        of the two integrated sides: probe on the operator's nk-column
+        key prefix (partial-key joins probe exactly the prefix the engine
+        probes), evaluate the join fn vectorized over the matched pairs."""
+        IL, IR = self.integral(lidx), self.integral(ridx)
+        if IL is None or IR is None:
+            return None
+        nk = op.nk
+        groups: Dict[tuple, List[tuple]] = {}
+        for r in IR:
+            groups.setdefault(r[:nk], []).append(r)
+        lrows, rrows = [], []
+        for lr in IL:
+            for rr in groups.get(lr[:nk], ()):
+                lrows.append(lr)
+                rrows.append(rr)
+        if not lrows:
+            return []
+        import jax.numpy as jnp
+
+        lsch = self._in_schema(lidx)
+        rsch = self._in_schema(ridx)
+        kcols = tuple(jnp.asarray(np.asarray([lr[i] for lr in lrows]),
+                                  lsch[i]) for i in range(nk))
+        lvals = tuple(jnp.asarray(np.asarray([lr[i] for lr in lrows]),
+                                  lsch[i])
+                      for i in range(len(self.circuit.nodes[lidx]
+                                         .schema[0]),
+                                     len(lsch)))
+        rvals = tuple(jnp.asarray(np.asarray([rr[i] for rr in rrows]),
+                                  rsch[i])
+                      for i in range(len(self.circuit.nodes[ridx]
+                                         .schema[0]),
+                                     len(rsch)))
+        ok, ov = op._left_core.fn(kcols, lvals, rvals)
+        outs = _rows_from_cols((*tuple(ok), *tuple(ov)), len(lrows))
+        return [(lr, rr, orow, IL[lr] * IR[rr])
+                for lr, rr, orow in zip(lrows, rrows, outs)]
+
+    def _eval_join(self, idx, op, ins):
+        pairs = self._join_pairs(op, ins[0], ins[1])
+        if pairs is None:
+            return None
+        out: ZDict = {}
+        pm: Dict[tuple, _Prov] = {}
+        lpm = self._provs.setdefault(ins[0], {}) if self.prov else None
+        rpm = self._provs.setdefault(ins[1], {}) if self.prov else None
+        for lr, rr, orow, w in pairs:
+            nw = out.get(orow, 0) + w
+            if nw:
+                out[orow] = nw
+            else:
+                out.pop(orow, None)
+            if lpm is not None:
+                p = lpm.get(lr, _Prov()).union(rpm.get(rr, _Prov()),
+                                               self.prov_cap)
+                _punion(pm, orow, p, self.prov_cap)
+        if self.prov:
+            self._provs[idx] = {r: p for r, p in pm.items() if r in out}
+        return out
+
+    def _eval_linear(self, idx, op, ins):
+        from dbsp_tpu.operators.basic import Minus, Neg
+
+        out: ZDict = {}
+        pm: Dict[tuple, _Prov] = {}
+        for pos, i in enumerate(ins):
+            z = self.integral(i)
+            if z is None:
+                return None
+            neg = isinstance(op, Neg) or (isinstance(op, Minus) and pos == 1)
+            _zadd(out, {r: -w for r, w in z.items()} if neg else z)
+            if self.prov:
+                for r, p in self._provs.setdefault(i, {}).items():
+                    _punion(pm, r, p, self.prov_cap)
+        if self.prov:
+            self._provs[idx] = {r: p for r, p in pm.items() if r in out}
+        return out
+
+    def _eval_distinct(self, idx, src):
+        z = self.integral(src)
+        if z is None:
+            return None
+        out = {r: 1 for r, w in z.items() if w > 0}
+        if self.prov:
+            src_pm = self._provs.setdefault(src, {})
+            self._provs[idx] = {r: src_pm[r] for r in out if r in src_pm}
+        return out
+
+    def _group_prov(self, idx, src, groups: Dict[tuple, List[tuple]],
+                    out: ZDict, nk: int):
+        """Whole-group attribution (aggregates/topk/rolling): every output
+        row of a group carries the union of the group's members' prov —
+        membership and value both depend on the full group."""
+        if not self.prov:
+            return
+        src_pm = self._provs.setdefault(src, {})
+        pm: Dict[tuple, _Prov] = {}
+        gp: Dict[tuple, _Prov] = {}
+        for k, members in groups.items():
+            p = _Prov()
+            for m in members:
+                if m in src_pm:
+                    p = p.union(src_pm[m], self.prov_cap)
+            gp[k] = p
+        for r in out:
+            pm[r] = gp.get(r[:nk], _Prov())
+        self._provs[idx] = pm
+
+    def _eval_aggregate(self, idx, agg, nk, out_dtypes, src):
+        import jax
+        import jax.numpy as jnp
+
+        z = self.integral(src)
+        if z is None:
+            return None
+        sch = self._in_schema(src)
+        src_nk = len(self.circuit.nodes[src].schema[0])
+        assert src_nk == nk, (src_nk, nk)
+        rows = sorted(z.keys())
+        groups: Dict[tuple, List[tuple]] = {}
+        for r in rows:
+            groups.setdefault(r[:nk], []).append(r)
+        keys = sorted(groups)
+        if not keys:
+            out: ZDict = {}
+            self._group_prov(idx, src, groups, out, nk)
+            return out
+        kidx = {k: i for i, k in enumerate(keys)}
+        seg = jnp.asarray(np.asarray([kidx[r[:nk]] for r in rows],
+                                     np.int32))
+        vcols = tuple(
+            jnp.asarray(np.asarray([r[i] for r in rows]), sch[i])
+            for i in range(nk, len(sch)))
+        ws = jnp.asarray(np.asarray([z[r] for r in rows], np.int64))
+        outs = agg.reduce(vcols, ws, seg, len(keys))
+        present = np.asarray(jax.ops.segment_sum(
+            jnp.where(ws > 0, 1, 0), seg,
+            num_segments=len(keys))) > 0
+        omats = [np.asarray(o.astype(d)) for o, d in zip(outs, out_dtypes)]
+        out = {}
+        for i, k in enumerate(keys):
+            if present[i]:
+                row = k + tuple(_pyval(m[i]) for m in omats)
+                out[row] = 1
+        self._group_prov(idx, src, groups, out, nk)
+        return out
+
+    def _eval_linear_aggregate(self, idx, op, src):
+        import jax.numpy as jnp
+
+        z = self.integral(src)
+        if z is None:
+            return None
+        agg = op.agg
+        nk = len(op.key_dtypes)
+        groups: Dict[tuple, List[tuple]] = {}
+        for r in z:
+            groups.setdefault(r[:nk], []).append(r)
+        sch = self._in_schema(src)
+        out: ZDict = {}
+        for k, members in sorted(groups.items()):
+            vcols = tuple(
+                jnp.asarray(np.asarray([m[i] for m in members]), sch[i])
+                for i in range(nk, len(sch)))
+            ws = np.asarray([z[m] for m in members], np.int64)
+            weighed = agg.weigh(vcols)
+            accs = tuple(
+                jnp.asarray([int((np.asarray(a).astype(np.int64)
+                                  * ws).sum())], jnp.int64)
+                for a in weighed)
+            cnt = int(ws.sum())
+            if cnt <= 0:
+                continue
+            fin = agg.finalize(accs, jnp.asarray([cnt], jnp.int64))
+            row = k + tuple(int(np.asarray(c.astype(d))[0])
+                            for c, d in zip(fin, agg.out_dtypes))
+            out[row] = 1
+        self._group_prov(idx, src, groups, out, nk)
+        return out
+
+    def _eval_topk(self, idx, op, src):
+        z = self.integral(src)
+        if z is None:
+            return None
+        nk = len(op.schema[0])
+        groups: Dict[tuple, List[tuple]] = {}
+        for r, w in z.items():
+            groups.setdefault(r[:nk], []).append(r)
+        out: ZDict = {}
+        for k, members in groups.items():
+            present = sorted(r[nk:] for r in members if z[r] > 0)
+            take = present[-op.k:] if op.largest else present[:op.k]
+            for vals in take:
+                out[k + tuple(vals)] = 1
+        self._group_prov(idx, src, groups, out, nk)
+        return out
+
+    def _eval_rolling(self, idx, op, src):
+        z = self.integral(src)
+        if z is None:
+            return None
+        import jax.numpy as jnp
+
+        rng = op.range_ms
+        sch = self._in_schema(src)
+        by_p: Dict[int, List[tuple]] = {}
+        for r in z:
+            by_p.setdefault(r[0], []).append(r)
+        out: ZDict = {}
+        groups: Dict[tuple, List[tuple]] = {}
+        for p, members in by_p.items():
+            # one output PER DISTINCT LIVE (p, t) SLOT, presence weight 1
+            # — two distinct rows sharing (p, t) fill one window, not two
+            # (the engine's output spine is presence-based, _diff_outputs)
+            for t in sorted({r[1] for r in members if z[r] > 0}):
+                win = [m for m in members if t - rng <= m[1] <= t]
+                groups[(p, t)] = win
+                vcols = tuple(
+                    jnp.asarray(np.asarray([m[i] for m in win]), sch[i])
+                    for i in range(2, len(sch)))
+                ws = jnp.asarray(np.asarray([z[m] for m in win], np.int64))
+                seg = jnp.zeros((len(win),), jnp.int32)
+                outs = op.agg.reduce(vcols, ws, seg, 1)
+                row = (p, t) + tuple(
+                    int(np.asarray(o.astype(d))[0])
+                    for o, d in zip(outs, op.agg.out_dtypes))
+                out[row] = 1
+        self._group_prov(idx, src, groups, out, 2)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# backward slicing
+# ---------------------------------------------------------------------------
+
+
+def _backward(node, op, targets: ZDict, ev: Evaluator,
+              circuit) -> Tuple[List[Optional[ZDict]], Optional[str], bool]:
+    """One node's backward rule: targets on its OUTPUT -> support per
+    input (None = control/feedback edge, not followed). Returns
+    (supports, note, resolved)."""
+    from dbsp_tpu.operators.aggregate import AggregateOp
+    from dbsp_tpu.operators.aggregate_linear import LinearAggregateOp
+    from dbsp_tpu.operators.basic import Minus, Neg, Plus, SumN
+    from dbsp_tpu.operators.distinct import DistinctOp
+    from dbsp_tpu.operators.filter_map import FilterOp, FlatMapOp, MapOp
+    from dbsp_tpu.operators.io_handles import OutputOperator
+    from dbsp_tpu.operators.join import JoinOp
+    from dbsp_tpu.operators.shard_op import ExchangeOp, UnshardOp
+    from dbsp_tpu.operators.topk import TopKOp
+    from dbsp_tpu.operators.trace_op import TraceOp
+    from dbsp_tpu.operators.z1 import _PlusNamed
+    from dbsp_tpu.timeseries.rolling import RollingAggregateOp
+    from dbsp_tpu.timeseries.window import WindowOp
+
+    ins = node.inputs
+
+    if isinstance(op, (OutputOperator, TraceOp, ExchangeOp, UnshardOp)):
+        return [dict(targets)], None, True
+    if isinstance(op, WindowOp):
+        # the bounds input is a control stream: containment is decided by
+        # the watermark, but rows are not *derived from* watermark rows
+        return [dict(targets), None], "bounds input treated as a parameter", \
+            True
+    if isinstance(op, _PlusNamed):
+        sup: List[Optional[ZDict]] = []
+        for i in ins:
+            if circuit.nodes[i].kind == "strict_output":
+                sup.append(None)
+            else:
+                sup.append(dict(targets))
+        return sup, "integral pass-through (integrate sugar)", True
+    if isinstance(op, FilterOp):
+        # filters preserve rows bit-for-bit: the preimage IS the target set
+        return [dict(targets)], None, True
+    if isinstance(op, Neg):
+        return [{r: -w for r, w in targets.items()}], None, True
+    if isinstance(op, (Plus, Minus, SumN)):
+        sups: List[Optional[ZDict]] = []
+        ok = True
+        for i in ins:
+            try:
+                z = ev.integral(i)
+            except _Unsupported:
+                z = None
+            if z is None:
+                sups.append(dict(targets))
+                ok = False
+            else:
+                sups.append({r: z[r] for r in targets if r in z})
+        return sups, None if ok else "an input integral was unknown", ok
+    if isinstance(op, MapOp):
+        pairs = ev._map_images(op, ins[0])
+        if pairs is None:
+            return [None], "input integral unknown (enable lineage taps)", \
+                False
+        z = ev.integral(ins[0])
+        tset = set(targets)
+        sup = {r: z[r] for r, img in pairs if img in tset}
+        return [sup], None, True
+    if isinstance(op, FlatMapOp):
+        pairs = ev._flat_map_images(op, ins[0])
+        if pairs is None:
+            return [None], "input integral unknown (enable lineage taps)", \
+                False
+        z = ev.integral(ins[0])
+        tset = set(targets)
+        sup = {r: z[r] for r, imgs in pairs if any(i in tset for i in imgs)}
+        return [sup], None, True
+    if isinstance(op, JoinOp):
+        pairs = ev._join_pairs(op, ins[0], ins[1])
+        if pairs is None:
+            return [None, None], \
+                "a side's integral was unknown (enable lineage taps)", False
+        IL, IR = ev.integral(ins[0]), ev.integral(ins[1])
+        tset = set(targets)
+        supL: ZDict = {}
+        supR: ZDict = {}
+        for lr, rr, orow, _w in pairs:
+            if orow in tset:
+                supL[lr] = IL[lr]
+                supR[rr] = IR[rr]
+        return [supL, supR], None, True
+    if isinstance(op, DistinctOp):
+        z = ev.integral(ins[0])
+        if z is None:
+            return [dict(targets)], "input integral unknown", False
+        return [{r: z[r] for r in targets if r in z}], None, True
+    if isinstance(op, (AggregateOp, LinearAggregateOp, TopKOp)):
+        nk = len(op.key_dtypes) if not isinstance(op, TopKOp) \
+            else len(op.schema[0])
+        z = ev.integral(ins[0])
+        if z is None:
+            return [None], "input integral unknown (enable lineage taps)", \
+                False
+        keys = {r[:nk] for r in targets}
+        return [{r: w for r, w in z.items() if r[:nk] in keys}], None, True
+    if isinstance(op, RollingAggregateOp):
+        z = ev.integral(ins[0])
+        if z is None:
+            return [None], "input integral unknown", False
+        rng = op.range_ms
+        slots = {(r[0], r[1]) for r in targets}
+        sup = {r: w for r, w in z.items()
+               if any(p == r[0] and t - rng <= r[1] <= t for p, t in slots)}
+        return [sup], None, True
+    return [None] * len(ins), f"unsupported operator {op.name!r}", False
+
+
+def slice_view(circuit, state, view_node: int, key: Sequence,
+               tables: Optional[Dict[int, str]] = None,
+               view_name: Optional[str] = None,
+               max_rows: Optional[int] = DEFAULT_MAX_ROWS) -> dict:
+    """Backward-slice the lineage of the view rows whose key columns
+    start with ``key`` — the core entry point both engines share.
+
+    ``state`` is a :class:`HostState` / :class:`CompiledState`;
+    ``tables`` maps source node index -> table name (from the catalog).
+    Returns the lineage DAG report (schema ``dbsp_tpu.lineage/v1``)."""
+    t0 = time.perf_counter()
+    key = tuple(key)
+    tables = tables or {}
+    ev = Evaluator(circuit, state=state)
+    try:
+        I_view = ev.integral(view_node)
+    except _Unsupported as e:
+        I_view = None
+        view_err = str(e)
+    else:
+        view_err = None
+    if I_view is None:
+        return _report(circuit, state, view_node, key, {}, {}, [],
+                       tables, view_name, t0, max_rows,
+                       error=view_err or
+                       "view integral unknown (enable lineage taps)")
+    targets = {r: w for r, w in I_view.items() if r[:len(key)] == key}
+    if not targets:
+        # key miss: skip the backward walk entirely — every hop would
+        # intersect full-integral enumerations (join hash-joins, map
+        # re-evaluations) with the empty set, under the step lock
+        return _report(circuit, state, view_node, key, {}, {}, [],
+                       tables, view_name, t0, max_rows)
+
+    pend: Dict[int, ZDict] = {view_node: dict(targets)}
+    hops: Dict[int, dict] = {}
+    edges: List[List[int]] = []
+    from dbsp_tpu.operators.io_handles import ZSetInput
+    from dbsp_tpu.operators.upsert import UpsertInput
+
+    for idx in reversed(range(len(circuit.nodes))):
+        tgt = pend.get(idx)
+        if tgt is None:
+            continue
+        node = circuit.nodes[idx]
+        op = node.operator
+        hop = {"node": idx, "name": op.name, "kind": type(op).__name__}
+        if isinstance(op, (ZSetInput, UpsertInput)):
+            hop["table"] = tables.get(idx, f"input[{idx}]")
+            hop["resolved"] = True
+            _hop_rows(hop, tgt, max_rows)
+            hops[idx] = hop
+            continue
+        if not node.inputs:
+            hop["note"] = f"sourceless operator {op.name!r}"
+            hop["resolved"] = False
+            _hop_rows(hop, tgt, max_rows)
+            hops[idx] = hop
+            continue
+        try:
+            sups, note, resolved = _backward(node, op, tgt, ev, circuit)
+        except _Unsupported as e:
+            sups, note, resolved = [None] * len(node.inputs), str(e), False
+        hop["resolved"] = resolved
+        if note:
+            hop["note"] = note
+        _hop_rows(hop, tgt, max_rows)
+        hops[idx] = hop
+        for i, sup in zip(node.inputs, sups):
+            if sup is None:
+                continue
+            edges.append([idx, i])
+            cur = pend.setdefault(i, {})
+            for r, w in sup.items():
+                cur[r] = w  # weights are integral weights, not additive
+    return _report(circuit, state, view_node, key, targets, hops, edges,
+                   tables, view_name, t0, max_rows)
+
+
+def _hop_rows(hop: dict, z: ZDict, max_rows: Optional[int]) -> None:
+    rows = sorted(z.items())
+    hop["row_count"] = len(rows)
+    cap = len(rows) if max_rows is None else max_rows
+    hop["truncated"] = len(rows) > cap
+    hop["rows"] = [list(r) for r, _w in rows[:cap]]
+    hop["weights"] = [int(w) for _r, w in rows[:cap]]
+
+
+def _report(circuit, state, view_node, key, targets, hops, edges, tables,
+            view_name, t0, max_rows, error=None) -> dict:
+    import jax
+
+    inputs = {}
+    resolved = error is None
+    for idx, hop in hops.items():
+        if "table" in hop:
+            inputs[hop["table"]] = {
+                "rows": hop["rows"], "weights": hop["weights"],
+                "row_count": hop["row_count"],
+                "truncated": hop["truncated"], "resolved": True}
+        if not hop.get("resolved", True):
+            resolved = False
+    trows = sorted(targets.items())
+    cap = len(trows) if max_rows is None else max_rows
+    out = {
+        "schema": LINEAGE_SCHEMA,
+        "engine": getattr(state, "engine", "host"),
+        "view": view_name,
+        "view_node": view_node,
+        "key": list(key),
+        "found": bool(targets),
+        "target_rows": [[list(r), int(w)] for r, w in trows[:cap]],
+        "target_row_count": len(trows),
+        "nodes": [hops[i] for i in sorted(hops, reverse=True)],
+        "edges": edges,
+        "inputs": inputs,
+        "resolved": resolved,
+        "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        "host": {"cpu_count": os.cpu_count(),
+                 "platform": jax.default_backend()},
+    }
+    if error:
+        out["error"] = error
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pipeline-level entry point (server / manager / client routes)
+# ---------------------------------------------------------------------------
+
+
+def parse_key(key) -> tuple:
+    """Accept a tuple/list, or the HTTP form: a csv of column literals
+    (ints where they parse, then floats — float key columns are
+    first-class dtypes — bare strings otherwise)."""
+    if isinstance(key, (tuple, list)):
+        return tuple(key)
+    out = []
+    for part in str(key).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            out.append(int(part))
+        except ValueError:
+            try:
+                out.append(float(part))
+            except ValueError:
+                out.append(part)
+    return tuple(out)
+
+
+def source_tables(circuit, catalog) -> Dict[int, str]:
+    """Source node index -> catalog input-collection name."""
+    tables: Dict[int, str] = {}
+    for name, col in catalog.inputs.items():
+        op = col.handle._op
+        for node in circuit.nodes:
+            if node.operator is op:
+                tables[node.index] = name
+    return tables
+
+
+def view_node_of(circuit, catalog, view: str) -> int:
+    op = catalog.output(view).handle._op
+    for node in circuit.nodes:
+        if node.operator is op:
+            return node.inputs[0]
+    raise LineageError(f"view {view!r} has no sink node in the circuit")
+
+
+def slice_pipeline(handle_or_driver, catalog, view: str, key,
+                   max_rows: Optional[int] = DEFAULT_MAX_ROWS) -> dict:
+    """Lineage of one output row of a served pipeline: resolves the view
+    through the catalog, picks the engine's state provider, and runs the
+    backward slicer. Read-only; the caller owns quiescence (the HTTP
+    route holds the controller's step lock)."""
+    st = state_for(handle_or_driver)
+    circuit = st.circuit
+    report = slice_view(circuit, st, view_node_of(circuit, catalog, view),
+                        parse_key(key), tables=source_tables(circuit,
+                                                             catalog),
+                        view_name=view, max_rows=max_rows)
+    return report
+
+
+def observe_query(registry, flight, report: dict) -> None:
+    """Per-query observability: the gated metric families (this module is
+    their ONLY registration site — tools/check_metrics.py rule 5) and one
+    flight event."""
+    if registry is not None:
+        registry.counter(
+            "dbsp_tpu_lineage_queries_total",
+            "Lineage (EXPLAIN WHY) queries served, by engine mode",
+            labels=("mode",)).labels(mode=report["engine"]).inc()
+        registry.summary(
+            "dbsp_tpu_lineage_seconds",
+            "Lineage query latency (backward slice incl. state decode)"
+        ).observe(report["latency_ms"] / 1e3)
+    if flight is not None:
+        flight.record("lineage", view=report.get("view"),
+                      key=",".join(map(str, report.get("key", ()))),
+                      found=report.get("found"),
+                      resolved=report.get("resolved"),
+                      ms=report.get("latency_ms"))
+
+
+def http_query(report_fn, qs: Dict[str, list]) -> Tuple[int, Any, bool]:
+    """Shared ``/lineage`` HTTP handling for the pipeline server and the
+    manager proxy (ONE parser — the two surfaces cannot drift): ``qs`` is
+    ``parse_qs`` output, ``report_fn(view, key, max_rows=)`` runs the
+    quiesced slice. Returns ``(status, payload, dot)`` — ``dot`` means
+    the payload is graphviz text, else a JSON-safe dict; usage errors and
+    slicer failures map to 400."""
+    view = qs.get("view", [None])[0]
+    keystr = qs.get("key", [None])[0]
+    if not view or keystr is None:
+        return 400, {"error": "usage: ?view=<output>&key=<col1,col2,...>"
+                              " [&n=<rows/hop>] [&format=dot]"}, False
+    try:
+        n = int(qs["n"][0]) if "n" in qs else None
+        report = report_fn(view, keystr, max_rows=n)
+    except Exception as e:  # noqa: BLE001 — API boundary
+        return 400, {"error": f"{type(e).__name__}: {e}"}, False
+    if qs.get("format", ["json"])[0] == "dot":
+        return 200, lineage_dot(report), True
+    return 200, report, False
+
+
+def lineage_dot(report: dict) -> str:
+    """Graphviz rendering of the lineage DAG: one node per hop (row
+    counts in the label), edges following the backward walk, input-table
+    leaves boxed."""
+    lines = ["digraph lineage {", '  rankdir="RL";']
+    present = {h["node"] for h in report.get("nodes", ())}
+    for h in report.get("nodes", ()):
+        label = f"{h['name']}\\n{h['row_count']} row(s)"
+        if "table" in h:
+            label = f"{h['table']}\\n{label}"
+        shape = "box" if "table" in h else "ellipse"
+        color = "lightblue" if "table" in h else (
+            "white" if h.get("resolved", True) else "lightpink")
+        lines.append(
+            f'  n{h["node"]} [label="{label}", shape={shape}, '
+            f'style=filled, fillcolor={color}];')
+    for src, dst in report.get("edges", ()):
+        if src in present and dst in present:
+            lines.append(f"  n{src} -> n{dst};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the provenance-semiring oracle
+# ---------------------------------------------------------------------------
+
+
+def provenance_oracle(circuit, sources: Dict[int, ZDict], view_node: int,
+                      key, prov_cap: int = ORACLE_PROV_CAP) -> dict:
+    """Independent full recompute with provenance tags: evaluate the
+    circuit forward from ``sources`` ({source node index: input
+    integral}), each input row tagged with its own id, provenance sets
+    unioned through every operator (whole-group attribution at
+    aggregates/topk — membership depends on the full group). Returns the
+    per-source id sets supporting the view rows matching ``key``."""
+    ev = Evaluator(circuit, sources=sources, prov=True, prov_cap=prov_cap)
+    I_view = ev.integral(view_node)
+    if I_view is None:
+        raise LineageError("oracle: a source integral was not provided")
+    key = tuple(parse_key(key))
+    targets = {r: w for r, w in I_view.items() if r[:len(key)] == key}
+    pm = ev.prov_of(view_node)
+    ids = set()
+    truncated = False
+    for r in targets:
+        p = pm.get(r)
+        if p is None:
+            continue
+        ids |= p.ids
+        truncated = truncated or p.truncated
+    by_source: Dict[int, set] = {}
+    for src_idx, row in ids:
+        by_source.setdefault(src_idx, set()).add(row)
+    return {"targets": targets, "ids_by_source": by_source,
+            "truncated": truncated}
+
+
+def check_against_oracle(report: dict, oracle: dict,
+                         tables: Dict[int, str]) -> List[str]:
+    """Agreement between a backward slice and the oracle recompute:
+    identical target rows/weights and, per input table, identical row
+    sets (subset when the oracle's prov sets truncated). Returns mismatch
+    strings (empty = agreement)."""
+    mism: List[str] = []
+    got_targets = {tuple(r): w for r, w in report.get("target_rows", ())}
+    want_targets = {tuple(r): w for r, w in oracle["targets"].items()}
+    if report.get("target_row_count", 0) == len(
+            report.get("target_rows", ())) and got_targets != want_targets:
+        mism.append(
+            f"target rows differ: slice={sorted(got_targets.items())!r} "
+            f"oracle={sorted(want_targets.items())!r}")
+    names = {idx: tables.get(idx, f"input[{idx}]")
+             for idx in oracle["ids_by_source"]}
+    for idx, want in oracle["ids_by_source"].items():
+        name = names[idx]
+        ent = report.get("inputs", {}).get(name)
+        if ent is None:
+            mism.append(f"slice resolved no rows for table {name!r} "
+                        f"(oracle has {len(want)})")
+            continue
+        if ent.get("truncated"):
+            mism.append(f"table {name!r}: slice rows truncated — re-run "
+                        "with max_rows=None for oracle comparison")
+            continue
+        got = {tuple(r) for r in ent["rows"]}
+        want_set = set(want)
+        if oracle["truncated"]:
+            if not want_set <= got:
+                mism.append(f"table {name!r}: oracle rows (truncated set) "
+                            f"not a subset of slice rows")
+        elif got != want_set:
+            only_got = sorted(got - want_set)[:4]
+            only_want = sorted(want_set - got)[:4]
+            mism.append(
+                f"table {name!r}: slice={len(got)} oracle={len(want_set)} "
+                f"rows; slice-only={only_got!r} oracle-only={only_want!r}")
+    for name, ent in report.get("inputs", {}).items():
+        idx = next((i for i, n in tables.items() if n == name), None)
+        if ent["row_count"] and idx is not None and \
+            idx not in oracle["ids_by_source"]:
+            mism.append(f"table {name!r}: slice found {ent['row_count']} "
+                        "rows the oracle never touched")
+    return mism
+
+
+# ---------------------------------------------------------------------------
+# dryrun (lint front + artifact generator + CLI)
+# ---------------------------------------------------------------------------
+
+
+def _recap(report: dict, max_rows: Optional[int]) -> dict:
+    """A capped copy of an uncapped lineage report: truncate each hop's
+    (and input table's, and the target set's) row listing to ``max_rows``
+    — exactly what slice_view(max_rows=...) would have served, without
+    walking the circuit a second time."""
+    if max_rows is None:
+        return report
+    out = dict(report)
+    out["nodes"] = []
+    for hop in report["nodes"]:
+        h = dict(hop)
+        h["truncated"] = h["row_count"] > max_rows
+        h["rows"] = h["rows"][:max_rows]
+        h["weights"] = h["weights"][:max_rows]
+        out["nodes"].append(h)
+    out["inputs"] = {}
+    for name, ent in report["inputs"].items():
+        e = dict(ent)
+        e["truncated"] = e["row_count"] > max_rows
+        e["rows"] = e["rows"][:max_rows]
+        e["weights"] = e["weights"][:max_rows]
+        out["inputs"][name] = e
+    out["target_rows"] = report["target_rows"][:max_rows]
+    return out
+
+
+def dryrun(query: str = "q4", events: int = 4000, steps: int = 4,
+           key=None, engine: str = "host", out: Optional[str] = None,
+           max_rows: int = DEFAULT_MAX_ROWS,
+           rate: Optional[int] = None) -> dict:
+    """Build a mini Nexmark pipeline, feed it, backward-slice one output
+    row, and verify the slice against the provenance-semiring oracle —
+    the ``tools/lint_all.py`` front (red on divergence) and the
+    ``LINEAGE_q4.json`` artifact generator (``out=``). Raises
+    :class:`LineageError` on oracle divergence."""
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    q = getattr(queries, query)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, q(*streams).output()
+
+    handle, (handles, out_handle) = Runtime.init_circuit(1, build)
+    enable_taps(handle.circuit)
+    driver = handle
+    if engine == "compiled":
+        from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+
+        driver = CompiledCircuitDriver(handle)
+    if rate is None:
+        # watermark/window queries need event time to cross a period
+        # (q7: 10s tumbling window) — spread the same events over more
+        # event time instead of feeding more events
+        rate = 150 if query in ("q7",) else 1000
+    gen = NexmarkGenerator(GeneratorConfig(seed=7, first_event_rate=rate))
+    per = events // steps
+    for i in range(steps):
+        gen.feed(handles, i * per, (i + 1) * per)
+        if engine == "compiled":
+            driver.step()
+        else:
+            handle.step()
+    if engine == "compiled":
+        driver.flush()
+
+    st = state_for(driver)
+    circuit = handle.circuit
+    tables = {}
+    for name, h in zip(("persons", "auctions", "bids"), handles):
+        for node in circuit.nodes:
+            if node.operator is h._op:
+                tables[node.index] = name
+    # the view node: the one OutputOperator sink
+    from dbsp_tpu.operators.io_handles import OutputOperator
+
+    sink = next(n for n in circuit.nodes
+                if isinstance(n.operator, OutputOperator))
+    view_node = sink.inputs[0]
+    if key is None:
+        ev = Evaluator(circuit, state=st)
+        I_view = ev.integral(view_node)
+        if not I_view:
+            raise LineageError(f"{query}: empty view — nothing to slice")
+        key = sorted(I_view)[0][:1]  # first row's leading key column
+    key = parse_key(key)
+    # ONE uncapped slice serves both needs: the oracle comparison reads
+    # it directly, the reported artifact re-caps its row lists (the cap
+    # only truncates what _hop_rows lists, never what the walk computes)
+    full = slice_view(circuit, st, view_node, key, tables=tables,
+                      view_name=query, max_rows=None)
+    report = _recap(full, max_rows)
+    sources = {idx: st.source_integral(idx) for idx in tables}
+    if any(v is None for v in sources.values()):
+        raise LineageError("dryrun: missing source integral (taps broken?)")
+    oracle = provenance_oracle(circuit, sources, view_node, key)
+    mism = check_against_oracle(full, oracle, tables)
+    if mism:
+        raise LineageError(
+            f"{query}: backward slice diverged from the provenance oracle "
+            f"({len(mism)}): {mism[:4]}")
+    if not full["found"]:
+        raise LineageError(f"{query}: key {key!r} matched no view row")
+    report["oracle"] = {"agrees": True,
+                        "input_rows": {tables[i]: len(r) for i, r in
+                                       oracle["ids_by_source"].items()},
+                        "truncated": oracle["truncated"]}
+    report["workload"] = {"query": query, "events": events, "steps": steps,
+                          "engine": engine}
+    report["host"]["note"] = (
+        "latency measured on this CPU-only host (see host.cpu_count) — "
+        "an environment figure, not a representative serving number")
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return report
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m dbsp_tpu.obs.lineage",
+        description="Backward provenance slice of one Nexmark view row, "
+                    "verified against the provenance-semiring oracle.")
+    ap.add_argument("query", nargs="?", default="q4",
+                    help="nexmark query builder name (default q4)")
+    ap.add_argument("--key", default=None,
+                    help="output-row key prefix, csv (default: first row)")
+    ap.add_argument("--events", type=int, default=4000)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--engine", choices=("host", "compiled"),
+                    default="host")
+    ap.add_argument("--max-rows", type=int, default=DEFAULT_MAX_ROWS)
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON to this path")
+    args = ap.parse_args(argv)
+    report = dryrun(args.query, events=args.events, steps=args.steps,
+                    key=args.key, engine=args.engine, out=args.out,
+                    max_rows=args.max_rows)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -28,6 +28,13 @@ class ZSetInput(SourceOperator):
 
     name = "input"
 
+    # Optional lineage tap (obs/lineage.py enable_taps): a host spine this
+    # source folds every drained delta into — the raw input-table integral
+    # backward provenance slicing resolves to. Both engines drain inputs
+    # through this eval (the compiled serving driver calls it per tick),
+    # so one tap serves both. Opt-in: None = zero cost.
+    lineage_tap = None
+
     def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = ()):
         self.key_dtypes = tuple(key_dtypes)
         self.val_dtypes = tuple(val_dtypes)
@@ -60,6 +67,10 @@ class ZSetInput(SourceOperator):
         acc = parts[0]
         for p in parts[1:]:
             acc = acc.merge_with(p)
+        if self.lineage_tap is not None:
+            # tapped BEFORE sharding: the tap is a 1-D host integral even
+            # on a worker mesh (lineage readers union state host-side)
+            self.lineage_tap.insert(acc)
         if workers > 1:
             # distribute by key hash over the mesh (the reference spreads
             # input across workers at the handle, input.rs:66-67/309-311)
@@ -67,6 +78,19 @@ class ZSetInput(SourceOperator):
 
             acc = shard_batch(acc, rt.mesh).shrink_to_fit()
         return acc
+
+    def state_dict(self):
+        # host checkpoints carry the lineage tap so restored pipelines
+        # keep answering provenance queries (the pending buffers stay
+        # transient — consumed counts are the controller's to persist)
+        if self.lineage_tap is not None:
+            return {"lineage_tap": self.lineage_tap}
+        return {}
+
+    def load_state_dict(self, state):
+        tap = state.get("lineage_tap")
+        if tap is not None:
+            self.lineage_tap = tap
 
 
 class InputHandle:

@@ -75,23 +75,18 @@ def _range_gather_impl(qp, qlo, qhi, qlive, level: Batch, out_cap: int):
     """Rows of a (p, pos)-keyed level with p == qp[i] and pos in [qlo, qhi];
     returns (qrow, pos col, value col, weights, total), sorted by
     (qrow, pos). Dead slots carry qrow == len(qp) (the trash segment).
-    Empty ranges (qhi < qlo) gather nothing."""
-    q_cap = qp.shape[0]
-    pk, tk = level.keys[0], level.keys[1]
-    qlo = qlo.astype(tk.dtype)
-    qhi = qhi.astype(tk.dtype)
-    lo = kernels.lex_probe((pk, tk), (qp, qlo), side="left")
-    hi = kernels.lex_probe((pk, tk), (qp, qhi), side="right")
-    ok = qlive & (qhi >= qlo)
-    lo = jnp.where(ok, lo, 0)
-    hi = jnp.where(ok, hi, lo)
-    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap)
-    w = jnp.where(valid, level.weights[src], 0)
-    t = jnp.where(valid, tk[src], kernels.sentinel_for(tk.dtype))
-    v = jnp.where(valid, level.vals[0][src],
-                  kernels.sentinel_for(level.vals[0].dtype))
-    qrow = jnp.where(valid, row, jnp.int32(q_cap))
-    return qrow, t, v, w, total
+    Empty ranges (qhi < qlo) gather nothing. One-level instance of the
+    aggregate family's shared cursor entry point (cursor.gather_ladder
+    with distinct lo/hi probe columns + the pos key column gathered
+    back); the per-level loop stays here because the tree's consumers
+    want per-level parts with per-level caps."""
+    from dbsp_tpu.zset import cursor
+
+    tk = level.keys[1]
+    (qrow, cols, w), total = cursor.gather_ladder(
+        (qp, qlo.astype(tk.dtype)), qlive, (level,), out_cap,
+        qhi_keys=(qp, qhi.astype(tk.dtype)), gather_keys=1)
+    return qrow, cols[0], cols[1], w, total
 
 
 _range_gather = jax.jit(_range_gather_impl, static_argnames=("out_cap",))

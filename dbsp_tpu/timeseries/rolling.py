@@ -23,17 +23,11 @@ proportional, state-independent.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Dict
-
 import jax
 import jax.numpy as jnp
 
 from dbsp_tpu.circuit.builder import CircuitError, Stream
 from dbsp_tpu.circuit.operator import UnaryOperator
-# TODO(next round): unify RangeGather/_range_gather_level with aggregate's
-# GroupGather/_gather_level (distinct lo/hi query cols + optional key-column
-# return generalize both).
 from dbsp_tpu.operators.aggregate import Aggregator, GroupGather, _TupleMax, \
     _diff_outputs, _reduce_groups
 from dbsp_tpu.operators.registry import stream_method
@@ -43,76 +37,66 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, bucket_cap
 
 
-def _range_gather_level_impl(qp, qlo, qhi, qlive, level: Batch,
-                             out_cap: int):
-    """Rows of one (p, time)-keyed level with key p==qp and time in
-    [qlo, qhi]; returns (qrow ids, time col, val cols, weights, total)."""
-    tk = level.keys[0]
-    tt = level.keys[1]
-    lo = kernels.lex_probe((tk, tt), (qp, qlo), side="left")
-    hi = kernels.lex_probe((tk, tt), (qp, qhi), side="right")
-    lo = jnp.where(qlive, lo, 0)
-    hi = jnp.where(qlive, hi, lo)
-    row, src, valid, total = kernels.expand_ranges(lo, hi, out_cap)
-    w = jnp.where(valid, level.weights[src], 0)
-    t = jnp.where(valid, tt[src], kernels.sentinel_for(tt.dtype))
-    vals = tuple(jnp.where(valid, c[src], kernels.sentinel_for(c.dtype))
-                 for c in level.vals)
-    qrow = jnp.where(valid, row, jnp.int32(-1))
-    return qrow, t, vals, w, total
+def _range_gather_ladder_impl(qp, qlo, qhi, qlive, levels, out_cap: int):
+    """Rows of the (p, time)-keyed ladder with key p==qp and time in
+    [qlo, qhi], in ONE fused launch over ALL levels — the aggregate
+    family's shared cursor entry point (cursor.gather_ladder) with
+    distinct lo/hi probe columns and the time key column gathered back.
+    Returns ((qrow ids, time col + val cols, weights), total); dead slots
+    carry qrow == q_cap (the trash segment) and sentinel cols."""
+    from dbsp_tpu.zset import cursor
+
+    return cursor.gather_ladder((qp, qlo), qlive, levels, out_cap,
+                                qhi_keys=(qp, qhi), gather_keys=1)
 
 
-_range_gather_level = jax.jit(_range_gather_level_impl,
-                              static_argnames=("out_cap",))
+_range_gather_ladder = jax.jit(_range_gather_ladder_impl,
+                               static_argnames=("out_cap",))
 
 
-def _range_gather_factory(out_cap: int):
-    return lambda qp, qlo, qhi, qlive, level: _range_gather_level_impl(
-        qp, qlo, qhi, qlive, level, out_cap)
+def _range_gather_ladder_factory(out_cap: int):
+    return lambda qp, qlo, qhi, qlive, levels: _range_gather_ladder_impl(
+        qp, qlo, qhi, qlive, levels, out_cap)
 
 
 class RangeGather:
-    """Grow-on-demand driver for per-row [lo, hi] time-range gathers.
-    Sharded levels gather per worker; the capacity check takes the worst
+    """Host driver for per-row [lo, hi] time-range gathers: the full
+    ladder in ONE fused launch through the same cursor entry point the
+    equality aggregates use (one probe pair over the ladder, one
+    cross-level expansion, one monotone shared capacity — the per-level
+    loop paid K probe kernels and K grow-on-demand buffers). Sharded
+    query sets gather per worker; the capacity check takes the worst
     worker."""
 
     def __init__(self):
-        self.caps: Dict[int, int] = {}
+        self.out_cap = 0  # fused ladder output capacity (monotone)
 
     @staticmethod
-    def _launch(qp, qlo, qhi, qlive, level, cap):
-        if level.sharded:
+    def _launch(qp, qlo, qhi, qlive, levels, cap):
+        if qlive.ndim > 1:  # sharded query set
             from dbsp_tpu.parallel.lift import lifted
 
-            return lifted(_range_gather_factory, cap)(qp, qlo, qhi, qlive,
-                                                      level)
-        return _range_gather_level(qp, qlo, qhi, qlive, level, cap)
+            return lifted(_range_gather_ladder_factory, cap)(
+                qp, qlo, qhi, qlive, levels)
+        return _range_gather_ladder(qp, qlo, qhi, qlive, levels, cap)
 
     def __call__(self, qp, qlo, qhi, qlive, levels, q_cap):
         import numpy as np
 
-        rows, times, vals, ws = [], [], [], []
-        for level in levels:
-            cap = self.caps.get(level.cap, max(64, q_cap))
-            qrow, t, v, w, total = self._launch(qp, qlo, qhi, qlive, level,
-                                                cap)
-            tt = int(np.max(jax.device_get(total)))
-            if tt > cap:
-                cap = bucket_cap(tt)
-                self.caps[level.cap] = cap
-                qrow, t, v, w, total = self._launch(qp, qlo, qhi, qlive,
-                                                    level, cap)
-            rows.append(qrow)
-            times.append(t)
-            vals.append(v)
-            ws.append(w)
-        if not rows:
+        if not levels:
             return None
-        return (jnp.concatenate(rows, axis=-1),
-                jnp.concatenate(times, axis=-1),
-                tuple(jnp.concatenate([v[i] for v in vals], axis=-1)
-                      for i in range(len(vals[0]))),
-                jnp.concatenate(ws, axis=-1))
+        levels = tuple(levels)
+        if not self.out_cap:
+            self.out_cap = bucket_cap(max(64, q_cap))
+        part, total = self._launch(qp, qlo, qhi, qlive, levels,
+                                   self.out_cap)
+        t = int(np.max(jax.device_get(total)))  # ONE sync; worst worker
+        if t > self.out_cap:
+            self.out_cap = bucket_cap(t)
+            part, _ = self._launch(qp, qlo, qhi, qlive, levels,
+                                   self.out_cap)
+        qrow, cols, w = part
+        return qrow, cols[0], cols[1:], w
 
 
 def _rolling_reduce_impl(wrow, wt, wvals, ww, at, agg: Aggregator,

@@ -220,12 +220,22 @@ def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
 
 
 def gather_ladder(qkeys: Cols, qlive: jnp.ndarray, levels: Sequence[Batch],
-                  out_cap: int):
+                  out_cap: int, qhi_keys: Cols = None,
+                  gather_keys: int = 0):
     """Gather the query keys' rows from ALL trace levels into one
     (qrow, val_cols, w) part of capacity ``out_cap``. Dead slots carry
     qrow == q_cap (the trash segment) and sentinel vals — the same contract
     as the per-level gather + offset scatter it replaces. Returns
     ``(part, unclamped total)``.
+
+    The ONE leveled-gather entry point, shared by equality and range
+    consumers (the aggregate family, rolling aggregates, the radix time
+    index): ``qhi_keys`` optionally gives DISTINCT upper-bound query
+    columns for the right-side probe — each query then matches the key
+    range [qkeys[i], qhi_keys[i]] instead of the exact group (empty
+    ranges, qhi < qlo, gather nothing); ``gather_keys`` returns that many
+    trailing PROBED KEY columns ahead of the vals (range gathers need the
+    time column back; equality gathers already hold their keys).
 
     NOTE: with K > 1 the part may hold cross-level insert/retract rows for
     one (qrow, vals) — reducers must net them
@@ -236,15 +246,20 @@ def gather_ladder(qkeys: Cols, qlive: jnp.ndarray, levels: Sequence[Batch],
     q_cap = qlive.shape[-1]
     tables = [lvl.keys[:nk] for lvl in levels]
     lo = lex_probe_ladder(tables, qkeys, side="left")
-    hi = lex_probe_ladder(tables, qkeys, side="right")
+    hi = lex_probe_ladder(tables, qkeys if qhi_keys is None else qhi_keys,
+                          side="right")
     lo = jnp.where(qlive[None, :], lo, 0)
-    hi = jnp.where(qlive[None, :], hi, lo)
+    # probes are monotone, so with distinct bounds an empty query range
+    # (qhi < qlo) lands hi <= lo — the clamp makes it gather nothing;
+    # with qhi_keys=None hi >= lo always holds and the clamp is a no-op
+    hi = jnp.where(qlive[None, :], jnp.maximum(hi, lo), lo)
     level, qrow, src, valid, total = expand_ladder(lo, hi, out_cap)
     (lw,) = _select_gather([(lvl.weights,) for lvl in levels], level, src)
     w = jnp.where(valid, lw, 0)
+    gcols = [(*lvl.keys[nk - gather_keys:nk], *lvl.vals) for lvl in levels] \
+        if gather_keys else [lvl.vals for lvl in levels]
     vals = tuple(jnp.where(valid, v, kernels.sentinel_for(v.dtype))
-                 for v in _select_gather([lvl.vals for lvl in levels],
-                                         level, src))
+                 for v in _select_gather(gcols, level, src))
     qrow = jnp.where(valid, qrow, jnp.int32(q_cap)).astype(jnp.int32)
     return (qrow, vals, w), total
 

@@ -206,7 +206,17 @@ def kernel_enabled(kernel: str) -> bool:
 
 def _supported_dtype(d) -> bool:
     d = jnp.dtype(d)
-    return jnp.issubdtype(d, jnp.integer) or d == jnp.bool_
+    if d == jnp.bool_:
+        return True
+    if not jnp.issubdtype(d, jnp.integer):
+        return False
+    # every column is widened via astype(int64) before the C++ kernels:
+    # unsigned widths <= 32 zero-extend losslessly, but uint64 values
+    # >= 2^63 wrap NEGATIVE and break the lexicographic order the
+    # two-pointer merge/probe assumes — those columns take the XLA path
+    if jnp.issubdtype(d, jnp.unsignedinteger) and d.itemsize >= 8:
+        return False
+    return True
 
 
 def supports(dtypes) -> bool:

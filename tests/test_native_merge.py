@@ -291,3 +291,44 @@ def test_jit_path_matches(seed):
             want[row] = want.get(row, 0) + w
     want = {r: w for r, w in want.items() if w}
     assert out.to_dict() == want
+
+
+def test_uint64_rejected_falls_back_to_xla():
+    """uint64 columns must NOT take the native path: every column is
+    widened via astype(int64) before the C++ kernels, so values >= 2^63
+    wrap negative and break the lexicographic order the two-pointer
+    merge/probe assumes. Unsigned widths <= 32 zero-extend losslessly
+    and stay native. (round-5 advisor finding, native_merge.py)"""
+    assert not native_merge._supported_dtype(jnp.uint64)
+    assert not native_merge.supports([jnp.int64, jnp.uint64])
+    for d in (jnp.uint8, jnp.uint16, jnp.uint32, jnp.int64, jnp.bool_):
+        assert native_merge._supported_dtype(d), d
+
+    # values straddling 2^63: unsigned order differs from the wrapped
+    # int64 order, so a native dispatch would mis-sort these
+    vals = np.array([2**63 + 5, 3, 2**64 - 2, 2**63, 7], np.uint64)
+    cols = (jnp.asarray(vals),)
+    w = jnp.ones((5,), jnp.int64)
+    before = dict(kernels.KERNEL_DISPATCH_COUNTS)
+    out_cols, out_w = kernels.consolidate_cols(cols, w)
+    delta = {k: v - before.get(k, 0)
+             for k, v in kernels.KERNEL_DISPATCH_COUNTS.items()}
+    assert delta.get(("consolidate", "native"), 0) == 0
+    assert delta.get(("consolidate", "xla"), 0) == 1
+    # bit-identical to the unsigned-order oracle (sentinel = uint64 max
+    # marks the dead tail; 2^64-1 is reserved, not used as a value)
+    want = np.sort(vals)
+    got = np.asarray(out_cols[0])
+    np.testing.assert_array_equal(got[:5], want)
+    np.testing.assert_array_equal(np.asarray(out_w), np.ones(5, np.int64))
+
+    # the merge entry point rejects uint64 through the same supports()
+    before = dict(kernels.KERNEL_DISPATCH_COUNTS)
+    mc, mw = kernels.merge_sorted_cols(out_cols, out_w, out_cols, out_w)
+    delta = {k: v - before.get(k, 0)
+             for k, v in kernels.KERNEL_DISPATCH_COUNTS.items()}
+    assert delta.get(("merge", "native"), 0) == 0
+    got = np.asarray(mc[0])
+    np.testing.assert_array_equal(got[:5], want)
+    np.testing.assert_array_equal(np.asarray(mw)[:5],
+                                  np.full(5, 2, np.int64))

@@ -34,6 +34,14 @@ tests/test_obs.py::test_metrics_lint):
    hotpath rules: a ``# metrics: ok`` comment on the registration line
    acknowledges a deliberately-bounded exception.
 
+5. **Lineage families register only in the lineage module.** The
+   ``dbsp_tpu_lineage_*`` families exist so provenance queries stay
+   observable at ONE site (``obs/lineage.py::observe_query`` — absent
+   from the exposition until a query actually runs); a second
+   registration elsewhere would fork the family's labels/help and
+   double-count queries. Violation outside ``dbsp_tpu/obs/lineage.py``;
+   waivable with ``# metrics: ok`` like rule 4.
+
 Usage: ``python tools/check_metrics.py [root]`` — prints violations and
 exits 1 when any are found.
 """
@@ -64,12 +72,27 @@ _FORMAT_PATTERNS = (
 # a literal that IS a metric name (subject to the naming convention)
 _METRIC_LITERAL = re.compile(r"^dbsp_tpu_[a-z0-9_]+$")
 
-# rule 4: per-node metric families (one series per circuit node) — only
-# obs/opprofile.py::export_node_metrics may register these (it top-N caps
-# the label set and gates registration on a profile actually running)
-_NODE_FAMILY = re.compile(r"^dbsp_tpu_compiled_node_")
-_NODE_GATE = os.path.join("obs", "opprofile.py")
 _WAIVER = "# metrics: ok"
+
+# Pinned families (rules 4 and 5): (family regex, sole registration site,
+# why). A registration elsewhere is a violation unless waived with
+# _WAIVER on the registration line; the next pinned family is one row.
+_PINNED_FAMILIES = (
+    # rule 4: per-node families (one series per circuit node) — only
+    # obs/opprofile.py::export_node_metrics top-N-caps the label set and
+    # gates registration on a profile actually running
+    (re.compile(r"^dbsp_tpu_compiled_node_"),
+     os.path.join("obs", "opprofile.py"),
+     "node-labeled series must stay top-N capped and profile-gated "
+     "(export_node_metrics)"),
+    # rule 5: lineage query families — obs/lineage.py::observe_query is
+    # the one observation site (absent until a query runs); a second
+    # registration forks the family and double-counts queries
+    (re.compile(r"^dbsp_tpu_lineage_"),
+     os.path.join("obs", "lineage.py"),
+     "observe_query is the one observation site — a second registration "
+     "forks the family and double-counts queries"),
+)
 
 _REGISTER_METHODS = {"counter": "counter", "gauge": "gauge",
                      "histogram": "histogram", "summary": "summary"}
@@ -117,7 +140,7 @@ def check_tree(pkg_root: str) -> list:
             continue
         in_obs = _is_obs(path, pkg_root)
         src_lines = src.splitlines()
-        is_node_gate = os.path.relpath(path, pkg_root) == _NODE_GATE
+        rel_in_pkg = os.path.relpath(path, pkg_root)
         for node in ast.walk(tree):
             # (1) exposition formatting outside obs/
             if not in_obs and isinstance(node, ast.Constant) and \
@@ -152,18 +175,19 @@ def check_tree(pkg_root: str) -> list:
                                 "per-key/per-tick label values are "
                                 "forbidden; grow the allowlist only for "
                                 "enumerable dimensions")
-                    # (4) per-node families only via the opprofile gate
-                    if _NODE_FAMILY.match(name) and not is_node_gate:
+                    # (4)/(5) pinned families register only at their gate
+                    for fam, gate, why in _PINNED_FAMILIES:
+                        if not fam.match(name) or rel_in_pkg == gate:
+                            continue
                         span = src_lines[node.lineno - 1:
                                          (node.end_lineno or node.lineno)]
                         if not any(_WAIVER in ln for ln in span):
                             violations.append(
-                                f"{rel}:{node.lineno}: per-node family "
+                                f"{rel}:{node.lineno}: pinned family "
                                 f"{name!r} registered outside the "
-                                "obs/opprofile.py gate — node-labeled "
-                                "series must stay top-N capped and "
-                                "profile-gated (export_node_metrics); "
-                                f"waive deliberately with {_WAIVER!r}")
+                                f"{gate.replace(os.sep, '/')} gate "
+                                f"({why}); waive deliberately with "
+                                f"{_WAIVER!r}")
             # (2b) any metric-shaped literal: convention minus the kind rule
             elif isinstance(node, ast.Constant) and \
                     isinstance(node.value, str) and \

@@ -35,6 +35,11 @@ the tier-1 test in tests/test_analysis.py):
    profile end to end, red on schema drift, segmented/fused divergence,
    or attribution below 90% — the operator profiler cannot silently rot.
    The import-based tier-1 consumer is tests/test_opprofile.py.
+6. **Lineage dryrun** (CLI only; DBSP_TPU_LINT_LINEAGE=0 skips) —
+   ``lineage.dryrun("q4")`` in a subprocess: backward-slice one known q4
+   output row and verify it against the provenance-semiring recompute
+   oracle, red on divergence — EXPLAIN WHY cannot silently rot. The
+   import-based tier-1 consumer is tests/test_lineage.py.
 
 Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
 1 when any front fails.
@@ -322,6 +327,33 @@ def run_profile_dryrun() -> list:
     return []
 
 
+def run_lineage_dryrun() -> list:
+    """6. **Lineage dryrun** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_LINEAGE=0`` skips — tests/test_lineage.py carries the
+    import-based tier-1 coverage): ``lineage.dryrun("q4")`` backward-
+    slices one known q4 output row on the host engine and raises
+    LineageError when the slice diverges from the provenance-semiring
+    full-recompute oracle."""
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_LINEAGE", "1") == "0":
+        print("lint_all: lineage_dryrun: skipped (DBSP_TPU_LINT_LINEAGE=0)")
+        return []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "from dbsp_tpu.obs.lineage import dryrun; "
+             "dryrun('q4', events=2000, steps=2)"],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return ["lineage.dryrun('q4') timed out after 900s"]
+    if p.returncode != 0:
+        return [f"lineage.dryrun('q4') failed (oracle divergence?):\n"
+                f"{p.stdout[-800:]}\n{p.stderr[-800:]}"]
+    return []
+
+
 def main() -> int:
     fronts = [("check_metrics", run_check_metrics),
               ("check_hotpath", run_check_hotpath),
@@ -331,7 +363,8 @@ def main() -> int:
               ("check_dashboard", run_check_dashboard),
               ("analyzer_selfcheck", run_analyzer_selfcheck),
               ("multichip", run_multichip),
-              ("profile_dryrun", run_profile_dryrun)]
+              ("profile_dryrun", run_profile_dryrun),
+              ("lineage_dryrun", run_lineage_dryrun)]
     failed = 0
     for name, fn in fronts:
         violations = fn()
