@@ -15,6 +15,7 @@ transitions happen at drain points), which keeps the protocol identical
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import threading
@@ -25,6 +26,7 @@ from dbsp_tpu.circuit.runtime import CircuitHandle
 from dbsp_tpu.io.catalog import Catalog
 from dbsp_tpu.io.format import INPUT_FORMATS, OUTPUT_FORMATS
 from dbsp_tpu.io.transport import InputTransport, OutputTransport
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
 
 
 @dataclasses.dataclass
@@ -66,6 +68,7 @@ class _InputEndpoint:
         # replay their stream from the beginning, so replayed rows the
         # restored state already contains are not double-applied
         self.skip_rows = 0
+        _tsan_hook(self)
 
     def on_chunk(self, chunk: bytes) -> None:
         with self.lock:
@@ -118,6 +121,7 @@ class _OutputEndpoint:
         self.pending = None  # batch whose write failed, awaiting retry
         # private delta queue: endpoints never race other handle consumers
         self.cursor = collection.handle.register_consumer()
+        _tsan_hook(self)
 
 
 class Controller:
@@ -162,6 +166,7 @@ class Controller:
         # optional obs.FlightRecorder (PipelineObs.attach_controller wires
         # it) — checkpoint/restore events become SLO-visible through it
         self.flight = None
+        _tsan_hook(self)
 
     # -- endpoint wiring ----------------------------------------------------
     def add_input_endpoint(self, name: str, collection: str,
@@ -242,7 +247,7 @@ class Controller:
         with self._step_lock:
             return self._checkpoint_locked(path)
 
-    def _checkpoint_locked(self, path: Optional[str] = None) -> dict:
+    def _checkpoint_locked(self, path=None) -> dict:  # holds: _step_lock
         from dbsp_tpu import checkpoint as _ckpt
 
         path = path or self.checkpoint_dir
@@ -269,7 +274,7 @@ class Controller:
                                bytes=info["bytes"])
         return info
 
-    def _maybe_checkpoint_locked(self) -> None:
+    def _maybe_checkpoint_locked(self) -> None:  # holds: _step_lock
         """Periodic-cadence hook on the circuit thread: a checkpoint
         failure is recorded (flight + stats) but never takes the pipeline
         down — serving continues at reduced durability."""
@@ -308,14 +313,16 @@ class Controller:
             info = _ckpt.restore(self.handle, path)
             c = info.get("controller") or {}
             self.steps = int(c.get("steps", info["tick"]))
-            self.total_pushed = int(c.get("pushed_records", 0))
+            with self._pushed_lock:  # writes join note_pushed's guard
+                self.total_pushed = int(c.get("pushed_records", 0))
             for name, d in (c.get("inputs") or {}).items():
                 ep = self.inputs.get(name)
                 if ep is not None:
-                    ep.total_records = int(d.get("total_records", 0))
-                    ep.total_bytes = int(d.get("total_bytes", 0))
-                    if getattr(ep.transport, "replays_from_start", False):
-                        with ep.lock:
+                    with ep.lock:  # counters share the endpoint's guard
+                        ep.total_records = int(d.get("total_records", 0))
+                        ep.total_bytes = int(d.get("total_bytes", 0))
+                        if getattr(ep.transport, "replays_from_start",
+                                   False):
                             ep.skip_rows = ep.total_records
             for name, batch in (info.get("output_pending") or {}).items():
                 out = self.outputs.get(name)
@@ -327,19 +334,30 @@ class Controller:
 
     # -- lifecycle (reference: start/pause/stop, controller/mod.rs:196-246) -
     def start(self) -> None:
-        self.state = "running"
-        self._running.set()
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._circuit_loop,
-                                            daemon=True, name="circuit")
-            self._thread.start()
+        # under the lifecycle lock like pause()/stop(): a start() racing
+        # a stop() must not resurrect "running" state or spawn a second
+        # circuit thread (found by tools/check_concurrency.py C001 —
+        # state/_thread are claimed writelock(_lifecycle_lock))
+        with self._lifecycle_lock:
+            if self.state == "shutdown":
+                return
+            self.state = "running"
+            self._running.set()
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._circuit_loop,
+                                                daemon=True, name="circuit")
+                self._thread.start()
 
     def pause(self) -> None:
         with self._lifecycle_lock:
             if self.state in ("paused", "shutdown"):
                 return  # idempotent under double-call
             self.state = "paused"
-        self._running.clear()
+            # clear the run gate INSIDE the lifecycle lock: a racing
+            # start() otherwise interleaves its _running.set() before
+            # this clear, leaving state=="running" with the gate down —
+            # a healthy-looking pipeline that never steps
+            self._running.clear()
         with self._step_lock:  # quiesce: wait out any in-flight step
             self._flush_driver_locked()
 
@@ -376,7 +394,7 @@ class Controller:
                 except Exception as e:  # noqa: BLE001 — still shut down
                     self.checkpoint_error = f"{type(e).__name__}: {e}"
 
-    def _flush_driver_locked(self) -> None:
+    def _flush_driver_locked(self) -> None:  # holds: _step_lock
         """Validate + deliver a compiled driver's open interval (no-op for
         host handles and at the default serve cadence of 1). Called with
         the step lock held, at quiesce points and when the loop idles, so
@@ -385,6 +403,29 @@ class Controller:
         if flush is not None:
             flush()
             self._emit_outputs()
+
+    @contextlib.contextmanager
+    def quiesce(self):
+        """Public quiesce point: hold the step lock (no serving tick in
+        flight) with any open deferred-validation interval flushed, for
+        the duration of the ``with`` block. The sanctioned way for other
+        components (the HTTP server's ``/lineage`` and ``/profile``
+        handlers) to get a consistent, non-advancing view of the engine —
+        reaching through to ``_step_lock`` directly is a C003 lint
+        violation (tools/check_concurrency.py).
+
+        Lock order: ``_step_lock`` is the OUTERMOST engine lock; nested
+        inside it are ``_pushed_lock`` (static C002 graph) and the
+        per-endpoint ``_InputEndpoint.lock`` (drain/restore — a
+        cross-class edge the static graph does not model; the runtime
+        sanitizer's lock-order tracking covers it). Never acquire an
+        endpoint lock and THEN call into a step-lock-taking controller
+        method — that is the ABBA inversion. Do not call ``step()``,
+        ``checkpoint()`` or another ``quiesce()`` from inside the block —
+        the step lock is not reentrant."""
+        with self._step_lock:
+            self._flush_driver_locked()
+            yield self
 
     def eoi_reached(self) -> bool:
         """All inputs exhausted AND fully processed.
@@ -440,7 +481,7 @@ class Controller:
         with self._step_lock:
             self._step_locked()
 
-    def _step_locked(self) -> None:
+    def _step_locked(self) -> None:  # holds: _step_lock
         with self._pushed_lock:
             self._pushed = 0  # this step consumes all pushed rows
         for ep in self.inputs.values():

@@ -28,6 +28,8 @@ import socketserver
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
 
 class MiniKafkaBroker:
     """Line-JSON TCP broker: topics are append-only lists of byte messages;
@@ -70,6 +72,7 @@ class MiniKafkaBroker:
         self.address = f"mini://{self.host}:{self.port}"
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True, name="minikafka")
+        _tsan_hook(self)
 
     def _handle(self, req: dict) -> dict:
         op = req.get("op")
@@ -141,9 +144,10 @@ class _Conn:
         self.sock = None
         self.rfile = None
         self._connect()
+        _tsan_hook(self)
 
-    def _connect(self) -> None:
-        self.close()
+    def _connect(self) -> None:  # holds: lock
+        self._close_locked()
         self.sock = socket.create_connection(self.addr,
                                              timeout=self.timeout_s)
         self.sock.settimeout(self.timeout_s)  # read timeout
@@ -152,16 +156,21 @@ class _Conn:
     def configure_retry(self, timeout_s: Optional[float] = None,
                         retries: Optional[int] = None,
                         backoff_s: Optional[float] = None) -> None:
-        if timeout_s is not None:
-            self.timeout_s = float(timeout_s)
-            if self.sock is not None:
-                self.sock.settimeout(self.timeout_s)
-        if retries is not None:
-            self.max_retries = int(retries)
-        if backoff_s is not None:
-            self.backoff_s = float(backoff_s)
+        # under the connection lock: the reader thread may be mid-request
+        # when the controller applies its transport knobs at endpoint
+        # wiring (found by tools/check_concurrency.py C001 — sock is
+        # claimed lock(lock))
+        with self.lock:
+            if timeout_s is not None:
+                self.timeout_s = float(timeout_s)
+                if self.sock is not None:
+                    self.sock.settimeout(self.timeout_s)
+            if retries is not None:
+                self.max_retries = int(retries)
+            if backoff_s is not None:
+                self.backoff_s = float(backoff_s)
 
-    def _roundtrip(self, payload: bytes) -> bytes:
+    def _roundtrip(self, payload: bytes) -> bytes:  # holds: lock
         if self.sock is None:
             self._connect()
         self.sock.sendall(payload)
@@ -192,7 +201,7 @@ class _Conn:
                     break
                 except (ConnectionError, socket.timeout, OSError) as e:
                     last = e
-                    self.close()
+                    self._close_locked()
             else:
                 raise ConnectionError(
                     f"minikafka broker {self.addr} unreachable after "
@@ -203,6 +212,12 @@ class _Conn:
         return resp
 
     def close(self) -> None:
+        """Public close: serialized against an in-flight request (waits
+        out its retry loop rather than yanking the socket mid-read)."""
+        with self.lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:  # holds: lock
         for f in (self.rfile, self.sock):
             if f is not None:
                 try:
@@ -230,6 +245,7 @@ class MiniConsumer:
         self.topics = list(topics)
         self.group = group_id
         self.conn = _Conn(bootstrap_servers)
+        _tsan_hook(self)
 
     @property
     def retries(self) -> int:
@@ -273,6 +289,7 @@ class MiniProducer:
         self.conn = _Conn(bootstrap_servers)
         self._pending: List[Tuple[str, bytes]] = []
         self.lock = threading.Lock()
+        _tsan_hook(self)
 
     @property
     def retries(self) -> int:

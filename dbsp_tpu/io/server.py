@@ -18,6 +18,7 @@ from urllib.parse import parse_qs, urlparse
 from dbsp_tpu.io.controller import Controller
 from dbsp_tpu.io.format import INPUT_FORMATS, OUTPUT_FORMATS
 from dbsp_tpu.obs import export as obs_export
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
 
 
 class CircuitServer:
@@ -45,7 +46,9 @@ class CircuitServer:
                     workers=getattr(runtime, "workers", 1),
                     registry=obs.registry if obs is not None else None)
         self.analysis_findings = findings or []
-        self._outputs: Dict[str, list] = {}
+        # last served /profile and /lineage reports (for /debug)
+        self._last_profile: Optional[dict] = None
+        self._last_lineage: Optional[dict] = None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -259,6 +262,7 @@ class CircuitServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        _tsan_hook(self)
 
     def status_dict(self) -> dict:
         """The /status body: serving state + mode + SLO health in one
@@ -296,8 +300,7 @@ class CircuitServer:
         from dbsp_tpu.obs import lineage
 
         kwargs = {} if max_rows is None else {"max_rows": max_rows}
-        with self.controller._step_lock:
-            self.controller._flush_driver_locked()
+        with self.controller.quiesce():
             report = lineage.slice_pipeline(
                 self.controller.handle, self.controller.catalog, view, key,
                 **kwargs)
@@ -335,8 +338,7 @@ class CircuitServer:
         slices in the existing ``/trace`` window; the registry receives
         the gated per-node metric families only when a MEASURED profile
         actually runs (opprofile.export_node_metrics)."""
-        with self.controller._step_lock:
-            self.controller._flush_driver_locked()
+        with self.controller.quiesce():
             report = self.profiler.profile_report(
                 ticks=ticks,
                 spans=self.obs.spans if self.obs is not None else None,

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
 ChunkCallback = Callable[[bytes], None]
 
 
@@ -70,6 +72,7 @@ class FileInputTransport(InputTransport):
         self._paused = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        _tsan_hook(self)
 
     def start(self, on_chunk, on_eoi) -> None:
         def run():
@@ -110,6 +113,7 @@ class FileOutputTransport(OutputTransport):
     def __init__(self, path: str):
         self._f = open(path, "ab")
         self._lock = threading.Lock()
+        _tsan_hook(self)
 
     def write(self, data: bytes) -> None:
         with self._lock:
@@ -165,6 +169,7 @@ class KafkaInputTransport(InputTransport):
         self._consumer = None
         self._retry_cfg: dict = {}
         self.error: str | None = None  # terminal transport failure, if any
+        _tsan_hook(self)
 
     def configure_retry(self, timeout_s: float = 10.0, retries: int = 5,
                         backoff_s: float = 0.05) -> None:
@@ -263,6 +268,7 @@ class KafkaOutputTransport(OutputTransport):
                 {"bootstrap.servers": brokers})
         else:
             self._producer = self._mod.KafkaProducer(bootstrap_servers=brokers)
+        _tsan_hook(self)
 
     def configure_retry(self, timeout_s: float = 10.0, retries: int = 5,
                         backoff_s: float = 0.05) -> None:

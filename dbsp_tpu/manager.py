@@ -53,6 +53,8 @@ from typing import Dict, Optional
 
 import jax.numpy as jnp
 
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
 DTYPES = {"int32": jnp.int32, "int64": jnp.int64, "float32": jnp.float32}
 
 def _build_fn(program: dict):
@@ -111,6 +113,7 @@ class Pipeline:
         self.fallback_reason: Optional[str] = None
         # tick restored from a checkpoint at deploy (None = fresh start)
         self.restored_tick: Optional[int] = None
+        _tsan_hook(self)
 
     def compile_and_start(self, _allow_restore: bool = True) -> None:
         from dbsp_tpu.circuit import Runtime
@@ -291,6 +294,7 @@ class _CompilerService:
         self.q: "queue.Queue" = queue.Queue()
         self.thread = threading.Thread(target=self._work, daemon=True,
                                        name="compiler-service")
+        _tsan_hook(self)
         self.thread.start()
 
     def submit(self, name: str, version: int) -> None:
@@ -511,8 +515,17 @@ class PipelineManager:
                         self._json(p.describe())
                     elif len(parts) == 4 and parts[1] == "pipelines" and \
                             parts[3] == "shutdown":
-                        mgr.pipelines[parts[2]].stop()
-                        self._json(mgr.pipelines[parts[2]].describe())
+                        # look up under the lock (a concurrent DELETE
+                        # mutates the dict); stop() itself runs outside
+                        # it — it joins the circuit thread, and holding
+                        # the manager lock for that would stall every
+                        # other route for up to the join timeout
+                        with mgr.lock:
+                            p = mgr.pipelines.get(parts[2])
+                        if p is None:
+                            return self._json({"error": "not found"}, 404)
+                        p.stop()
+                        self._json(p.describe())
                     elif len(parts) == 4 and parts[1] == "pipelines" and \
                             parts[3] == "checkpoint":
                         with mgr.lock:
@@ -542,6 +555,7 @@ class PipelineManager:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        _tsan_hook(self)
 
     # -- program lifecycle ---------------------------------------------------
     @staticmethod
@@ -641,7 +655,7 @@ class PipelineManager:
         return {"health": worst, "pipelines": detail}
 
     # -- persistence / serving -----------------------------------------------
-    def _persist(self):
+    def _persist(self):  # holds: lock
         if self.storage_path:
             with open(self.storage_path, "w") as f:
                 json.dump(self.programs, f)
@@ -652,7 +666,11 @@ class PipelineManager:
         self._thread.start()
 
     def stop(self):
-        for p in self.pipelines.values():
+        # snapshot under the lock; stopping (which joins circuit threads)
+        # happens outside it so in-flight routes are not stalled
+        with self.lock:
+            pipes = list(self.pipelines.values())
+        for p in pipes:
             if p.status == "running":
                 p.stop()
         self.compiler.stop()

@@ -57,6 +57,8 @@ import time
 from collections import deque
 from typing import Deque, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
 __all__ = [
     "FlightRecorder", "CompiledFlightSource", "HostFlightSource",
     "ControllerFlightSource",
@@ -73,6 +75,7 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self.dropped = 0  # events aged out of the ring
+        _tsan_hook(self)
 
     def record(self, kind: str, t_ns: Optional[int] = None, **fields) -> int:
         """Append one event; returns its ``seq``. The hot-path cost budget
@@ -248,6 +251,7 @@ class CompiledFlightSource:
         self._consolidate_seen: Dict[str, int] = {}
         # synthetic wall anchors for batched samples (see trace_slice)
         self._clock_ns: Optional[int] = None
+        _tsan_hook(self)
 
     def poll(self) -> None:
         ch = self.ch
@@ -309,7 +313,7 @@ class CompiledFlightSource:
             self._rows_moved_seen = max(self._rows_moved_seen, moved)
             self._poll_consolidate()
 
-    def _poll_consolidate(self) -> None:
+    def _poll_consolidate(self) -> None:  # holds: _lock
         from dbsp_tpu.zset import kernels as zkernels
 
         delta = {}
@@ -336,29 +340,36 @@ class ControllerFlightSource:
     def __init__(self, controller, flight: FlightRecorder):
         self.controller = controller
         self.flight = flight
+        # poll() runs concurrently — PipelineObs.watch is both a scrape
+        # collector (HTTP threads) and a controller monitor (circuit
+        # thread); unlocked transition tracking would double-record
+        # transport events (found by tools/check_concurrency.py C001)
+        self._lock = threading.Lock()
         self._errors_seen: Dict[str, str] = {}
+        _tsan_hook(self)
 
     def poll(self) -> None:
         try:
             stats = self.controller.stats()
         except Exception:
             return  # a mid-teardown race must not kill the watch pass
-        for section in ("inputs", "outputs"):
-            for name, ep in stats.get(section, {}).items():
-                err = ep.get("error")
-                key = f"{section}/{name}"
-                prev = self._errors_seen.get(key)
-                if err and prev != err:
-                    self._errors_seen[key] = err
-                    self.flight.record("transport", endpoint=name,
-                                       error=str(err)[:200])
-                elif not err and prev:
-                    # RECOVERY transition: a transient sink blip (the
-                    # pending-batch retry delivered) must not leave the
-                    # pipeline latched degraded forever
-                    del self._errors_seen[key]
-                    self.flight.record("transport", endpoint=name,
-                                       recovered=True)
+        with self._lock:
+            for section in ("inputs", "outputs"):
+                for name, ep in stats.get(section, {}).items():
+                    err = ep.get("error")
+                    key = f"{section}/{name}"
+                    prev = self._errors_seen.get(key)
+                    if err and prev != err:
+                        self._errors_seen[key] = err
+                        self.flight.record("transport", endpoint=name,
+                                           error=str(err)[:200])
+                    elif not err and prev:
+                        # RECOVERY transition: a transient sink blip (the
+                        # pending-batch retry delivered) must not leave
+                        # the pipeline latched degraded forever
+                        del self._errors_seen[key]
+                        self.flight.record("transport", endpoint=name,
+                                           recovered=True)
 
 
 class HostFlightSource:
@@ -396,6 +407,7 @@ class HostFlightSource:
         self._merged_seen = self._merged_rows()
         self._exch_seen = self._exchange_totals()
         self._wm_lag_seen: Dict[int, float] = {}
+        _tsan_hook(self)
         circuit.register_scheduler_event_handler(self._on_event)
 
     @staticmethod

@@ -34,6 +34,8 @@ import re
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
 # final name segment must be a unit (prometheus naming conventions; "total"
 # is the counter suffix, "info" the build-info idiom; "timestamp" covers
 # event-time domains whose unit the engine cannot know)
@@ -106,6 +108,16 @@ class _Child:
         self.count = 0
         self.buckets = [0] * nbuckets if nbuckets else None
 
+    def snapshot(self) -> "_Child":
+        """Deep-enough copy for consistent reads; take under the owning
+        metric's lock (samples()/quantile() both go through this — one
+        copy site, so a new field cannot be copied in one and torn in
+        the other)."""
+        s = _Child()
+        s.value, s.sum, s.count = self.value, self.sum, self.count
+        s.buckets = list(self.buckets) if self.buckets is not None else None
+        return s
+
 
 class Metric:
     """Base: a named family of children keyed by label values."""
@@ -124,11 +136,17 @@ class Metric:
         self._lock = threading.Lock()
 
     def _child(self, key: Tuple[str, ...]) -> _Child:
-        c = self._children.get(key)
-        if c is None:
-            with self._lock:
-                c = self._children.setdefault(key, self._new_child())
-        return c
+        # fully under the lock — no lock-free fast path. The old
+        # check-then-act (a naked dict read before a locked setdefault)
+        # could hand out a child that clear_children() had just detached,
+        # silently dropping updates into a dead cell; the schema claims
+        # _children as lock(_lock), and these are control-plane metrics
+        # where an uncontended acquire costs nothing measurable.
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = self._new_child()
+            return c
 
     def _new_child(self) -> _Child:
         return _Child()
@@ -160,13 +178,8 @@ class Metric:
         observe()/inc() renders internally consistent values (sum/count/
         buckets from one moment), never torn mid-update state."""
         with self._lock:
-            out = []
-            for key, c in self._children.items():
-                s = _Child()
-                s.value, s.sum, s.count = c.value, c.sum, c.count
-                s.buckets = list(c.buckets) if c.buckets is not None else None
-                out.append((key, s))
-            return out
+            return [(key, c.snapshot())
+                    for key, c in self._children.items()]
 
 
 class _Bound:
@@ -290,9 +303,13 @@ class Histogram(Metric):
     def quantile(self, q: float, labels: Tuple[str, ...] = ()) -> float:
         """Estimated q-quantile (0..1) from the bucket sketch: linear
         interpolation inside the containing bucket (log buckets make the
-        relative error bounded by the bucket growth factor)."""
+        relative error bounded by the bucket growth factor). Computed
+        over a snapshot taken under the lock, like :meth:`samples` — a
+        live child mid-observe() would yield a torn count/bucket pair."""
         with self._lock:
             c = self._children.get(labels)
+            if c is not None:
+                c = c.snapshot()
         return self.quantile_of(c, q)
 
     def quantile_of(self, c: Optional[_Child], q: float) -> float:
@@ -338,14 +355,23 @@ class MetricsRegistry:
         self._metrics: Dict[str, Metric] = {}
         self._collectors: List[Callable[[], None]] = []
         self._lock = threading.Lock()
+        _tsan_hook(self)
 
     def _get_or_create(self, cls, name, help, labels, **kw) -> Metric:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, help, labels, **kw)
+                # the construction chokepoint for every metric family:
+                # instrumenting here (not in Metric.__init__) lets the
+                # whole subclass __init__ chain finish first, so the
+                # sanitizer never misreads construction as mutation
+                _tsan_hook(m)
                 return m
-        if type(m) is not cls or m.label_names != tuple(labels):
+        # under tsan the stored instance's class is the traced subclass;
+        # compare against the ORIGINAL class it instruments
+        if getattr(type(m), "__tsan_base__", type(m)) is not cls or \
+                m.label_names != tuple(labels):
             raise ValueError(
                 f"metric {name!r} re-registered as {cls.__name__}"
                 f"{tuple(labels)} but exists as {type(m).__name__}"
@@ -395,13 +421,19 @@ class MetricsRegistry:
             return sorted(self._metrics)
 
     def value(self, name: str, **labels: str) -> float:
-        """Current value of a counter/gauge child (tests)."""
+        """Current value of a counter/gauge child (tests). Goes through
+        the metric's snapshotting :meth:`Metric.samples` instead of
+        reaching into its private child dict — reading another object's
+        lock-guarded state directly is exactly what the concurrency lint
+        exists to stop."""
         m = self.get(name)
         if m is None:
             raise KeyError(name)
         key = tuple(str(labels[n]) for n in m.label_names)
-        c = m._children.get(key)
-        return c.value if c is not None else 0.0
+        for k, c in m.samples():
+            if k == key:
+                return c.value
+        return 0.0
 
 
 def fmt_value(v: float) -> str:

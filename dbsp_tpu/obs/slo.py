@@ -49,6 +49,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from dbsp_tpu.obs.flight import FlightRecorder, dominant_cause, trace_slice
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
 
 __all__ = ["SLOConfig", "SLOWatchdog", "SLO_KEYS"]
 
@@ -84,6 +85,7 @@ class SLOConfig:
         self.overflow_replays = overflow_replays
         self.window_ticks = int(window_ticks)
         self.window_s = float(window_s)
+        _tsan_hook(self)
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "SLOConfig":
@@ -165,9 +167,15 @@ class SLOWatchdog:
             dropped_c = registry.counter(
                 "dbsp_tpu_obs_flight_dropped_total",
                 "Flight-recorder events aged out of the bounded ring")
-            registry.register_collector(
-                lambda: (active_g.set(len(self._active)),
-                         dropped_c.set_total(self.flight.dropped)))
+
+            def export():  # scrape-time collector, runs on HTTP threads
+                with self._lock:
+                    n_active = len(self._active)
+                active_g.set(n_active)
+                dropped_c.set_total(self.flight.dropped)
+
+            registry.register_collector(export)
+        _tsan_hook(self)
 
     # -- evaluation ----------------------------------------------------------
     def evaluate(self) -> List[dict]:
@@ -177,7 +185,7 @@ class SLOWatchdog:
         with self._lock:
             return self._evaluate_locked()
 
-    def _evaluate_locked(self) -> List[dict]:
+    def _evaluate_locked(self) -> List[dict]:  # holds: _lock
         cfg = self.config
         new = self.flight.events(since_seq=self._seen_seq)
         if new:
@@ -284,7 +292,7 @@ class SLOWatchdog:
         return opened
 
     # -- incidents -----------------------------------------------------------
-    def _attribute(self, inc: dict, fixed_cause: Optional[str],
+    def _attribute(self, inc, fixed_cause,  # holds: _lock
                    breaching_ticks: List[dict], p50: float) -> None:
         if fixed_cause is not None:
             inc["cause"], inc["causes"] = fixed_cause, {fixed_cause: 1}
@@ -306,7 +314,7 @@ class SLOWatchdog:
         inc["window"] = window
         inc["trace"] = trace_slice(window)
 
-    def _open_incident(self, slo: str, observed: float, threshold: float,
+    def _open_incident(self, slo, observed, threshold,  # holds: _lock
                        fixed_cause: Optional[str],
                        breaching_ticks: List[dict], p50: float) -> dict:
         self._ids += 1
@@ -348,12 +356,17 @@ class SLOWatchdog:
             return out
 
     def status(self) -> str:
+        # one consistent snapshot under the lock: the latched conditions
+        # and the active set must come from the same moment, or a scrape
+        # racing evaluate() can render degraded-with-no-cause
         with self._lock:
             active = set(self._active)
+            latched = (self._fallback is not None or
+                       bool(self._transport) or
+                       self._restore_failed is not None)
         if active - set(_DEGRADED_ONLY):
             return "unhealthy"
-        if active or self._fallback is not None or self._transport or \
-                self._restore_failed is not None:
+        if active or latched:
             return "degraded"
         return "ok"
 
@@ -363,10 +376,11 @@ class SLOWatchdog:
         a latched failed-restore reason — if any. DURABLE: the watchdog
         retains it after the one-shot flight event ages out of the bounded
         ring (consumers must read it here, not rescan the ring)."""
-        fb = self._fallback
+        with self._lock:
+            fb = self._fallback
+            rf = self._restore_failed
         if fb is not None:
             return fb.get("reason")
-        rf = self._restore_failed
         if rf is not None:
             return f"restore failed: {rf.get('reason')}"
         return None

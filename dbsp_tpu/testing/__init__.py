@@ -3,17 +3,36 @@
 :mod:`dbsp_tpu.testing.faults` is the deterministic fault harness behind
 the durability acceptance tests: seeded SIGKILL-at-tick of a pipeline
 subprocess, transport connect/read failure injection, slow-consumer
-stalls, and checkpoint corruption — see README §Durability.
+stalls, checkpoint corruption, and seeded interleaving schedules — see
+README §Durability.
+
+:mod:`dbsp_tpu.testing.tsan` is the runtime concurrency sanitizer
+(``DBSP_TPU_TSAN=1``): instrumented locks + attribute tracing over the
+classes registered in ``dbsp_tpu.concurrency.CONCURRENCY_SCHEMA``,
+enforcing declared guards with Eraser-style lockset inference and
+lock-order inversion detection — see README §Static analysis.
+
+Attribute access is lazy (PEP 562): the serving modules import
+``dbsp_tpu.testing.tsan`` at module top for their construction hooks,
+and an eager ``faults`` import here would cycle back through
+``dbsp_tpu.io.transport``.
 """
 
-from dbsp_tpu.testing.faults import (FaultPlan, StallingOutputTransport,
-                                     corrupt_checkpoint, read_deltas,
-                                     read_status, run_child,
-                                     spawn_child, transport_chaos,
-                                     wait_for_tick)
-
-__all__ = [
+_FAULTS_EXPORTS = (
     "FaultPlan", "StallingOutputTransport", "corrupt_checkpoint",
     "read_deltas", "read_status", "run_child", "spawn_child",
-    "transport_chaos", "wait_for_tick",
-]
+    "transport_chaos", "wait_for_tick", "InterleaveSchedule",
+)
+
+__all__ = list(_FAULTS_EXPORTS) + ["faults", "tsan"]
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("faults", "tsan"):
+        return importlib.import_module(f"dbsp_tpu.testing.{name}")
+    if name in _FAULTS_EXPORTS:
+        faults = importlib.import_module("dbsp_tpu.testing.faults")
+        return getattr(faults, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

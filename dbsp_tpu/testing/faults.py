@@ -30,6 +30,13 @@ exactly (the harness is the proof side of README §Durability):
   bytes in the CURRENT generation's manifest or a seeded-chosen blob;
   restore must fall back to the previous generation and surface exactly
   one SLO-visible ``restore`` incident.
+
+* **Seeded interleavings** — :class:`InterleaveSchedule` injects sleeps
+  at the yield points the concurrency sanitizer's instrumented locks
+  expose (``dbsp_tpu.testing.tsan.set_schedule``), widening the thread
+  schedules a hammer test explores. Deterministic per seed: the decision
+  SEQUENCE (which acquire/release yields) replays exactly; what the OS
+  scheduler does with each yield is the explored dimension.
 """
 
 from __future__ import annotations
@@ -42,6 +49,7 @@ import random
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -221,6 +229,57 @@ class StallingOutputTransport(OutputTransport):
             self.stalls += 1
             time.sleep(self.stall_s)
         self.chunks.append(data)
+
+
+# ---------------------------------------------------------------------------
+# Seeded interleaving schedules (yield-point injection at traced locks)
+# ---------------------------------------------------------------------------
+
+
+class InterleaveSchedule:
+    """Seeded yield-point injector for the runtime concurrency sanitizer.
+
+    Installed via ``tsan.set_schedule`` (or ``tsan.session(schedule=...)``)
+    it is called at every instrumented lock acquire/release with the
+    event kind and the lock's ``Class.attr`` name. With probability
+    ``rate`` (decided by a seeded RNG, so the decision sequence is
+    deterministic) it sleeps ``sleep_s`` — long enough that any runnable
+    peer thread gets scheduled into the window the yield opens. This is
+    the deliberate-interleaving half of ThreadSanitizer's recipe: races
+    that need a narrow preemption window (check-then-act on a shared
+    field, a reader between a clear and a refill) reproduce under the
+    widened schedule instead of once a quarter in production.
+
+    ``only`` restricts injection to lock names containing any of the
+    given substrings (e.g. ``("Controller.",)``); ``max_yields`` bounds
+    total injected sleeps so a hammer test's duration stays bounded.
+    """
+
+    def __init__(self, seed: int = 1, rate: float = 0.25,
+                 sleep_s: float = 0.002, max_yields: int = 2000,
+                 only: Optional[tuple] = None):
+        self.rng = random.Random(seed)
+        self.rate = float(rate)
+        self.sleep_s = float(sleep_s)
+        self.max_yields = int(max_yields)
+        self.only = tuple(only) if only else None
+        self.yields = 0
+        self.decisions = 0
+        self._lock = threading.Lock()
+
+    def yield_point(self, hook: str, lock_name: str) -> None:
+        if self.only is not None and \
+                not any(s in lock_name for s in self.only):
+            return
+        with self._lock:
+            self.decisions += 1
+            if self.yields >= self.max_yields:
+                return
+            fire = self.rng.random() < self.rate
+            if fire:
+                self.yields += 1
+        if fire:
+            time.sleep(self.sleep_s)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,585 @@
+"""Runtime concurrency sanitizer: declared-guard enforcement + Eraser
+lockset inference + lock-order inversion detection.
+
+The runtime half of the concurrency sanitizer (the static half is
+``tools/check_concurrency.py``). Under ``DBSP_TPU_TSAN=1`` — or inside a
+:func:`session` — every serving-plane object registered in
+:data:`dbsp_tpu.concurrency.CONCURRENCY_SCHEMA` is instrumented at
+construction (``maybe_instrument`` hooks at the end of each ``__init__``):
+
+* its ``threading.Lock``/``RLock`` fields are wrapped in
+  :class:`TracedLock` — acquire/release maintain a per-thread held-lock
+  set, feed the global lock-ORDER graph (an A->B acquisition observed
+  after a B->A acquisition is an inversion violation, no deadlock
+  required — ThreadSanitizer's deadlock detector idiom, Serebryany &
+  Iskhodzhanov, WBIA'09), and call the installed
+  :class:`~dbsp_tpu.testing.faults.InterleaveSchedule` yield points so a
+  seeded fuzz run explores more interleavings;
+* its class is swapped for a generated subclass whose
+  ``__getattribute__``/``__setattr__`` trace the schema'd fields and
+  enforce each field's declared guard:
+
+  ====================  ====================================================
+  ``lock(L)``           every access must hold the instance's ``L``
+  ``writelock(L)``      every WRITE must hold ``L``
+  ``owner``             all accesses from one thread (recorded at first
+                        access after instrumentation)
+  ``lockset``           Eraser (Savage et al., TOCS'97) over writes: once a
+                        second thread writes, the intersection of lock sets
+                        held across all writes must stay non-empty
+  ``immutable``         no rebinding after construction
+  ``gil-atomic``        exempt (the schema carries the invariant)
+  ====================  ====================================================
+
+  ``lock``/``writelock`` fields additionally run the Eraser candidate-set
+  bookkeeping as evidence: every violation report carries the lockset that
+  protected the field so far, the guard the schema declared, the accessing
+  thread, and a trimmed stack.
+
+Violations are collected process-wide; :func:`check` raises
+:class:`TsanViolations` when any were recorded — the structured report
+that fails tests. Violations are NOT waivable at runtime: fix the race or
+change the schema claim (``# concurrency: ok`` only waives static
+findings).
+
+Typical test shape::
+
+    from dbsp_tpu.testing import tsan
+
+    with tsan.session() as report:
+        ... build pipeline, hammer it from threads ...
+    assert report.violations == []        # or tsan.check() to raise
+
+Overhead: attribute tracing costs one dict lookup on traced-field access
+of instrumented instances only; with the sanitizer disabled the
+construction hooks are a single module-flag check and instances are left
+untouched, so production pays nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+from dbsp_tpu.concurrency import CONCURRENCY_SCHEMA, Guard, parse_guard
+
+__all__ = [
+    "TracedLock", "TsanViolations", "enable", "disable", "enabled",
+    "session", "instrument", "maybe_instrument", "violations", "check",
+    "reset", "set_schedule", "dryrun",
+]
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+# -- process-wide sanitizer state (its own untraced lock; RLock because
+# the access handler holds it across _record/_eraser calls) ----------------
+_state_lock = threading.RLock()
+_ACTIVE = os.environ.get("DBSP_TPU_TSAN", "0") not in ("", "0")
+_VIOLATIONS: List[dict] = []
+_SEEN: Set[Tuple] = set()          # dedup key per (kind, cls, field, ...)
+_ORDER: Dict[Tuple[str, str], List[str]] = {}   # (a, b) -> example stack
+_SCHEDULE = None                   # faults.InterleaveSchedule or None
+_tls = threading.local()
+
+
+def _held() -> List["TracedLock"]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _stack(limit: int = 14) -> List[str]:
+    out = []
+    for f in traceback.extract_stack(limit=limit + 4)[:-3]:
+        if f.filename.endswith(("tsan.py",)):
+            continue
+        out.append(f"{os.path.basename(f.filename)}:{f.lineno} {f.name}")
+    return out[-limit:]
+
+
+def _record(kind: str, dedup_key: Tuple, **fields) -> None:
+    with _state_lock:
+        if dedup_key in _SEEN:
+            for v in _VIOLATIONS:
+                if v.get("_key") == dedup_key:
+                    v["count"] += 1
+                    break
+            return
+        _SEEN.add(dedup_key)
+        _VIOLATIONS.append(dict(kind=kind, count=1, _key=dedup_key,
+                                thread=threading.current_thread().name,
+                                stack=_stack(), **fields))
+
+
+class TsanViolations(AssertionError):
+    """Raised by :func:`check`; carries the structured reports."""
+
+    def __init__(self, reports: List[dict]):
+        self.reports = reports
+        lines = [f"{len(reports)} concurrency violation(s):"]
+        for r in reports:
+            lines.append(
+                f"  [{r['kind']}] {r.get('cls')}.{r.get('field')} "
+                f"guard={r.get('guard')} thread={r['thread']} "
+                f"x{r['count']}")
+            for s in r.get("stack", [])[-4:]:
+                lines.append(f"      {s}")
+        super().__init__("\n".join(lines))
+
+
+# ---------------------------------------------------------------------------
+# traced locks
+# ---------------------------------------------------------------------------
+
+
+class TracedLock:
+    """Wraps a ``threading.Lock``/``RLock``: held-set bookkeeping, lock-
+    order graph edges, and yield-point injection for the interleaving
+    fuzzer. Context-manager compatible with the wrapped lock."""
+
+    __slots__ = ("real", "name")
+
+    def __init__(self, real, name: str):
+        self.real = real
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        sched = _SCHEDULE
+        if sched is not None:
+            sched.yield_point("acquire", self.name)
+        ok = self.real.acquire(blocking, timeout)
+        if ok:
+            held = _held()
+            if not any(lk is self for lk in held):  # re-entrant: no edges
+                self._edges(held)
+            held.append(self)
+        return ok
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self.real.release()
+        sched = _SCHEDULE
+        if sched is not None:
+            sched.yield_point("release", self.name)
+
+    def _edges(self, held: List["TracedLock"]) -> None:
+        for prev in held:
+            if prev is self or prev.name == self.name:
+                continue  # same class.attr on two instances: ambiguous
+            edge = (prev.name, self.name)
+            with _state_lock:
+                known = edge in _ORDER
+                if not known:
+                    _ORDER[edge] = _stack()
+                inverse = _ORDER.get((self.name, prev.name))
+            if inverse is not None:
+                _record(
+                    "lock-order-inversion",
+                    ("order", self.name, prev.name) if
+                    self.name < prev.name else ("order", prev.name,
+                                                self.name),
+                    cls=self.name.split(".")[0],
+                    field=self.name.split(".", 1)[1],
+                    guard=f"{prev.name} -> {self.name} inverts an "
+                          f"observed {self.name} -> {prev.name}",
+                    inverse_stack=inverse[-6:])
+
+    def locked(self) -> bool:
+        return self.real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TracedLock {self.name}>"
+
+
+# ---------------------------------------------------------------------------
+# attribute tracing
+# ---------------------------------------------------------------------------
+
+
+def _mro_guards(base: type) -> Dict[str, Guard]:
+    out: Dict[str, Guard] = {}
+    for klass in reversed(base.__mro__):
+        entry = CONCURRENCY_SCHEMA.get(klass.__name__)
+        if entry:
+            for attr, value in entry.items():
+                out[attr] = parse_guard(value)
+    return out
+
+
+def _on_access(obj, field: str, guard: Guard, write: bool) -> None:
+    if getattr(_tls, "in_handler", False):
+        return
+    _tls.in_handler = True
+    try:
+        st = object.__getattribute__(obj, "__tsan__")
+        held = _held()
+        held_names = tuple(lk.name for lk in held)
+        cls = type(obj).__tsan_base__.__name__
+        tname = threading.current_thread().name
+        # the detector's own per-field state (owner, Eraser candidate
+        # set) is shared across the very threads it watches — mutate it
+        # only under the sanitizer lock, or a check-then-act race INSIDE
+        # the race detector drops violations (two first-writers both
+        # seeding cand, two first-accessors both claiming owner)
+        with _state_lock:
+            fs = st["fields"].setdefault(field, {
+                "owner": None, "writers": set(), "cand": None})
+            _check_access(obj, st, fs, field, guard, write, held,
+                          held_names, cls, tname)
+    finally:
+        _tls.in_handler = False
+
+
+def _check_access(obj, st, fs, field, guard, write, held, held_names,
+                  cls, tname):  # holds: _state_lock
+    if guard.kind in ("lock", "writelock"):
+        if guard.kind == "lock" or write:
+            target = st["locks"].get(guard.lock)
+            if target is not None and \
+                    not any(lk is target for lk in held):
+                _record(
+                    "declared-guard",
+                    ("guard", cls, field, write, tname),
+                    cls=cls, field=field,
+                    guard=f"{guard.kind}({guard.lock})",
+                    access="write" if write else "read",
+                    held=held_names,
+                    lockset=sorted(fs["cand"] or ()))
+        # Eraser evidence channel over writes
+        if write:
+            _eraser(fs, {lk.name for lk in held}, tname, cls, field,
+                    f"{guard.kind}({guard.lock})")
+    elif guard.kind == "owner":
+        if fs["owner"] is None:
+            fs["owner"] = tname
+        elif fs["owner"] != tname:
+            _record("owner-violation",
+                    ("owner", cls, field, tname),
+                    cls=cls, field=field, guard="owner",
+                    access="write" if write else "read",
+                    first_owner=fs["owner"], held=held_names)
+    elif guard.kind == "lockset":
+        if write:
+            _eraser(fs, {lk.name for lk in held}, tname, cls, field,
+                    "lockset")
+    elif guard.kind == "immutable":
+        if write:
+            _record("immutable-write",
+                    ("immutable", cls, field),
+                    cls=cls, field=field, guard="immutable",
+                    held=held_names)
+
+
+def _eraser(fs: dict, held_names: Set[str], tname: str, cls: str,
+            field: str, guard: str) -> None:  # holds: _state_lock
+    """Eraser state machine over writes: candidate lockset = intersection
+    of lock sets held at every write; empty with >1 writer thread =
+    violation. Runs under ``_state_lock`` — the candidate set is shared
+    across the threads being watched."""
+    fs["writers"].add(tname)
+    if fs["cand"] is None:
+        fs["cand"] = set(held_names)
+    else:
+        fs["cand"] &= held_names
+    if len(fs["writers"]) > 1 and not fs["cand"]:
+        _record("eraser-lockset",
+                ("eraser", cls, field),
+                cls=cls, field=field, guard=guard,
+                access="write", held=tuple(sorted(held_names)),
+                writers=sorted(fs["writers"]),
+                lockset=[])
+
+
+_TRACED_CACHE: Dict[Tuple, type] = {}
+
+
+def _traced_class(base: type, guards: Dict[str, Guard],
+                  cache_key: Tuple) -> type:
+    cached = _TRACED_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    # fields needing read tracing vs write tracing
+    read_fields = {f: g for f, g in guards.items()
+                   if g.kind in ("lock", "owner")}
+    write_fields = {f: g for f, g in guards.items()
+                    if g.kind in ("lock", "writelock", "owner", "lockset",
+                                  "immutable")}
+
+    class Traced(base):
+        __tsan_base__ = base
+
+        def __getattribute__(self, name):
+            g = read_fields.get(name)
+            if g is not None and _ACTIVE:
+                _on_access(self, name, g, write=False)
+            return object.__getattribute__(self, name)
+
+        def __setattr__(self, name, value):
+            g = write_fields.get(name)
+            if g is not None and _ACTIVE:
+                _on_access(self, name, g, write=True)
+            object.__setattr__(self, name, value)
+
+    Traced.__name__ = base.__name__
+    Traced.__qualname__ = base.__qualname__
+    Traced.__module__ = base.__module__
+    _TRACED_CACHE[cache_key] = Traced
+    return Traced
+
+
+def instrument(obj, guards: Optional[Dict[str, str]] = None):
+    """Instrument one instance: wrap its lock fields in
+    :class:`TracedLock` and swap in the traced subclass. ``guards``
+    overrides the schema (tests); by default the MRO-merged
+    ``CONCURRENCY_SCHEMA`` entry applies. No-op if already traced or the
+    class has no schema entry."""
+    base = type(obj)
+    if getattr(base, "__tsan_base__", None) is not None:
+        return obj
+    if guards is not None:
+        parsed = {f: parse_guard(v) for f, v in guards.items()}
+        cache_key = (base, tuple(sorted(guards.items())))
+    else:
+        if not any(k.__name__ in CONCURRENCY_SCHEMA
+                   for k in base.__mro__):
+            return obj
+        parsed = _mro_guards(base)
+        cache_key = (base,)
+
+    # wrap lock-valued fields (before the class swap: these setattrs must
+    # not themselves be traced as writes)
+    locks: Dict[str, TracedLock] = {}
+    d = object.__getattribute__(obj, "__dict__")
+    for attr, value in list(d.items()):
+        if isinstance(value, _LOCK_TYPES):
+            tl = TracedLock(value, f"{base.__name__}.{attr}")
+            object.__setattr__(obj, attr, tl)
+            locks[attr] = tl
+        elif isinstance(value, TracedLock):
+            locks[attr] = value
+    object.__setattr__(obj, "__tsan__", {"locks": locks, "fields": {}})
+    obj.__class__ = _traced_class(base, parsed, cache_key)
+    return obj
+
+
+def maybe_instrument(obj) -> None:
+    """Construction hook the serving classes call at the end of
+    ``__init__``: a no-op (one flag check) unless the sanitizer is on."""
+    if _ACTIVE:
+        instrument(obj)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle / reporting
+# ---------------------------------------------------------------------------
+
+
+def enable() -> None:
+    global _ACTIVE
+    _ACTIVE = True
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = False
+
+
+def enabled() -> bool:
+    return _ACTIVE
+
+
+def set_schedule(schedule) -> None:
+    """Install (or clear, with ``None``) the seeded interleaving schedule
+    whose ``yield_point(hook, lock_name)`` runs at every instrumented
+    acquire/release (``faults.InterleaveSchedule``)."""
+    global _SCHEDULE
+    _SCHEDULE = schedule
+
+
+def reset() -> None:
+    with _state_lock:
+        _VIOLATIONS.clear()
+        _SEEN.clear()
+        _ORDER.clear()
+
+
+def violations() -> List[dict]:
+    with _state_lock:
+        return [dict(v) for v in _VIOLATIONS]
+
+
+def check() -> None:
+    """Raise :class:`TsanViolations` when any violation was recorded —
+    the structured report that fails tests."""
+    v = violations()
+    if v:
+        raise TsanViolations(v)
+
+
+class _Session:
+    def __init__(self):
+        self.violations: List[dict] = []
+
+    def refresh(self) -> List[dict]:
+        self.violations = violations()
+        return self.violations
+
+
+class session:
+    """``with tsan.session() as report:`` — enable + reset around a
+    block; ``report.violations`` holds the structured findings at exit
+    (the sanitizer is disabled again, instrumented objects go inert).
+    ``schedule`` installs a seeded interleaving schedule for the block."""
+
+    def __init__(self, schedule=None):
+        self.schedule = schedule
+        self.report = _Session()
+        self._was_active = False
+
+    def __enter__(self) -> _Session:
+        self._was_active = _ACTIVE
+        reset()
+        enable()
+        set_schedule(self.schedule)
+        return self.report
+
+    def __exit__(self, *exc):
+        self.report.refresh()
+        set_schedule(None)
+        # restore, don't force-disable: a DBSP_TPU_TSAN=1 run must stay
+        # armed after the first session-using test exits
+        if not self._was_active:
+            disable()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# smoke dryrun (tools/lint_all.py `concurrency` front)
+# ---------------------------------------------------------------------------
+
+
+def dryrun(seconds: float = 2.0) -> dict:
+    """TSAN smoke: a small served host pipeline hammered from threads
+    must come out race-clean, and a seeded unlocked write must be CAUGHT
+    (non-vacuity). Raises on either failing; returns a summary dict."""
+    import queue as _queue
+    import time
+
+    # NO global jax.config mutation here: tier-1 runs this in-process
+    # (tests/test_concurrency.py) where flipping the platform would leak
+    # into every later test; the CPU pin comes from the caller's
+    # environment (conftest / lint_all's subprocess / __main__ below)
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.obs import PipelineObs
+    from dbsp_tpu.operators import add_input_zset
+
+    with session() as report:
+        def build(c):
+            s, h = add_input_zset(c, [jnp.int64], [jnp.int64])
+            return h, s.integrate().output()
+
+        handle, (h, out) = Runtime.init_circuit(1, build)
+        catalog = Catalog()
+        catalog.register_input("t", h, (jnp.int64, jnp.int64))
+        catalog.register_output("v", out, ())
+        obs = PipelineObs(name="tsan-dryrun")
+        ctl = Controller(handle, catalog, ControllerConfig(
+            min_batch_records=1, flush_interval_s=0.01))
+        obs.attach_circuit(handle.circuit)
+        obs.attach_controller(ctl)
+        ctl.start()
+        errors: "_queue.Queue" = _queue.Queue()
+        stop = threading.Event()
+
+        def pusher():
+            i = 0
+            while not stop.is_set():
+                try:  # catalog rows are ((key..., val...), weight) pairs
+                    ctl.push("t", [((i, 1), 1)])
+                except Exception as e:  # noqa: BLE001
+                    errors.put(e)
+                    return
+                i += 1
+                time.sleep(0.002)
+
+        def watcher():
+            while not stop.is_set():
+                try:
+                    ctl.stats()
+                    obs.watch()
+                    obs.slo.status_dict()
+                except Exception as e:  # noqa: BLE001
+                    errors.put(e)
+                    return
+                time.sleep(0.003)
+
+        threads = [threading.Thread(target=pusher),
+                   threading.Thread(target=watcher),
+                   threading.Thread(target=watcher)]
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        steps = ctl.steps
+        ctl.stop()
+        if not errors.empty():
+            raise RuntimeError(f"dryrun worker died: {errors.get()}")
+        if steps == 0:
+            raise RuntimeError(
+                "dryrun circuit loop never stepped — the serving thread "
+                "died (the sanitizer result would be vacuous)")
+    clean = list(report.violations)
+
+    # non-vacuity: a seeded unlocked write MUST be caught
+    class Racy:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.n = 0
+
+    with session() as report2:
+        r = instrument(Racy(), guards={"lock": "immutable",
+                                       "n": "writelock(lock)"})
+        with r.lock:
+            r.n += 1   # guarded write: fine
+        r.n += 1       # unguarded write: the seeded defect
+
+    caught = [v for v in report2.violations
+              if v["kind"] == "declared-guard" and v["field"] == "n"]
+    if clean:
+        raise TsanViolations(clean)
+    if not caught:
+        raise AssertionError(
+            "tsan dryrun: the seeded unlocked write was NOT caught — "
+            "the sanitizer has rotted")
+    summary = {"clean_pipeline_violations": 0,
+               "seeded_defect_caught": True}
+    print(f"tsan dryrun: ok {summary}")
+    return summary
+
+
+if __name__ == "__main__":
+    # standalone CLI: pin the platform via env BEFORE jax imports (own
+    # process only — the in-process callers inherit their host's config)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    dryrun()

@@ -1,0 +1,647 @@
+"""Concurrency sanitizer (ISSUE 11): lock-discipline static analysis +
+runtime race detection over the serving plane.
+
+Acceptance coverage:
+  * the real tree verifies clean against CONCURRENCY_SCHEMA (both
+    directions), with the documented ``_step_lock -> _pushed_lock`` order
+    present and acyclic;
+  * seeded-defect EXACTNESS: deleting the ``with self._pushed_lock:``
+    around note_pushed's writes turns the static pass red with exactly
+    those findings (waivable only via ``# concurrency: ok``); the
+    defects gallery fires each rule C001-C007 and only that rule;
+  * the runtime sanitizer (dbsp_tpu/testing/tsan.py) catches a seeded
+    unlocked write, an unlocked read of a lock(L) field, an Eraser
+    lockset-empty write race under a seeded interleaving schedule
+    (deterministically, across seeds), a lock-order inversion, an owner
+    violation, and an immutable rebind — and stays SILENT on the locked
+    controls;
+  * hammer tests: simultaneous /metrics + /lineage + /profile +
+    /checkpoint + input push + step + stop against a served pipeline in
+    host AND compiled modes — bit-identical final views vs a serial twin
+    that consumed the same input multiset, zero TSAN violations;
+  * C003: io/server.py no longer reaches through to
+    ``controller._step_lock`` — the public ``Controller.quiesce()``
+    context manager is the sanctioned surface.
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dbsp_tpu import concurrency
+from dbsp_tpu.testing import tsan
+from dbsp_tpu.testing.faults import InterleaveSchedule
+
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+
+from tools import check_concurrency as cc  # noqa: E402
+from tools import lint_all  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# schema well-formedness + the clean-tree gate
+# ---------------------------------------------------------------------------
+
+
+def test_schema_wellformed():
+    for cls_name, entry in concurrency.CONCURRENCY_SCHEMA.items():
+        for attr, value in entry.items():
+            g = concurrency.parse_guard(value)  # raises on malformed
+            if g.kind == "gil-atomic":
+                assert g.note, f"{cls_name}.{attr}: gil-atomic w/o rationale"
+    listed = {c for _, c in concurrency.CONCURRENCY_CLASSES}
+    assert listed == set(concurrency.CONCURRENCY_SCHEMA)
+
+
+def test_guard_parse_errors():
+    with pytest.raises(concurrency.GuardError):
+        concurrency.parse_guard("gil-atomic")  # rationale required
+    with pytest.raises(concurrency.GuardError):
+        concurrency.parse_guard("locked(_x)")
+    g = concurrency.parse_guard("writelock(_step_lock): note here")
+    assert g.kind == "writelock" and g.lock == "_step_lock"
+
+
+def test_tree_is_clean():
+    violations = cc.check_tree(_ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_lint_all_concurrency_front(monkeypatch):
+    # static half only — the TSAN smoke subprocess is the CLI's job
+    # (mirrors the multichip/profile dryrun split)
+    monkeypatch.setenv("DBSP_TPU_LINT_CONCURRENCY", "0")
+    assert lint_all.run_concurrency() == []
+
+
+def test_lock_order_graph_has_documented_edge():
+    """The sanctioned order Controller._step_lock -> _pushed_lock is in
+    the static graph (from _step_locked's nested acquisition), and the
+    graph is acyclic."""
+    import ast
+
+    path = os.path.join(_ROOT, "dbsp_tpu/io/controller.py")
+    with open(path) as f:
+        src = f.read()
+    edges = {}
+    v = cc.check_class(ast.parse(src), src.splitlines(),
+                       "dbsp_tpu/io/controller.py", "Controller", edges)
+    assert v == []
+    assert ("Controller._step_lock", "Controller._pushed_lock") in edges
+    assert cc.find_cycles(edges) == []
+
+
+# ---------------------------------------------------------------------------
+# seeded-defect exactness (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+_GUARDED_WRITE = """\
+        with self._pushed_lock:
+            self._pushed += int(n)
+            self.total_pushed += int(n)
+"""
+_UNGUARDED_WRITE = """\
+        self._pushed += int(n)
+        self.total_pushed += int(n)
+"""
+_WAIVED_WRITE = """\
+        self._pushed += int(n)  # concurrency: ok
+        self.total_pushed += int(n)  # concurrency: ok
+"""
+
+_CTRL_CLASSES = ["Controller", "_InputEndpoint", "_OutputEndpoint"]
+
+
+def _controller_src():
+    with open(os.path.join(_ROOT, "dbsp_tpu/io/controller.py")) as f:
+        return f.read()
+
+
+def test_seeded_defect_exactness_on_real_source():
+    """Deleting the ``with self._pushed_lock:`` around note_pushed's two
+    writes yields EXACTLY those two C001 findings — nothing else."""
+    src = _controller_src()
+    assert src.count(_GUARDED_WRITE) == 1
+    rel = "dbsp_tpu/io/controller.py"
+    assert cc.check_source(src, rel, _CTRL_CLASSES) == []  # baseline
+
+    mutated = src.replace(_GUARDED_WRITE, _UNGUARDED_WRITE)
+    findings = cc.check_source(mutated, rel, _CTRL_CLASSES)
+    assert len(findings) == 2, "\n".join(findings)
+    assert all("C001" in f for f in findings)
+    assert any("Controller._pushed " in f for f in findings)
+    assert any("Controller.total_pushed " in f for f in findings)
+    assert all("_pushed_lock" in f for f in findings)
+
+
+def test_waiver_suppresses_seeded_defect():
+    src = _controller_src().replace(_GUARDED_WRITE, _WAIVED_WRITE)
+    assert cc.check_source(src, "dbsp_tpu/io/controller.py",
+                           _CTRL_CLASSES) == []
+
+
+def test_defects_gallery_exact():
+    """Each gallery defect fires its rule and ONLY its rule."""
+    results = cc.run_defects()
+    assert {r for r, _, _ in results} == {"C001", "C002", "C003", "C004",
+                                          "C005", "C006"}
+    for rule, desc, findings in results:
+        assert findings, f"{rule} ({desc}): no findings"
+        assert any(f"{rule}:" in v for v in findings), (rule, findings)
+        for v in findings:
+            others = [r for r in cc._ALL_RULES if r != rule]
+            assert not any(f"{o}:" in v for o in others), (rule, v)
+
+
+def test_holds_marker_honored():
+    src = '''\
+import threading
+
+class FlightRecorder:
+    def __init__(self):
+        self.capacity = 1
+        self._lock = threading.Lock()
+        self._ring = []
+        self._seq = 0
+        self.dropped = 0
+
+    def record(self, ev):
+        with self._lock:
+            self._append(ev)
+
+    def _append(self, ev):  # holds: _lock
+        self._ring.append(ev)
+        self._seq += 1
+'''
+    assert cc.check_source(src, "<t>", ["FlightRecorder"]) == []
+    # drop the marker: both accesses flag
+    bad = src.replace("  # holds: _lock", "")
+    findings = cc.check_source(bad, "<t>", ["FlightRecorder"])
+    assert len(findings) == 2 and all("C001" in f for f in findings)
+
+
+def test_c003_reach_through_and_waiver():
+    src = '''\
+class Grabby:
+    def poke(self, controller):
+        with controller._step_lock:
+            return controller.steps
+'''
+    findings = cc.check_source(src, "<t>", [])
+    assert len(findings) == 1 and "C003" in findings[0]
+    waived = src.replace(
+        "with controller._step_lock:",
+        "with controller._step_lock:  # concurrency: ok")
+    assert cc.check_source(waived, "<t>", []) == []
+
+
+def test_server_has_no_step_lock_reach_through():
+    """Satellite 1: the /lineage and /profile quiesce paths go through
+    Controller.quiesce(), not controller._step_lock."""
+    with open(os.path.join(_ROOT, "dbsp_tpu/io/server.py")) as f:
+        src = f.read()
+    assert "._step_lock" not in src
+    assert "quiesce()" in src
+
+
+def test_quiesce_context_manager():
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.operators import add_input_zset
+
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [jnp.int64])
+        return h, s.integrate().output()
+
+    handle, (h, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    catalog.register_input("t", h, (jnp.int64, jnp.int64))
+    catalog.register_output("v", out, ())
+    ctl = Controller(handle, catalog, ControllerConfig())
+    with ctl.quiesce() as c:
+        assert c is ctl
+        assert ctl._step_lock.locked()
+    assert not ctl._step_lock.locked()
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: seeded defects caught, controls stay silent
+# ---------------------------------------------------------------------------
+
+
+def _racy_class():
+    class Racy:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.val = 0
+            self.items = []
+            self.cap = 1
+
+    return Racy
+
+
+def test_tsan_catches_unlocked_write_and_silent_on_locked():
+    Racy = _racy_class()
+    guards = {"lock": "immutable", "val": "writelock(lock)",
+              "items": "lock(lock)", "cap": "immutable"}
+    with tsan.session() as report:
+        r = tsan.instrument(Racy(), guards=guards)
+        with r.lock:
+            r.val += 1          # guarded: fine
+        with r.lock:
+            r.items.append(1)   # guarded read+mutate: fine
+    assert report.violations == []
+
+    with tsan.session() as report:
+        r = tsan.instrument(Racy(), guards=guards)
+        r.val += 1              # the seeded unguarded write
+    kinds = {(v["kind"], v["field"]) for v in report.violations}
+    assert ("declared-guard", "val") in kinds
+    with pytest.raises(tsan.TsanViolations):
+        with tsan.session():
+            r = tsan.instrument(Racy(), guards=guards)
+            r.val += 1
+            tsan.check()
+
+
+def test_tsan_lock_guard_checks_reads():
+    Racy = _racy_class()
+    guards = {"lock": "immutable", "items": "lock(lock)",
+              "val": "gil-atomic: test", "cap": "immutable"}
+    with tsan.session() as report:
+        r = tsan.instrument(Racy(), guards=guards)
+        len(r.items)            # unguarded READ of a lock(L) field
+    v = [x for x in report.violations if x["field"] == "items"]
+    assert v and v[0]["kind"] == "declared-guard" and \
+        v[0]["access"] == "read"
+
+
+def test_tsan_immutable_and_owner():
+    Racy = _racy_class()
+    with tsan.session() as report:
+        r = tsan.instrument(Racy(), guards={
+            "lock": "immutable", "val": "owner",
+            "items": "gil-atomic: test", "cap": "immutable"})
+        r.cap = 99              # immutable rebind
+        r.val += 1              # owner: main thread claims it
+        t = threading.Thread(target=lambda: setattr(r, "val", 5))
+        t.start()
+        t.join()
+    kinds = {v["kind"] for v in report.violations}
+    assert "immutable-write" in kinds
+    assert "owner-violation" in kinds
+
+
+def test_tsan_lock_order_inversion():
+    class AB:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+    with tsan.session() as report:
+        ab = tsan.instrument(AB(), guards={"a": "immutable",
+                                           "b": "immutable"})
+        with ab.a:
+            with ab.b:
+                pass
+        with ab.b:              # inverted order: no deadlock needed,
+            with ab.a:          # the graph edge alone convicts it
+                pass
+    v = [x for x in report.violations if x["kind"] == "lock-order-inversion"]
+    assert v, report.violations
+    assert "AB.a" in v[0]["guard"] and "AB.b" in v[0]["guard"]
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_seeded_interleaving_race_caught_deterministically(seed):
+    """The faults-harness schedule widens the explored interleavings; the
+    Eraser lockset intersection convicts the unlocked second writer on
+    EVERY run, for every seed — the catch is deterministic because it
+    depends on the lock sets held at the writes, not on winning the
+    race window."""
+    Racy = _racy_class()
+    guards = {"lock": "immutable", "val": "lockset: hammer test field",
+              "items": "gil-atomic: test", "cap": "immutable"}
+    sched = InterleaveSchedule(seed=seed, rate=0.5, sleep_s=0.0005,
+                               max_yields=500)
+    with tsan.session(schedule=sched) as report:
+        r = tsan.instrument(Racy(), guards=guards)
+
+        def locked_writer():
+            for _ in range(40):
+                with r.lock:
+                    r.val += 1
+
+        def unlocked_writer():
+            for _ in range(10):
+                r.val += 1      # the seeded race
+
+        ts = [threading.Thread(target=locked_writer),
+              threading.Thread(target=unlocked_writer)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert sched.yields > 0     # the schedule actually injected
+    v = [x for x in report.violations if x["kind"] == "eraser-lockset"]
+    assert v, report.violations
+    assert v[0]["field"] == "val" and len(v[0]["writers"]) == 2
+
+    # control: both writers locked -> no violation, same schedule shape
+    sched2 = InterleaveSchedule(seed=seed, rate=0.5, sleep_s=0.0005)
+    with tsan.session(schedule=sched2) as report2:
+        r = tsan.instrument(Racy(), guards=guards)
+
+        def w():
+            for _ in range(25):
+                with r.lock:
+                    r.val += 1
+
+        ts = [threading.Thread(target=w), threading.Thread(target=w)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert report2.violations == []
+
+
+def test_tsan_minikafka_transport_clean():
+    """Broker + shared producer hammered from two threads + a consumer:
+    the transport layer's locks hold up under tracing."""
+    with tsan.session() as report:
+        from dbsp_tpu.io.minikafka import (MiniConsumer, MiniKafkaBroker,
+                                           MiniProducer)
+
+        broker = MiniKafkaBroker().start()
+        prod = MiniProducer(bootstrap_servers=broker.address)
+        errors = queue.Queue()
+
+        def producer(tag):
+            try:
+                for i in range(30):
+                    prod.send("t", f"{tag}-{i}".encode())
+                    if i % 5 == 0:
+                        prod.flush()
+                prod.flush()
+            except Exception as e:  # noqa: BLE001
+                errors.put(e)
+
+        cons = MiniConsumer("t", bootstrap_servers=broker.address,
+                            group_id="g")
+        got = []
+
+        def consumer():
+            try:
+                deadline = time.monotonic() + 5
+                while len(got) < 60 and time.monotonic() < deadline:
+                    for recs in cons.poll(timeout_ms=100).values():
+                        got.extend(r.value for r in recs)
+            except Exception as e:  # noqa: BLE001
+                errors.put(e)
+
+        ts = [threading.Thread(target=producer, args=("a",)),
+              threading.Thread(target=producer, args=("b",)),
+              threading.Thread(target=consumer)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        cons.close()
+        prod.close()
+        broker.stop()
+        assert errors.empty(), errors.get()
+        assert len(got) == 60
+    assert report.violations == [], tsan.TsanViolations(report.violations)
+
+
+# ---------------------------------------------------------------------------
+# hammer: simultaneous scrape/lineage/profile/checkpoint/push/step/stop
+# against a served pipeline, both engines, vs a serial twin
+# ---------------------------------------------------------------------------
+
+TABLES = {
+    "bids": {"columns": ["auction", "bidder", "price"],
+             "dtypes": ["int64", "int64", "int64"], "key_columns": 1},
+    "auctions": {"columns": ["id", "category"],
+                 "dtypes": ["int64", "int64"], "key_columns": 1},
+}
+SQL = {"cat_stats":
+       "SELECT auctions.category, COUNT(*) AS n, MAX(bids.price) AS hi "
+       "FROM bids JOIN auctions ON bids.auction = auctions.id "
+       "GROUP BY auctions.category"}
+
+
+def _feeds(n_batches=600):
+    """Deterministic push sequence: (table, rows) pairs."""
+    out = []
+    k = 0
+    for i in range(n_batches):
+        if i % 2 == 0:
+            out.append(("auctions",
+                        [[k + j, (k + j) % 7] for j in range(4)]))
+        else:
+            out.append(("bids",
+                        [[k + j, (k + j) % 5, 100 + k + j]
+                         for j in range(4)]))
+            k += 4
+    return out
+
+
+@pytest.mark.parametrize("mode", ["host", "compiled"])
+def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
+    """The satellite-3 acceptance: concurrent scrape + lineage + profile
+    + checkpoint + push + step (+ the controller loop's own stepping)
+    against one pipeline, then stop — final view bit-identical to a
+    serial twin over the same input multiset, zero TSAN violations."""
+    from dbsp_tpu.client import Connection
+    from dbsp_tpu.manager import PipelineManager
+
+    if mode == "host":
+        monkeypatch.setenv("DBSP_TPU_MANAGER_COMPILED", "0")
+    feeds = _feeds()
+    sched = InterleaveSchedule(
+        seed=11, rate=0.04, sleep_s=0.001, max_yields=300,
+        only=("Controller.", "SLOWatchdog.", "FlightRecorder.",
+              "PipelineManager.", "_InputEndpoint."))
+    cfg = {"min_batch_records": 1, "flush_interval_s": 0.02,
+           "lineage_taps": True,
+           "checkpoint_dir": str(tmp_path / f"ckpt-{mode}"),
+           "checkpoint_every_ticks": 1000}  # explicit /checkpoint only
+    with tsan.session(schedule=sched) as report:
+        mgr = PipelineManager()
+        mgr.start()
+        try:
+            conn = Connection(port=mgr.port)
+            conn.create_program("prog", TABLES, SQL)
+            pipe = conn.start_pipeline(f"hammer-{mode}", "prog",
+                                       config=dict(cfg))
+            assert pipe.mode() == mode
+
+            stop_evt = threading.Event()
+            errors = queue.Queue()
+            done = {"pushes": 0, "lineage": 0, "profile": 0,
+                    "checkpoints": 0, "scrapes": 0, "steps": 0}
+
+            def pusher():
+                try:
+                    for i, (table, rows) in enumerate(feeds):
+                        if stop_evt.is_set():
+                            return
+                        pipe.push(table, rows)
+                        done["pushes"] = i + 1
+                        time.sleep(0.002)
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("pusher", e))
+
+            def stepper():
+                try:
+                    while not stop_evt.is_set():
+                        pipe.step()
+                        done["steps"] += 1
+                        time.sleep(0.02)
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("stepper", e))
+
+            def scraper():
+                try:
+                    while not stop_evt.is_set():
+                        conn.metrics()
+                        pipe.status()
+                        pipe.stats()
+                        pipe.flight(n=16)
+                        pipe.incidents(with_window=False)
+                        done["scrapes"] += 1
+                        time.sleep(0.01)
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("scraper", e))
+
+            def lineage_reader():
+                try:
+                    while not stop_evt.is_set():
+                        rep = pipe.why("cat_stats", "3")
+                        assert "found" in rep
+                        done["lineage"] += 1
+                        time.sleep(0.05)
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("lineage", e))
+
+            def profiler():
+                try:  # one measured-surface poke is enough per hammer
+                    rep = pipe.profile()
+                    assert rep.get("mode")
+                    done["profile"] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("profile", e))
+
+            def checkpointer():
+                try:
+                    while not stop_evt.is_set():
+                        info = pipe.checkpoint()
+                        assert "generation" in info
+                        done["checkpoints"] += 1
+                        time.sleep(0.25)
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("checkpoint", e))
+
+            threads = [threading.Thread(target=f, name=f.__name__)
+                       for f in (pusher, stepper, scraper, lineage_reader,
+                                 profiler, checkpointer)]
+            for t in threads:
+                t.start()
+            time.sleep(2.5)
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), f"{t.name} wedged"
+            assert errors.empty(), errors.get()
+            consumed = done["pushes"]
+            assert consumed > 10 and done["steps"] > 0
+            assert done["lineage"] > 0 and done["profile"] > 0
+            assert done["checkpoints"] > 0 and done["scrapes"] > 0
+
+            pipe.step()  # consume any remainder, emit the integral
+            view = sorted(pipe.read("cat_stats").items())
+
+            # the serial twin consumes the SAME input multiset in one
+            # tick: the integral is batching-invariant, so any divergence
+            # means the hammered pipeline lost or double-applied rows
+            twin = conn.start_pipeline(
+                f"twin-{mode}", "prog",
+                config={"min_batch_records": 10 ** 9,
+                        "flush_interval_s": 3600.0, "lineage_taps": True})
+            for table, rows in feeds[:consumed]:
+                twin.push(table, rows)
+            twin.step()
+            twin_view = sorted(twin.read("cat_stats").items())
+            assert view == twin_view
+
+            # stop: shutdown racing a final scrape volley
+            def late_scraper():
+                for _ in range(10):
+                    try:
+                        pipe.status()
+                        conn.health()
+                    except Exception:  # noqa: BLE001 — server going down
+                        return
+                    time.sleep(0.01)
+
+            ls = threading.Thread(target=late_scraper)
+            ls.start()
+            urllib.request.urlopen(
+                urllib.request.Request(f"{pipe.base}/shutdown",
+                                       method="POST"), timeout=30).read()
+            ls.join(timeout=30)
+        finally:
+            mgr.stop()
+    assert report.violations == [], tsan.TsanViolations(report.violations)
+
+
+def test_tsan_dryrun_smoke():
+    """The lint_all front's subprocess body, run in-process: the
+    instrumented mini-pipeline is race-clean AND the seeded defect is
+    caught (non-vacuity of the whole runtime layer)."""
+    summary = tsan.dryrun(seconds=1.0)
+    assert summary["seeded_defect_caught"]
+
+
+def test_schema_walker_shared_with_check_state():
+    """Satellite 5: both field-claim lints import the ONE walker."""
+    import tools.check_state as cs
+    from tools import schema_walk
+
+    assert cs._self_attrs is schema_walk.self_attrs
+    assert cc.self_attrs is schema_walk.self_attrs
+    # and the walker skips nested classes (the Handler-in-server case)
+    import ast
+
+    tree = ast.parse("class A:\n"
+                     "    def __init__(self):\n"
+                     "        self.x = 1\n"
+                     "    class Inner:\n"
+                     "        def __init__(self):\n"
+                     "            self.hidden = 2\n")
+    attrs = schema_walk.self_attrs(schema_walk.find_class(tree, "A"))
+    assert "x" in attrs and "hidden" not in attrs
+
+
+def test_violation_report_is_structured():
+    Racy = _racy_class()
+    with tsan.session() as report:
+        r = tsan.instrument(Racy(), guards={
+            "lock": "immutable", "val": "writelock(lock)",
+            "items": "gil-atomic: test", "cap": "immutable"})
+        r.val = 3
+        r.val = 4  # same site: dedup'd, counted
+    [v] = report.violations
+    assert v["kind"] == "declared-guard" and v["count"] == 2
+    assert v["cls"] == "Racy" and v["field"] == "val"
+    assert v["guard"] == "writelock(lock)" and v["stack"]
+    json.dumps({k: val for k, val in v.items() if k != "_key"})

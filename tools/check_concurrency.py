@@ -1,0 +1,625 @@
+#!/usr/bin/env python
+"""Lock-discipline lint: every shared mutable field obeys its declared guard.
+
+The race-condition failure mode this prevents: someone adds a field to the
+serving plane (controller / server / manager / transports / observability),
+touches it from a second thread without the lock that protects it, and the
+corruption only fires under production interleavings — PR 6 found exactly
+such a data-loss race (input buffers cleared after a ~600ms jit-compiling
+drain) only by accident, via a fault test. This pass makes the guard
+discipline machine-checkable the way ``tools/check_state.py`` makes the
+persistence discipline checkable: one schema
+(:data:`dbsp_tpu.concurrency.CONCURRENCY_SCHEMA` — the guard-claim sibling
+of ``checkpoint.STATE_SCHEMA``; the two lints share the field walker in
+``tools/schema_walk.py`` so they cannot drift), plus the static half of
+the Eraser/TSan recipe (Savage et al., TOCS'97; Serebryany & Iskhodzhanov,
+WBIA'09 — the runtime half is ``dbsp_tpu/testing/tsan.py``).
+
+Rule catalog (each waivable with a ``# concurrency: ok`` comment on the
+flagged line; ``--defects`` renders a seeded gallery proving each fires):
+
+  C001  unguarded access — a field claimed ``lock(L)`` is read or written
+        (``writelock(L)``: written) outside a ``with self.L:`` block and
+        outside a method whose def line carries a ``# holds: L`` marker.
+  C002  lock-order cycle — the static acquisition graph built from nested
+        ``with`` blocks (interprocedural across same-class ``self.m()``
+        calls) contains a cycle; today's sanctioned order is
+        ``Controller._step_lock -> Controller._pushed_lock``.
+  C003  private-lock reach-through — code outside a class touches one of
+        its underscore-private locks (``server.controller._step_lock``
+        was the motivating case; the sanctioned surface is a public
+        context manager like ``Controller.quiesce()``).
+  C004  unclaimed field — a ``self.X`` the schema does not claim.
+  C005  stale claim — a schema entry whose field (or class) no longer
+        exists.
+  C006  immutable field rebound outside ``__init__``.
+  C007  malformed guard — unparsable guard string, ``gil-atomic`` without
+        its rationale, or a lock target that is not a field of the class.
+
+Usage::
+
+    python tools/check_concurrency.py [repo_root]   # lint the tree
+    python tools/check_concurrency.py --defects     # seeded-defect gallery
+
+Wired tier-1 via tests/test_concurrency.py and into tools/lint_all.py as
+the ``concurrency`` front.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from tools.schema_walk import find_class, self_attrs  # noqa: E402
+
+#: container-method calls that mutate the receiver — a
+#: ``self.X.append(...)`` on a write-guarded field is a write
+MUTATORS = frozenset((
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "add", "discard", "update", "setdefault", "sort", "reverse",
+))
+
+#: constructor names whose assignment marks a field as a lock even when
+#: no guard targets it yet (threading.Lock() / RLock() / Condition())
+_LOCK_CTORS = ("Lock", "RLock", "Condition")
+
+
+def _conc():
+    from dbsp_tpu import concurrency
+
+    return concurrency
+
+
+# ---------------------------------------------------------------------------
+# per-class guard walk
+# ---------------------------------------------------------------------------
+
+
+def _marker_locks(fn: ast.AST, lines: List[str]) -> Set[str]:
+    """Locks named by a ``# holds: a, b`` marker on the def-line region
+    (signature lines + first body line — the ``*_locked``
+    caller-owns-the-lock idiom)."""
+    marker = _conc().HOLDS_MARKER
+    out: Set[str] = set()
+    for i in range(fn.lineno - 1, min(fn.body[0].lineno, len(lines))):
+        if marker in lines[i]:
+            names = lines[i].split(marker, 1)[1]
+            out.update(n.strip() for n in names.split(",") if n.strip())
+    return out
+
+
+def _ctor_locks(cls: ast.ClassDef) -> Set[str]:
+    """Fields assigned a bare threading lock constructor anywhere in the
+    class — recognized as acquirable even without a guard targeting them."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if name in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out.add(t.attr)
+    return out
+
+
+class _ClassWalk:
+    """Walks one class body collecting guarded-field accesses with the
+    set of self-locks held at each, plus lock acquisitions and same-class
+    calls for the order graph."""
+
+    def __init__(self, cls: ast.ClassDef, lines: List[str],
+                 lock_attrs: Set[str]):
+        self.cls = cls
+        self.lines = lines
+        self.lock_attrs = lock_attrs
+        # (attr, access kind "read"|"bind"|"mutate", lineno,
+        #  frozenset(held), construction_phase). "bind" rebinds the
+        # attribute itself; "mutate" changes its referent in place
+        # (subscript store, mutator method call) — immutable fields allow
+        # mutate (threading.Event bindings), lock/writelock check both.
+        self.accesses: List[Tuple[str, str, int, FrozenSet[str], bool]] = []
+        # method -> {(lock, frozenset(held-before))}
+        self.acquires: Dict[str, Set[Tuple[str, FrozenSet[str]]]] = {}
+        # method -> {(callee, frozenset(held-at-call))}
+        self.calls: Dict[str, Set[Tuple[str, FrozenSet[str]]]] = {}
+        self.acquire_sites: Dict[str, int] = {}
+        self._method = ""
+
+    def run(self) -> None:
+        for stmt in self.cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                held = frozenset(_marker_locks(stmt, self.lines))
+                self._method = stmt.name
+                self.acquires.setdefault(stmt.name, set())
+                self.calls.setdefault(stmt.name, set())
+                exempt = stmt.name == "__init__"
+                for s in stmt.body:
+                    self._stmt(s, held, exempt)
+
+    # -- statement dispatch --------------------------------------------------
+    def _stmt(self, node: ast.AST, held: FrozenSet[str],
+              exempt: bool) -> None:
+        if isinstance(node, ast.ClassDef):
+            return  # nested class: different `self`
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure runs later, NOT under the enclosing with — and
+            # never in the construction phase, even inside __init__
+            inner = frozenset(_marker_locks(node, self.lines))
+            for s in node.body:
+                self._stmt(s, inner, False)
+            return
+        if isinstance(node, ast.With):
+            acquired: Set[str] = set()
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Attribute) and \
+                        isinstance(ce.value, ast.Name) and \
+                        ce.value.id == "self" and ce.attr in self.lock_attrs:
+                    if ce.attr not in held:  # reentrant RLock: no edge
+                        self.acquires[self._method].add(
+                            (ce.attr, frozenset(held | acquired)))
+                        self.acquire_sites.setdefault(ce.attr, ce.lineno)
+                        acquired.add(ce.attr)
+                else:
+                    self._expr(ce, held, exempt)
+                if item.optional_vars is not None:
+                    self._expr(item.optional_vars, held, exempt)
+            inner = frozenset(held | acquired)
+            for s in node.body:
+                self._stmt(s, inner, exempt)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._target(t, held, exempt)
+            self._expr(node.value, held, exempt)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            self._target(node.target, held, exempt)
+            if node.value is not None:
+                self._expr(node.value, held, exempt)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target(t, held, exempt)
+            return
+        self._children(node, held, exempt)
+
+    def _children(self, node: ast.AST, held: FrozenSet[str],
+                  exempt: bool) -> None:
+        """Generic recursion: dispatches child statements/expressions and
+        drills through non-stmt/expr containers (ExceptHandler bodies,
+        comprehension generators, match cases)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, held, exempt)
+            elif isinstance(child, ast.expr):
+                self._expr(child, held, exempt)
+            else:
+                self._children(child, held, exempt)
+
+    def _target(self, t: ast.AST, held: FrozenSet[str],
+                exempt: bool) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, held, exempt)
+            return
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            self.accesses.append((t.attr, "bind", t.lineno, held, exempt))
+            return
+        if isinstance(t, ast.Subscript):
+            v = t.value
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self":
+                # self.X[k] = ... mutates X's referent: a write access
+                self.accesses.append(
+                    (v.attr, "mutate", t.lineno, held, exempt))
+            else:
+                self._expr(v, held, exempt)
+            self._expr(t.slice, held, exempt)
+            return
+        self._expr(t, held, exempt)
+
+    def _expr(self, node: Optional[ast.AST], held: FrozenSet[str],
+              exempt: bool) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Lambda):
+            self._expr(node.body, frozenset(), False)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATORS and \
+                    isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self":
+                # self.X.append(...) — mutator call on a guarded container
+                self.accesses.append(
+                    (f.value.attr, "mutate", node.lineno, held, exempt))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                # self.m(...) — same-class call, for interprocedural edges
+                self.calls[self._method].add((f.attr, held))
+                self.accesses.append(
+                    (f.attr, "read", node.lineno, held, exempt))
+            else:
+                self._expr(f, held, exempt)
+            for a in node.args:
+                self._expr(a, held, exempt)
+            for kw in node.keywords:
+                self._expr(kw.value, held, exempt)
+            return
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                self.accesses.append(
+                    (node.attr, "read", node.lineno, held, exempt))
+                return
+            self._expr(node.value, held, exempt)
+            return
+        self._children(node, held, exempt)
+
+
+# ---------------------------------------------------------------------------
+# module / tree checks
+# ---------------------------------------------------------------------------
+
+
+def _waived(lines: List[str], lineno: int) -> bool:
+    line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+    return _conc().WAIVER in line
+
+
+def _ast_bases(tree: ast.AST) -> Dict[str, Tuple[str, ...]]:
+    out: Dict[str, Tuple[str, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = tuple(b.id for b in node.bases
+                                   if isinstance(b, ast.Name))
+    return out
+
+
+def _private_locks(schema_map: Dict[str, Dict[str, str]]) -> Set[str]:
+    conc = _conc()
+    out: Set[str] = set()
+    for entry in schema_map.values():
+        for value in entry.values():
+            try:
+                g = conc.parse_guard(value)
+            except conc.GuardError:
+                continue
+            if g.lock is not None and g.lock.startswith("_"):
+                out.add(g.lock)
+    return out
+
+
+def check_class(tree: ast.AST, lines: List[str], rel: str, cls_name: str,
+                edges: Optional[Dict] = None,
+                schema_map: Optional[Dict] = None) -> List[str]:
+    """Guard-claim + discipline checks for one class; appends its lock
+    acquisitions into ``edges`` (the global C002 graph) as
+    ``(Class.lockA, Class.lockB) -> (rel, lineno)``."""
+    conc = _conc()
+    schema_map = schema_map if schema_map is not None \
+        else conc.CONCURRENCY_SCHEMA
+    violations: List[str] = []
+    cls = find_class(tree, cls_name)
+    if cls is None:
+        return [f"{rel}: C005: class {cls_name} not found (update "
+                "dbsp_tpu/concurrency.py CONCURRENCY_CLASSES)"]
+    own = schema_map.get(cls_name)
+    if own is None:
+        return [f"{rel}: C004: class {cls_name} has no CONCURRENCY_SCHEMA "
+                "entry in dbsp_tpu/concurrency.py"]
+    merged = conc.effective_schema(cls_name, _ast_bases(tree),
+                                   schema_map=schema_map)
+    attrs = self_attrs(cls)
+
+    guards: Dict[str, conc.Guard] = {}
+    for attr, value in sorted(merged.items()):
+        try:
+            guards[attr] = conc.parse_guard(value)
+        except conc.GuardError as e:
+            violations.append(f"{rel}: C007: {cls_name}.{attr}: {e}")
+    for attr, g in sorted(guards.items()):
+        if g.lock is not None and g.lock not in attrs and \
+                g.lock not in merged:
+            violations.append(
+                f"{rel}: C007: {cls_name}.{attr} is guarded by "
+                f"{g.lock!r}, which is not a field of the class")
+
+    # both directions: unclaimed fields / stale claims
+    for attr, lineno in sorted(attrs.items()):
+        if attr not in merged and not _waived(lines, lineno):
+            violations.append(
+                f"{rel}:{lineno}: C004: {cls_name}.{attr} has no guard "
+                "claim in dbsp_tpu.concurrency.CONCURRENCY_SCHEMA — "
+                "declare immutable | lock(X) | writelock(X) | owner | "
+                "lockset | gil-atomic: <why>")
+    for attr in sorted(set(own) - set(attrs)):
+        violations.append(
+            f"{rel}: C005: CONCURRENCY_SCHEMA claims {cls_name}.{attr} "
+            "but the class no longer assigns it — drop the stale entry")
+
+    lock_attrs = {g.lock for g in guards.values() if g.lock is not None}
+    lock_attrs |= _ctor_locks(cls)
+    walk = _ClassWalk(cls, lines, lock_attrs)
+    walk.run()
+
+    for attr, kind, lineno, held, in_init in walk.accesses:
+        g = guards.get(attr)
+        if g is None or _waived(lines, lineno):
+            continue
+        if g.kind == "immutable":
+            if kind == "bind" and not in_init:
+                violations.append(
+                    f"{rel}:{lineno}: C006: {cls_name}.{attr} is claimed "
+                    "immutable but rebound outside __init__")
+        elif g.kind == "lock":
+            if not in_init and g.lock not in held:
+                violations.append(
+                    f"{rel}:{lineno}: C001: {cls_name}.{attr} "
+                    f"{'read' if kind == 'read' else 'written'} without "
+                    f"holding {g.lock} (guard lock({g.lock})) — wrap in "
+                    f"'with self.{g.lock}:' or mark the method "
+                    f"'# holds: {g.lock}'")
+        elif g.kind == "writelock":
+            if kind != "read" and not in_init and g.lock not in held:
+                violations.append(
+                    f"{rel}:{lineno}: C001: {cls_name}.{attr} written "
+                    f"without holding {g.lock} (guard "
+                    f"writelock({g.lock}))")
+        # owner / lockset / gil-atomic: runtime-enforced or exempt by
+        # declared invariant (dbsp_tpu/testing/tsan.py enforces them)
+
+    # lock-order edges (interprocedural fixpoint over same-class calls)
+    acq = {m: set(s) for m, s in walk.acquires.items()}
+    for _ in range(8):
+        changed = False
+        for m, callees in walk.calls.items():
+            for callee, held in callees:
+                for lock, held2 in acq.get(callee, ()):
+                    item = (lock, frozenset(held | held2))
+                    if item not in acq.setdefault(m, set()):
+                        acq[m].add(item)
+                        changed = True
+        if not changed:
+            break
+    if edges is not None:
+        for m, items in acq.items():
+            for lock, held in items:
+                for h in held:
+                    if h != lock:
+                        edges.setdefault(
+                            (f"{cls_name}.{h}", f"{cls_name}.{lock}"),
+                            (rel, walk.acquire_sites.get(lock, cls.lineno)))
+    return violations
+
+
+def check_reach_through(tree: ast.AST, lines: List[str], rel: str,
+                        private_locks: Set[str]) -> List[str]:
+    """C003: an underscore-private lock of a schema'd class touched
+    through anything but ``self`` — cross-class lock reach-through."""
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in private_locks \
+                and not (isinstance(node.value, ast.Name) and
+                         node.value.id == "self"):
+            if _waived(lines, node.lineno):
+                continue
+            violations.append(
+                f"{rel}:{node.lineno}: C003: reach-through to private "
+                f"lock .{node.attr} — use the owning class's public "
+                "surface instead (Controller.quiesce() for the step lock)")
+    return violations
+
+
+def find_cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]) -> List[str]:
+    """C002 over the accumulated acquisition graph."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    violations: List[str] = []
+    seen: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = tuple(sorted(set(cyc)))
+                if key not in seen:
+                    seen.add(key)
+                    sites = []
+                    for x, y in zip(cyc, cyc[1:]):
+                        r, ln = edges.get((x, y), ("?", 0))
+                        sites.append(f"{x} -> {y} ({r}:{ln})")
+                    violations.append(
+                        "C002: lock-order cycle: " + "; ".join(sites))
+            else:
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return violations
+
+
+def check_source(src: str, rel: str, class_names: List[str],
+                 extra_schema: Optional[Dict] = None,
+                 with_cycles: bool = True) -> List[str]:
+    """Check one module's source for the named classes — the in-memory
+    entry the seeded-defect tests and the gallery use. ``extra_schema``
+    layers gallery/test classes over the real registry."""
+    conc = _conc()
+    schema_map = dict(conc.CONCURRENCY_SCHEMA)
+    schema_map.update(extra_schema or {})
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    edges: Dict = {}
+    violations: List[str] = []
+    for cls_name in class_names:
+        violations += check_class(tree, lines, rel, cls_name, edges,
+                                  schema_map)
+    violations += check_reach_through(tree, lines, rel,
+                                      _private_locks(schema_map))
+    if with_cycles:
+        violations += find_cycles(edges)
+    return violations
+
+
+def check_tree(root: str) -> List[str]:
+    conc = _conc()
+    by_file: Dict[str, List[str]] = {}
+    for rel, cls_name in conc.CONCURRENCY_CLASSES:
+        by_file.setdefault(rel, []).append(cls_name)
+    violations: List[str] = []
+    edges: Dict = {}
+    private = _private_locks(conc.CONCURRENCY_SCHEMA)
+    scan = list(by_file) + [m for m in conc.REACH_THROUGH_MODULES
+                            if m not in by_file]
+    for rel in scan:
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            src = f.read()
+        tree = ast.parse(src)
+        lines = src.splitlines()
+        for cls_name in by_file.get(rel, ()):
+            violations += check_class(tree, lines, rel, cls_name, edges)
+        violations += check_reach_through(tree, lines, rel, private)
+    listed = {c for _, c in conc.CONCURRENCY_CLASSES}
+    for cls_name in sorted(set(conc.CONCURRENCY_SCHEMA) - listed):
+        violations.append(
+            f"dbsp_tpu/concurrency.py: C005: CONCURRENCY_SCHEMA has an "
+            f"entry for {cls_name} but CONCURRENCY_CLASSES does not list "
+            "it — add the (file, class) pair or drop the entry")
+    violations += find_cycles(edges)
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# defects gallery — seeded sources demonstrating each rule fires exactly
+# ---------------------------------------------------------------------------
+
+_GALLERY_PRELUDE = '''\
+import threading
+
+class FlightRecorder:  # reuses the real schema entry: _ring is lock(_lock)
+    def __init__(self):
+        self.capacity = 1
+        self._lock = threading.Lock()
+        self._ring = []
+        self._seq = 0
+        self.dropped = 0
+'''
+
+_TWO_LOCKS_SCHEMA = {
+    "TwoLocks": {"_a": "immutable", "_b": "immutable", "n": "lock(_a)"}}
+
+#: (rule, description, source, classes, extra_schema)
+DEFECTS: List[Tuple[str, str, str, List[str], Optional[Dict]]] = [
+    ("C001", "unguarded write to a lock-guarded field",
+     _GALLERY_PRELUDE + '''
+    def record(self, ev):
+        self._ring.append(ev)   # the with self._lock: went missing
+''', ["FlightRecorder"], None),
+    ("C001", "unguarded read of a lock-guarded field",
+     _GALLERY_PRELUDE + '''
+    def events(self):
+        return list(self._ring)
+''', ["FlightRecorder"], None),
+    ("C002", "lock-order cycle (ab / ba inversion)", '''\
+import threading
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                self.n -= 1
+''', ["TwoLocks"], _TWO_LOCKS_SCHEMA),
+    ("C003", "cross-class private-lock reach-through",
+     _GALLERY_PRELUDE + '''
+class Poker:
+    def poke(self, rec):
+        with rec._lock:   # grabbing another object's private lock
+            return rec.capacity
+''', ["FlightRecorder"], None),
+    ("C004", "field with no guard claim",
+     _GALLERY_PRELUDE + '''
+    def grow(self):
+        with self._lock:
+            self.brand_new_field = 1
+''', ["FlightRecorder"], None),
+    ("C005", "stale schema claim", _GALLERY_PRELUDE.replace(
+        "        self.dropped = 0\n", ""), ["FlightRecorder"], None),
+    ("C006", "immutable field rebound outside __init__",
+     _GALLERY_PRELUDE + '''
+    def resize(self, n):
+        self.capacity = n
+''', ["FlightRecorder"], None),
+]
+
+_ALL_RULES = ("C001", "C002", "C003", "C004", "C005", "C006", "C007")
+
+
+def run_defects() -> List[Tuple[str, str, List[str]]]:
+    """(rule, description, findings) per seeded defect. The gallery's
+    contract — asserted in tests/test_concurrency.py — is seeded-defect
+    EXACTNESS: each defect's findings name its rule and no other rule."""
+    out = []
+    for rule, desc, src, classes, extra in DEFECTS:
+        findings = check_source(src, f"<defect:{rule}>", classes,
+                                extra_schema=extra)
+        out.append((rule, desc, findings))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--defects":
+        ok = True
+        for rule, desc, findings in run_defects():
+            hit = any(f"{rule}:" in v for v in findings)
+            pure = all(any(f"{r}:" in v for r in (rule,))
+                       for v in findings)
+            status = "fires" if hit and pure else \
+                "MISSED" if not hit else "IMPURE"
+            ok &= hit and pure
+            print(f"[{rule}] {desc}: {status}")
+            for v in findings:
+                print(f"    {v}")
+        return 0 if ok else 1
+    root = (argv or [_ROOT])[0]
+    violations = check_tree(os.path.abspath(root))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_concurrency: {len(violations)} violation(s)")
+        return 1
+    print("check_concurrency: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

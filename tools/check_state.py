@@ -17,6 +17,13 @@ lose), ``config`` (rebuilt at deploy), or ``runtime`` (process-local).
 Stale claims — schema entries whose attribute no longer exists — are
 violations too, so the registry tracks the code both ways.
 
+Sibling lint: ``tools/check_concurrency.py`` claims the same kind of
+field inventory against ``dbsp_tpu.concurrency.CONCURRENCY_SCHEMA`` —
+there the claim is the field's GUARD (which lock protects it) rather
+than its persistence disposition. The two lints share the attribute
+walker in ``tools/schema_walk.py`` so "what counts as a field of the
+class" can never drift between them.
+
 Usage: ``python tools/check_state.py [repo_root]`` — prints violations
 and exits 1 when any are found.
 """
@@ -26,11 +33,13 @@ from __future__ import annotations
 import ast
 import os
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import List, Set, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
+
+from tools.schema_walk import find_class, self_attrs as _self_attrs  # noqa: E402
 
 #: (file relative to repo root, class name) pairs under schema control —
 #: the classes whose instances a checkpoint must fully account for
@@ -45,37 +54,6 @@ CHECKED_CLASSES: Tuple[Tuple[str, str], ...] = (
 DISPOSITIONS = ("persisted", "derived", "config", "runtime")
 
 
-def _self_attrs(cls: ast.ClassDef) -> Dict[str, int]:
-    """attr -> first line of every ``self.X = ...`` in the class body,
-    plus class-level attribute defaults (``spans = None``) — ALL_CAPS
-    constants excluded."""
-    out: Dict[str, int] = {}
-    for stmt in cls.body:
-        if isinstance(stmt, ast.Assign):
-            for t in stmt.targets:
-                if isinstance(t, ast.Name) and not t.id.isupper():
-                    out.setdefault(t.id, stmt.lineno)
-        elif isinstance(stmt, ast.AnnAssign) and \
-                isinstance(stmt.target, ast.Name) and \
-                not stmt.target.id.isupper():
-            out.setdefault(stmt.target.id, stmt.lineno)
-    for node in ast.walk(cls):
-        targets: List[ast.expr] = []
-        if isinstance(node, ast.Assign):
-            targets = list(node.targets)
-        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
-            targets = [node.target]
-        for t in targets:
-            # tuple targets: self.a, self.b = ...
-            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
-            for e in elts:
-                if isinstance(e, ast.Attribute) and \
-                        isinstance(e.value, ast.Name) and \
-                        e.value.id == "self":
-                    out.setdefault(e.attr, node.lineno)
-    return out
-
-
 def check_tree(root: str) -> List[str]:
     from dbsp_tpu.checkpoint import STATE_SCHEMA
 
@@ -84,9 +62,7 @@ def check_tree(root: str) -> List[str]:
         path = os.path.join(root, rel)
         with open(path) as f:
             tree = ast.parse(f.read())
-        cls = next((n for n in ast.walk(tree)
-                    if isinstance(n, ast.ClassDef) and n.name == cls_name),
-                   None)
+        cls = find_class(tree, cls_name)
         if cls is None:
             violations.append(f"{rel}: class {cls_name} not found (update "
                               "tools/check_state.py CHECKED_CLASSES)")

@@ -11,6 +11,13 @@ the tier-1 test in tests/test_analysis.py):
    bodies / jitted functions; no load-bearing asserts in circuit/ and io/.
 2b. ``tools/check_state.py``   — every serving-state field is claimed by
    the checkpoint schema registry (restore can never silently drop state).
+2f. **Concurrency front** — ``tools/check_concurrency.py`` (every shared
+   mutable serving-plane field obeys its declared guard; lock-order graph
+   acyclic; no private-lock reach-through) plus, on the CLI, a TSAN smoke
+   dryrun (``dbsp_tpu.testing.tsan.dryrun`` in a subprocess: a hammered
+   instrumented pipeline must be race-clean AND a seeded unlocked write
+   must be caught). ``DBSP_TPU_LINT_CONCURRENCY=0`` skips the smoke; the
+   import-based tier-1 consumer is tests/test_concurrency.py.
 2c. ``tools/build_native.py``  — cached native binaries carry the
    SHA-256 of their checked-out sources (a drifted ``.so`` is a red lint).
 2d. ``tools/gen_metrics_doc.py --check`` — the committed METRICS.md
@@ -73,6 +80,31 @@ def run_check_state() -> list:
     from tools.check_state import check_tree
 
     return check_tree(_ROOT)
+
+
+def run_concurrency() -> list:
+    """2f. Static lock-discipline pass + (CLI-only) TSAN smoke dryrun."""
+    import subprocess
+
+    from tools.check_concurrency import check_tree
+
+    violations = check_tree(_ROOT)
+    if os.environ.get("DBSP_TPU_LINT_CONCURRENCY", "1") == "0":
+        print("lint_all: concurrency: tsan smoke skipped "
+              "(DBSP_TPU_LINT_CONCURRENCY=0)")
+        return violations
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "dbsp_tpu.testing.tsan"],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return violations + ["tsan dryrun timed out after 600s"]
+    if p.returncode != 0:
+        violations.append(
+            f"tsan dryrun failed (runtime sanitizer rotted?):\n"
+            f"{p.stdout[-800:]}\n{p.stderr[-800:]}")
+    return violations
 
 
 def run_check_native() -> list:
@@ -358,6 +390,7 @@ def main() -> int:
     fronts = [("check_metrics", run_check_metrics),
               ("check_hotpath", run_check_hotpath),
               ("check_state", run_check_state),
+              ("concurrency", run_concurrency),
               ("check_native", run_check_native),
               ("gen_metrics_doc", run_gen_metrics_doc),
               ("check_dashboard", run_check_dashboard),

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Shared AST walker for the field-claim lints.
+
+Two lints claim every instance attribute of a registered class against a
+schema registry and check BOTH directions (unclaimed attribute, stale
+claim): ``tools/check_state.py`` (persistence claims against
+``dbsp_tpu.checkpoint.STATE_SCHEMA``) and ``tools/check_concurrency.py``
+(guard claims against ``dbsp_tpu.concurrency.CONCURRENCY_SCHEMA``). The
+attribute walk lives HERE, once, so the two lints cannot drift in what
+they consider "a field of the class".
+
+Semantics of :func:`self_attrs`:
+
+* class-level attribute defaults (``spans = None``) count, ALL_CAPS
+  constants excluded (``_FIELDS`` is a constant, ``name`` is a field);
+* every ``self.X = ...`` / ``self.X: T = ...`` / ``self.X += ...``
+  anywhere in the class body counts, including tuple targets and
+  assignments inside nested FUNCTIONS (closures share the enclosing
+  ``self``);
+* nested CLASS definitions are skipped — their ``self`` is a different
+  object (the per-request ``Handler`` classes inside the HTTP servers).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List
+
+
+def iter_class_nodes(cls: ast.ClassDef) -> Iterator[ast.AST]:
+    """``ast.walk`` over a class body that does NOT descend into nested
+    ClassDef subtrees (their ``self`` binds a different instance)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(cls))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def self_attrs(cls: ast.ClassDef) -> Dict[str, int]:
+    """attr -> first line of every ``self.X = ...`` in the class body,
+    plus class-level attribute defaults — ALL_CAPS constants excluded."""
+    out: Dict[str, int] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and not t.id.isupper():
+                    out.setdefault(t.id, stmt.lineno)
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                not stmt.target.id.isupper():
+            out.setdefault(stmt.target.id, stmt.lineno)
+    for node in iter_class_nodes(cls):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            # tuple targets: self.a, self.b = ...
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Attribute) and \
+                        isinstance(e.value, ast.Name) and \
+                        e.value.id == "self":
+                    out.setdefault(e.attr, node.lineno)
+    return out
+
+
+def find_class(tree: ast.AST, name: str) -> ast.ClassDef | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
