@@ -79,6 +79,21 @@ LEVEL0_CAP = int(os.environ.get("DBSP_TPU_TRACE_L0", "1024"))
 LEVEL_GROWTH = int(os.environ.get("DBSP_TPU_TRACE_GROWTH", "4"))
 
 
+def lazy_post_enabled() -> bool:
+    """LAZY post views: after a SLOTTED append, consumers probe the
+    (consolidated) delta itself as one more ladder level instead of
+    re-reading the freshly written level-0 slot — CTrace.eval stops being
+    a materialization consumers wait on (the dynamic_update_slice's only
+    remaining reader is the donated state carry, which XLA aliases in
+    place). The Z-set a consumer sees is IDENTICAL — the written slot
+    holds exactly the delta's rows — only the raw slot order of the fused
+    consumers' (pre-consolidation) buffers changes, which every consumer
+    canonicalizes away (CJoin consolidates, the reducers net, distinct
+    reads ``pre``). ``DBSP_TPU_TRACE_LAZY_POST=0`` is the code-free A/B
+    control (pairs with the ``DBSP_TPU_NATIVE`` per-kernel force-off)."""
+    return os.environ.get("DBSP_TPU_TRACE_LAZY_POST", "1") != "0"
+
+
 def levels_for_run(ticks: int) -> int:
     """Level count that amortizes tail merges for a planned run length.
 
@@ -203,6 +218,10 @@ class _Leveled:
         if can_slot and getattr(self, "_slot_cap", None) is None:
             self._slot_cap = dcap
         slotted = can_slot and self._slot_cap == dcap
+        # static per-trace decision consumed by the lazy post view (the
+        # same inputs retrace to the same value, so the step program's
+        # structure is stable across retraces)
+        self._append_slotted = slotted
         if slotted:
             nslots = l0.cap // dcap
             w_slots = l0.weights.reshape(
@@ -336,6 +355,21 @@ def gather_levels(qkeys, qlive, levels: Sequence[Batch], out_cap: int):
     assert levels, "gather_levels: trace has no levels (TRACE_LEVELS >= 1)"
     part, total = cursor.gather_ladder(qkeys, qlive, levels, out_cap)
     return part, total.astype(jnp.int64)
+
+
+def ensure_side_cap(cn: "CNode", key: str, floor: int) -> int:
+    """Size a fused join consumer's shared output buffer lazily on FIRST
+    eval (compile time knows no delta shapes) — the ONE sizing helper both
+    join directions and the range join share. The floor lands on
+    ``bucket_cap``'s power-of-two grow ladder: the old raw ``max(64,
+    delta.cap)`` guess lived OFF the ladder the requirement-driven regrow
+    (CompiledHandle.grow) climbs, so the first-tick guess and the
+    ladder-total requirement could drift apart across the two directions
+    (left at a raw 6900, right regrown to a bucketed 8192 — two different
+    capacity vocabularies for one node's A/B and presize accounting)."""
+    if not cn.caps.get(key):
+        cn.caps[key] = bucket_cap(max(64, floor))
+    return cn.caps[key]
 
 
 def trim_queries(ctx, cn: "CNode", qkeys, qlive):
@@ -555,8 +589,18 @@ class CTrace(CNode, _Leveled):
     def eval(self, ctx, state, inputs):
         delta = inputs[0]
         post = self._levels_append(ctx, state, delta)
-        return post, CView(delta=delta, pre=self._view_levels(state[0]),
-                           post=self._view_levels(post[0]))
+        pre = self._view_levels(state[0])
+        # LAZY post view (see lazy_post_enabled): after a slotted append
+        # the post-tick trace IS pre + delta — hand consumers the delta as
+        # one more ladder level instead of making them read the slot just
+        # written. Gated on a tagged-consolidated delta (the slot ladder's
+        # run invariant) — anything else keeps the materialized view.
+        if getattr(self, "_append_slotted", False) and \
+                delta.sorted_runs == 1 and lazy_post_enabled():
+            post_view: Tuple[Batch, ...] = (*pre, delta)
+        else:
+            post_view = self._view_levels(post[0])
+        return post, CView(delta=delta, pre=pre, post=post_view)
 
 
 class CJoin(CNode):
@@ -573,19 +617,15 @@ class CJoin(CNode):
         nk = self.op._left_core.nk
         fn = self.op._left_core.fn
         flipped = self.op._right_core.fn
-        if not self.caps["left"]:
-            self.caps["left"] = max(64, left.delta.cap)
-        if not self.caps["right"]:
-            self.caps["right"] = max(64, right.delta.cap)
+        cap_l = ensure_side_cap(self, "left", left.delta.cap)
+        cap_r = ensure_side_cap(self, "right", right.delta.cap)
         # ΔL joins every level of trace(R) post-append; ΔR every level of
         # trace(L) pre-append — each side's K level results land in ONE
         # shared buffer (requirement = total across levels), so the final
         # consolidate sorts 2 buffers regardless of K
-        lout, ltot = join_levels(left.delta, right.post, nk, fn,
-                                 self.caps["left"])
+        lout, ltot = join_levels(left.delta, right.post, nk, fn, cap_l)
         ctx.require(self, "left", ltot)
-        rout, rtot = join_levels(right.delta, left.pre, nk, flipped,
-                                 self.caps["right"])
+        rout, rtot = join_levels(right.delta, left.pre, nk, flipped, cap_r)
         ctx.require(self, "right", rtot)
         out = concat_batches([lout, rout])
         if not getattr(self, "defer_consolidate", False):
@@ -899,9 +939,8 @@ class CRangeJoin(CNode):
 
     def eval(self, ctx, state, inputs):
         left, right = inputs
-        if not self.caps["left"]:
-            self.caps["left"] = max(64, left.delta.cap)
-            self.caps["right"] = max(64, right.delta.cap)
+        ensure_side_cap(self, "left", left.delta.cap)
+        ensure_side_cap(self, "right", right.delta.cap)
         lout = self._fan(ctx, "left", left.delta, right.post,
                          self.op._left)
         rout = self._fan(ctx, "right", right.delta, left.pre,

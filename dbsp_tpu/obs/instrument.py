@@ -82,7 +82,10 @@ def export_kernel_dispatch(registry: MetricsRegistry) -> None:
         "dbsp_tpu_zset_kernel_dispatch_total",
         "Z-set kernel dispatch decisions by entry point and backend "
         "(native = C++ FFI custom call, xla = pure-XLA lowering, "
-        "pallas = hand-written Pallas program)",
+        "pallas = hand-written Pallas program); the fused ladder-consumer "
+        "megakernels report as kernel=join_ladder / gather_ladder / "
+        "old_weights, whose xla rows are the stitched-chain fallback "
+        "(the DBSP_TPU_NATIVE force-off A/B control)",
         labels=("kernel", "backend"))
 
     def _collect() -> None:

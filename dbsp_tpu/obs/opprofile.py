@@ -31,12 +31,19 @@ the same way on both engines):
   ``DBSP_TPU_PROFILE=segment`` is set.
 
 Methodology caveats, stated once: segments do NOT donate their state
-operands (the fused program does), so a leveled trace node is charged the
-pass-through copy of its deep levels each segmented tick and lost
-cross-operator fusion inflates the absolute numbers — the report carries
-``segmentation_overhead`` (segmented / fused ms per tick) so readers can
-see the distortion, and relative attribution (which node dominates) is the
-quantity the mode exists for. Sharded (``workers > 1``) circuits run the
+operands (the fused program does) and lost cross-operator fusion inflates
+the absolute numbers — the report carries ``segmentation_overhead``
+(segmented / fused ms per tick) so readers can see the distortion, and
+relative attribution (which node dominates) is the quantity the mode
+exists for. One distortion IS corrected exactly: a value a node returns
+UNTOUCHED (a leveled trace's deep levels flowing through its state, a
+trace view handing consumers the very level tracers it was given, a
+sink echoing its input batch) is elided from the segment's program
+outputs and substituted from the caller's own operands after the call —
+identity, not approximation — so a node is charged for what it computes,
+not for round-tripping state the fused program would alias in place
+(pre-elision, the two q4 CTrace nodes' pass-through copies dominated the
+whole attribution table). Sharded (``workers > 1``) circuits run the
 whole step inside one ``shard_map`` and are not segmentable; profiling them
 raises :class:`ProfileError` (the ``/profile`` route degrades to the static
 metadata it can still serve).
@@ -217,16 +224,58 @@ class SegmentedStep:
         if ent is not None:
             return ent
         pkey = self._partner_key(cn)
+        meta: Dict[str, Any] = {}
 
         def fn(state, ins, feed, partner_state):
             ctx = _SegCtx({idx: feed} if feed is not None else {},
                           {pkey: partner_state} if pkey is not None else {})
             st2, out = cn.eval(ctx, state, list(ins))
-            return (st2, out, tuple(ctx.reqs), dict(ctx.gc_bounds),
-                    dict(ctx.outputs))
+            # identity pass-through elision (module doc): any returned
+            # leaf that IS one of the operand tracers (state levels
+            # flowing through, views handing back their inputs, sinks
+            # echoing batches) — or a repeat of an already-emitted output
+            # leaf — leaves the program and is reconstructed from the
+            # caller's operands after the call. Exact by construction:
+            # the tracer identity proves the value is the operand.
+            arg_leaves = jax.tree_util.tree_flatten(
+                (state, ins, feed, partner_state))[0]
+            env = {}
+            for i, leaf in enumerate(arg_leaves):
+                if isinstance(leaf, jax.core.Tracer):
+                    env.setdefault(id(leaf), i)
+            ret_leaves, ret_def = jax.tree_util.tree_flatten(
+                (st2, out, dict(ctx.outputs)))
+            plan: List[Tuple[str, int]] = []
+            kept: List[Any] = []
+            emitted: Dict[int, int] = {}
+            for leaf in ret_leaves:
+                lid = id(leaf)
+                if isinstance(leaf, jax.core.Tracer) and lid in env:
+                    plan.append(("arg", env[lid]))
+                    continue
+                if isinstance(leaf, jax.core.Tracer) and lid in emitted:
+                    plan.append(("out", emitted[lid]))
+                    continue
+                if isinstance(leaf, jax.core.Tracer):
+                    emitted[lid] = len(kept)
+                plan.append(("out", len(kept)))
+                kept.append(leaf)
+            meta["plan"], meta["ret_def"] = plan, ret_def
+            return tuple(kept), tuple(ctx.reqs), dict(ctx.gc_bounds)
 
-        executable = jax.jit(fn).lower(*args).compile()
-        self.costs[idx] = _cost_of(executable)
+        compiled = jax.jit(fn).lower(*args).compile()
+        self.costs[idx] = _cost_of(compiled)
+        plan, ret_def = meta["plan"], meta["ret_def"]
+
+        def executable(state, ins, feed, partner_state):
+            kept, reqs, gc = compiled(state, ins, feed, partner_state)
+            arg_leaves = jax.tree_util.tree_flatten(
+                (state, ins, feed, partner_state))[0]
+            leaves = [arg_leaves[i] if kind == "arg" else kept[i]
+                      for kind, i in plan]
+            st2, out, outs = jax.tree_util.tree_unflatten(ret_def, leaves)
+            return st2, out, reqs, gc, outs
+
         ent = self._segments[key] = (executable, pkey)
         return ent
 

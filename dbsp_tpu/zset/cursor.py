@@ -24,6 +24,13 @@ This module collapses that fan-out (the engine's answer to the reference's
 * :func:`join_ladder` / :func:`gather_ladder` / :func:`old_weights_ladder`
   — the three hot consumers (incremental join, aggregate group gather,
   distinct old-weight lookup) as single fused kernels over the ladder.
+  On CPU with the native library each consumer is ONE megakernel custom
+  call (probe + expand + gather + weight-combine —
+  ``native_merge.join_ladder_native`` & co.); with Pallas selected it is
+  one grid-over-levels megakernel (``pallas_kernels.join_ladder_pallas``);
+  the stitched probe-ladder/expand/gather chain below is the pure-XLA
+  fallback and the force-off A/B control (``DBSP_TPU_NATIVE=join_ladder``
+  etc. — see ``native_merge.kernel_enabled``).
 
 All functions are pure/traceable over 1-D row axes; sharded callers lift
 them per worker exactly like the per-level kernels they replace
@@ -183,6 +190,25 @@ def _select_gather(cols_per_level: Sequence[Cols], level: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
+def _finish_join(fn, key_cols, lvals, rvals, w, valid, total
+                 ) -> Tuple[Batch, jnp.ndarray]:
+    """Apply the pair function + dead-slot sentinel mask — the (cheap,
+    elementwise) tail every join_ladder backend shares, so the fused
+    megakernels and the stitched chain produce bit-identical batches."""
+    out_keys, out_vals = fn(key_cols, lvals, rvals)
+    # dead slots must carry sentinels so they sort to the tail later
+    out_keys = tuple(jnp.where(valid, c, kernels.sentinel_for(c.dtype))
+                     for c in out_keys)
+    out_vals = tuple(jnp.where(valid, c, kernels.sentinel_for(c.dtype))
+                     for c in out_vals)
+    return Batch(out_keys, out_vals, w), total
+
+
+def _ladder_dtypes(delta: Batch, levels: Sequence[Batch]):
+    return (*(c.dtype for c in delta.cols), delta.weights.dtype,
+            *(c.dtype for lvl in levels for c in (*lvl.cols, lvl.weights)))
+
+
 def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
                 out_cap: int) -> Tuple[Batch, jnp.ndarray]:
     """Join a delta against ALL trace levels: one probe pair, one expansion,
@@ -193,9 +219,44 @@ def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
     UNCLAMPED cross-level requirement — when it exceeds ``out_cap`` the
     tail matches drop off the end and the caller grows + relaunches
     (host) or the runner's validation replays (compiled).
+
+    Backend dispatch (1-D operands, int64-widenable columns): ONE native
+    megakernel custom call on CPU (probe + expand + both-side gathers +
+    weight product — ``native_merge.join_ladder_native``); one Pallas
+    grid-over-levels megakernel when Pallas is selected; else the stitched
+    probe-ladder/expand/gather chain below (also the
+    ``DBSP_TPU_NATIVE=join_ladder`` force-off control).
     """
     assert levels, "join_ladder: trace has no levels"
     dk = delta.keys[:nk]
+    if nk >= 1 and delta.weights.ndim == 1 and out_cap >= 1:
+        if kernels.pallas_requested():
+            from dbsp_tpu.zset import pallas_kernels
+
+            if pallas_kernels.use_pallas(
+                    "join_ladder",
+                    (*delta.cols, delta.weights,
+                     *(c for lvl in levels
+                       for c in (*lvl.cols, lvl.weights)))):
+                kernels.count_kernel_dispatch("join_ladder", "pallas")
+                qrow, rvals, w, valid, total = \
+                    pallas_kernels.join_ladder_pallas(
+                        dk, delta.weights, levels, nk, out_cap)
+                key_cols = tuple(c[qrow] for c in dk)
+                lvals = tuple(c[qrow] for c in delta.vals)
+                return _finish_join(fn, key_cols, lvals, rvals, w, valid,
+                                    total)
+        if kernels.native_kernel("join_ladder"):
+            from dbsp_tpu.zset import native_merge
+
+            if native_merge.supports(_ladder_dtypes(delta, levels)):
+                kernels.count_kernel_dispatch("join_ladder", "native")
+                key_cols, lvals, rvals, w, valid, total = \
+                    native_merge.join_ladder_native(delta, levels, nk,
+                                                    out_cap)
+                return _finish_join(fn, key_cols, lvals, rvals, w, valid,
+                                    total)
+    kernels.count_kernel_dispatch("join_ladder", "xla")
     tables = [lvl.keys[:nk] for lvl in levels]
     lo = lex_probe_ladder(tables, dk, side="left")
     hi = lex_probe_ladder(tables, dk, side="right")
@@ -210,13 +271,7 @@ def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
     key_cols = tuple(c[qrow] for c in dk)
     lvals = tuple(c[qrow] for c in delta.vals)
     rvals = _select_gather([lvl.vals for lvl in levels], level, src)
-    out_keys, out_vals = fn(key_cols, lvals, rvals)
-    # dead slots must carry sentinels so they sort to the tail later
-    out_keys = tuple(jnp.where(valid, c, kernels.sentinel_for(c.dtype))
-                     for c in out_keys)
-    out_vals = tuple(jnp.where(valid, c, kernels.sentinel_for(c.dtype))
-                     for c in out_vals)
-    return Batch(out_keys, out_vals, w), total
+    return _finish_join(fn, key_cols, lvals, rvals, w, valid, total)
 
 
 def gather_ladder(qkeys: Cols, qlive: jnp.ndarray, levels: Sequence[Batch],
@@ -240,10 +295,37 @@ def gather_ladder(qkeys: Cols, qlive: jnp.ndarray, levels: Sequence[Batch],
     NOTE: with K > 1 the part may hold cross-level insert/retract rows for
     one (qrow, vals) — reducers must net them
     (``_reduce_groups_impl(..., net=True)``), exactly as with the old
-    combined buffer."""
+    combined buffer.
+
+    Backend dispatch mirrors :func:`join_ladder`: ONE native megakernel
+    custom call on CPU (``native_merge.gather_ladder_native`` — the part
+    comes back final, dead slots canonical), one Pallas megakernel when
+    selected, else the stitched chain (the ``DBSP_TPU_NATIVE=gather_ladder``
+    force-off control)."""
     assert levels, "gather_ladder: trace has no levels"
     nk = len(qkeys)
     q_cap = qlive.shape[-1]
+    if nk >= 1 and qlive.ndim == 1 and out_cap >= 1:
+        _all_cols = (*qkeys, *(qhi_keys or ()),
+                     *(c for lvl in levels
+                       for c in (*lvl.cols, lvl.weights)))
+        if kernels.pallas_requested():
+            from dbsp_tpu.zset import pallas_kernels
+
+            if pallas_kernels.use_pallas("gather_ladder", _all_cols):
+                kernels.count_kernel_dispatch("gather_ladder", "pallas")
+                return pallas_kernels.gather_ladder_pallas(
+                    qkeys, qlive, levels, out_cap, qhi_keys=qhi_keys,
+                    gather_keys=gather_keys)
+        if kernels.native_kernel("gather_ladder"):
+            from dbsp_tpu.zset import native_merge
+
+            if native_merge.supports(c.dtype for c in _all_cols):
+                kernels.count_kernel_dispatch("gather_ladder", "native")
+                return native_merge.gather_ladder_native(
+                    qkeys, qlive, levels, out_cap, qhi_keys=qhi_keys,
+                    gather_keys=gather_keys)
+    kernels.count_kernel_dispatch("gather_ladder", "xla")
     tables = [lvl.keys[:nk] for lvl in levels]
     lo = lex_probe_ladder(tables, qkeys, side="left")
     hi = lex_probe_ladder(tables, qkeys if qhi_keys is None else qhi_keys,
@@ -268,8 +350,18 @@ def old_weights_ladder(delta: Batch, levels: Sequence[Batch]) -> jnp.ndarray:
     """Accumulated weight of each delta ROW (keys+vals) across ALL levels —
     the fused form of distinct's per-level probe-and-sum. Rows are unique
     within a consolidated level, so each (level, row) range is 0 or 1 wide;
-    present weights sum across levels."""
+    present weights sum across levels. ONE native custom call on CPU
+    (``native_merge.old_weights_ladder_native``); the stitched probe pair
+    below is the fallback and the ``DBSP_TPU_NATIVE=old_weights`` control."""
     assert levels, "old_weights_ladder: trace has no levels"
+    if len(delta.cols) >= 1 and delta.weights.ndim == 1 and \
+            kernels.native_kernel("old_weights"):
+        from dbsp_tpu.zset import native_merge
+
+        if native_merge.supports(_ladder_dtypes(delta, levels)):
+            kernels.count_kernel_dispatch("old_weights", "native")
+            return native_merge.old_weights_ladder_native(delta, levels)
+    kernels.count_kernel_dispatch("old_weights", "xla")
     cols = delta.cols
     tables = [lvl.cols for lvl in levels]
     lo = lex_probe_ladder(tables, cols, side="left")

@@ -115,16 +115,24 @@ def pallas_requested() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def sentinel_for(dtype) -> jnp.ndarray:
-    """Largest representable value of ``dtype`` — reserved to mark dead rows."""
+def sentinel_scalar(dtype):
+    """Largest representable value of ``dtype`` as a HOST scalar — the ONE
+    definition of the dead-row sentinel; callers that need the value
+    outside a device array (the native FFI wrappers widening it to int64)
+    read it here so it can never drift from :func:`sentinel_for`."""
     dtype = jnp.dtype(dtype)
     if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(jnp.inf, dtype=dtype)
+        return float("inf")
     if jnp.issubdtype(dtype, jnp.integer):
-        return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
+        return int(jnp.iinfo(dtype).max)
     if dtype == jnp.bool_:
-        return jnp.array(True)
+        return True
     raise TypeError(f"unsupported column dtype {dtype}")
+
+
+def sentinel_for(dtype) -> jnp.ndarray:
+    """Largest representable value of ``dtype`` — reserved to mark dead rows."""
+    return jnp.array(sentinel_scalar(dtype), dtype=jnp.dtype(dtype))
 
 
 def sentinel_fill(shape, dtype) -> jnp.ndarray:

@@ -88,11 +88,18 @@ GATHER_TARGET = "dbsp_zset_gather"
 COMPACT_TARGET = "dbsp_zset_compact"
 PROBE_LADDER_TARGET = "dbsp_zset_probe_ladder"
 RANK_FOLD_TARGET = "dbsp_zset_rank_fold"
+JOIN_LADDER_TARGET = "dbsp_zset_join_ladder"
+GATHER_LADDER_TARGET = "dbsp_zset_gather_ladder"
+OLD_WEIGHTS_TARGET = "dbsp_zset_old_weights"
 
 # every native kernel the per-kernel force-off knob can address (the
-# DBSP_TPU_NATIVE csv grammar — see :func:`kernel_enabled`)
+# DBSP_TPU_NATIVE csv grammar — see :func:`kernel_enabled`). The last
+# three are the FUSED ladder consumers: forcing one off falls back to the
+# stitched probe/expand/gather chain (which still dispatches the granular
+# kernels above), so A/B runs can isolate exactly the fusion win.
 KERNELS = ("merge", "consolidate", "probe", "probe_ladder", "expand",
-           "gather", "compact", "rank_fold")
+           "gather", "compact", "rank_fold", "join_ladder",
+           "gather_ladder", "old_weights")
 
 
 def _build() -> str:
@@ -148,7 +155,10 @@ def _load() -> ctypes.CDLL:
                     (GATHER_TARGET, "ZsetGatherFfi"),
                     (COMPACT_TARGET, "ZsetCompactFfi"),
                     (PROBE_LADDER_TARGET, "ZsetProbeLadderFfi"),
-                    (RANK_FOLD_TARGET, "ZsetRankFoldFfi")):
+                    (RANK_FOLD_TARGET, "ZsetRankFoldFfi"),
+                    (JOIN_LADDER_TARGET, "ZsetJoinLadderFfi"),
+                    (GATHER_LADDER_TARGET, "ZsetGatherLadderFfi"),
+                    (OLD_WEIGHTS_TARGET, "ZsetOldWeightsFfi")):
                 _FFI.register_ffi_target(
                     target, _FFI.pycapsule(getattr(_lib, symbol)),
                     platform="cpu")
@@ -422,6 +432,122 @@ def compact_native(cols, weights: jnp.ndarray, keep: jnp.ndarray):
     out = _retag(out, weights)
     out_cols = tuple(c.astype(d) for c, d in zip(out[:ncols], dtypes))
     return out_cols, out[ncols].astype(weights.dtype)
+
+
+def _sentinel64(dtypes) -> tuple:
+    """Per-dtype sentinel values widened to int64 (host ints — traceable),
+    derived from the ONE dead-row sentinel definition
+    (``kernels.sentinel_scalar``) so the native megakernels' dead slots
+    can never drift from the stitched/Pallas backends' bit-identity
+    contract."""
+    from dbsp_tpu.zset import kernels
+
+    return tuple(int(kernels.sentinel_scalar(d)) for d in dtypes)
+
+
+def join_ladder_native(delta, levels, nk: int, out_cap: int):
+    """The WHOLE fused incremental join in one custom call
+    (ZsetJoinLadderImpl): both ladder probes, dead-row zeroing, the
+    cross-level expansion, the delta-side qrow gathers (keys + vals), the
+    level-side value gather and the weight product — where even the native
+    stitched path paid 4+ custom calls with XLA where-mask glue between
+    them. Returns ``(key_cols, delta_val_cols, level_val_cols, w, valid,
+    total)`` in the original dtypes; the caller applies the pair function
+    and the dead-slot sentinel mask (cheap elementwise XLA) on top."""
+    _load()
+    K = len(levels)
+    dk = delta.keys[:nk]
+    ndv = len(delta.vals)
+    nlv = len(levels[0].vals)
+    key_dts = tuple(c.dtype for c in dk)
+    dval_dts = tuple(c.dtype for c in delta.vals)
+    lval_dts = tuple(c.dtype for c in levels[0].vals)
+    ops = [c.astype(jnp.int64) for c in (*dk, *delta.vals)]
+    ops.append(delta.weights.astype(jnp.int64))
+    for lvl in levels:
+        ops.extend(c.astype(jnp.int64)
+                   for c in (*lvl.keys[:nk], *lvl.vals, lvl.weights))
+    ops.append(jnp.asarray([K, nk, ndv, nlv], jnp.int64))
+    n_out = nk + ndv + nlv
+    result = (*(jax.ShapeDtypeStruct((out_cap,), jnp.int64)
+                for _ in range(n_out + 1)),
+              jax.ShapeDtypeStruct((out_cap,), jnp.bool_),
+              jax.ShapeDtypeStruct((1,), jnp.int64))
+    out = _FFI.ffi_call(JOIN_LADDER_TARGET, result,
+                        vmap_method="sequential")(*ops)
+    out = _retag(out, delta.weights)
+    key_cols = tuple(c.astype(d) for c, d in zip(out[:nk], key_dts))
+    dvals = tuple(c.astype(d)
+                  for c, d in zip(out[nk:nk + ndv], dval_dts))
+    lvals = tuple(c.astype(d)
+                  for c, d in zip(out[nk + ndv:n_out], lval_dts))
+    w = out[n_out].astype(delta.weights.dtype)
+    valid = out[n_out + 1]
+    total = out[n_out + 2].reshape(())
+    return key_cols, dvals, lvals, w, valid, total
+
+
+def gather_ladder_native(qkeys, qlive, levels, out_cap: int,
+                         qhi_keys=None, gather_keys: int = 0):
+    """The WHOLE fused group gather in one custom call
+    (ZsetGatherLadderImpl): both ladder probes (equality or distinct
+    [lo, hi] range bounds), the cross-level expansion, the leveled value
+    gather and the dead-slot canonicalization (qrow == q_cap, sentinel
+    cols, weight 0) — the consumer-facing ``((qrow, vals, w), total)``
+    part comes back FINAL, no XLA post-pass. Shares the contract of
+    ``cursor.gather_ladder`` exactly (``qhi_keys``/``gather_keys``
+    included)."""
+    _load()
+    K = len(levels)
+    nk = len(qkeys)
+    gcols0 = (*levels[0].keys[nk - gather_keys:nk], *levels[0].vals) \
+        if gather_keys else tuple(levels[0].vals)
+    g_dts = tuple(c.dtype for c in gcols0)
+    ng = len(gcols0)
+    ops = [c.astype(jnp.int64) for c in qkeys]
+    if qhi_keys is not None:
+        ops.extend(c.astype(jnp.int64) for c in qhi_keys)
+    ops.append(qlive.astype(jnp.bool_))
+    for lvl in levels:
+        gc = (*lvl.keys[nk - gather_keys:nk], *lvl.vals) if gather_keys \
+            else tuple(lvl.vals)
+        ops.extend(c.astype(jnp.int64)
+                   for c in (*lvl.keys[:nk], *gc, lvl.weights))
+    ops.append(jnp.asarray(_sentinel64(g_dts), jnp.int64))
+    ops.append(jnp.asarray([K, nk, 1 if qhi_keys is not None else 0],
+                           jnp.int64))
+    result = (jax.ShapeDtypeStruct((out_cap,), jnp.int32),
+              *(jax.ShapeDtypeStruct((out_cap,), jnp.int64)
+                for _ in range(ng + 1)),
+              jax.ShapeDtypeStruct((1,), jnp.int64))
+    out = _FFI.ffi_call(GATHER_LADDER_TARGET, result,
+                        vmap_method="sequential")(*ops)
+    out = _retag(out, qlive)
+    qrow = out[0]
+    vals = tuple(c.astype(d) for c, d in zip(out[1:1 + ng], g_dts))
+    w = out[1 + ng].astype(levels[0].weights.dtype)
+    total = out[2 + ng].reshape(())
+    return (qrow, vals, w), total
+
+
+def old_weights_ladder_native(delta, levels) -> jnp.ndarray:
+    """Distinct's old-weight lookup in one custom call
+    (ZsetOldWeightsImpl): per delta row, one exact-match binary search per
+    level with the present weights summed — drop-in for the CPU branch of
+    ``cursor.old_weights_ladder``."""
+    _load()
+    K = len(levels)
+    nc = len(delta.cols)
+    ops = [c.astype(jnp.int64) for c in delta.cols]
+    ops.append(delta.weights.astype(jnp.int64))
+    for lvl in levels:
+        ops.extend(c.astype(jnp.int64) for c in (*lvl.cols, lvl.weights))
+    ops.append(jnp.asarray([K, nc], jnp.int64))
+    m = delta.weights.shape[-1]
+    result = (jax.ShapeDtypeStruct((m,), jnp.int64),)
+    out = _FFI.ffi_call(OLD_WEIGHTS_TARGET, result,
+                        vmap_method="sequential")(*ops)
+    return _retag(out, delta.weights)[0].astype(delta.weights.dtype)
 
 
 def rank_fold_native(cols, weights: jnp.ndarray, runs):

@@ -16,6 +16,14 @@ compiler on irregular gather/scatter and hand-write the hot loop):
   ``kernels.merge_sorted_cols`` (cross-rank binary search + position
   scatter) as a single program; the netting/compaction tail stays shared
   with the XLA path.
+* :func:`join_ladder_pallas` / :func:`gather_ladder_pallas` — the FUSED
+  trace-ladder consumers (``cursor.join_ladder`` / ``cursor.gather_ladder``)
+  as megakernels: grid over the K trace levels with static [K, maxcap]
+  stacked blocks, each program probing its level, resolving its window of
+  the shared output buffer through in-kernel prefix sums, and gathering its
+  level's values — probe + expand + gather + weight-combine in ONE
+  ``pallas_call``, with the running cross-level offset carried in the total
+  output block across the (sequential) grid.
 
 Selection: :func:`use_pallas` — ON when ``jax.default_backend() != "cpu"``
 (the CPU backend keeps its native C++ custom calls), overridable with
@@ -180,6 +188,197 @@ def lex_probe_ladder_pallas(tables: Sequence[Cols], query_cols: Cols,
         interpret=interpret_mode(),
     )(caps_arr, *stacked, *qcols)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fused ladder-consumer megakernels (probe + expand + gather, one call)
+# ---------------------------------------------------------------------------
+
+
+def _ladder_consumer_kernel(caps_ref, *refs, nk: int, ng: int,
+                            steps_tab: int, steps_q: int, join: bool):
+    """One grid step = one trace level: probe it, compute this level's
+    window of the shared [1, out_cap] output (the running cross-level
+    offset rides in the total block — TPU grids are sequential, so program
+    k reads the sum of programs 0..k-1's totals), resolve each window slot
+    to its (query, source) pair through the level-local prefix sums, and
+    gather the level's values + weights into the shared buffers."""
+    idx = 0
+    tabs = [refs[idx + i][:] for i in range(nk)]            # [1, maxcap]
+    idx += nk
+    gcols = [refs[idx + i][:] for i in range(ng)]           # [1, maxcap]
+    idx += ng
+    lw = refs[idx][:]                                       # [1, maxcap]
+    idx += 1
+    qlo = [refs[idx + i][:] for i in range(nk)]             # [1, m]
+    idx += nk
+    qhi = [refs[idx + i][:] for i in range(nk)]             # [1, m]
+    idx += nk
+    qm = refs[idx][:]                                       # [1, m] int64:
+    idx += 1                       # delta weights (join) / live 0|1 (gather)
+    qrow_ref = refs[idx]
+    out_refs = refs[idx + 1: idx + 1 + ng]
+    w_ref = refs[idx + 1 + ng]
+    tot_ref = refs[idx + 2 + ng]
+    k = pl.program_id(0)
+    m = qlo[0].shape[-1]
+    out_cap = w_ref.shape[-1]
+
+    @pl.when(k == 0)
+    def _init():
+        qrow_ref[:] = jnp.zeros((1, out_cap), jnp.int32)
+        for r in out_refs:
+            r[:] = jnp.zeros((1, out_cap), jnp.int64)
+        w_ref[:] = jnp.zeros((1, out_cap), jnp.int64)
+        tot_ref[:] = jnp.zeros((1, 1), jnp.int64)
+
+    cap = caps_ref[0, 0]
+    lo = _lex_search(tabs, qlo, cap, steps_tab, strict=True)
+    hi = _lex_search(tabs, qhi, cap, steps_tab, strict=False)
+    live = qm != 0
+    lo = jnp.where(live, lo, 0)
+    # distinct bounds may give an empty range (qhi < qlo): clamp gathers
+    # nothing — a no-op for the equality/join form where hi >= lo always
+    hi = jnp.where(live, jnp.maximum(hi, lo), lo)
+    counts = (hi - lo).astype(jnp.int64)
+    csum = jnp.cumsum(counts, axis=-1)
+    starts = csum - counts
+    tot_k = csum[0, m - 1]
+    base = tot_ref[0, 0]
+    j = jax.lax.broadcasted_iota(jnp.int64, (1, out_cap), 1)
+    local = j - base
+    sel = (local >= 0) & (local < tot_k)
+    q = jnp.clip(local, 0, jnp.maximum(tot_k - 1, 0))
+    # searchsorted-right over the level-local prefix sums == the stitched
+    # expand_ladder's slot resolution restricted to this level's window
+    flat = _lex_search([starts], [q], m, steps_q, strict=False) - 1
+    flat = jnp.clip(flat, 0, m - 1)
+    src = (jnp.take_along_axis(lo, flat, axis=1).astype(jnp.int64) + q
+           - jnp.take_along_axis(starts, flat, axis=1))
+    srci = jnp.clip(src, 0, jnp.maximum(cap - 1, 0)).astype(jnp.int32)
+    lw_slot = jnp.take_along_axis(lw, srci, axis=1)
+    if join:
+        w_slot = jnp.take_along_axis(qm, flat, axis=1) * lw_slot
+    else:
+        w_slot = lw_slot
+    qrow_ref[:] = jnp.where(sel, flat.astype(jnp.int32), qrow_ref[:])
+    for r, g in zip(out_refs, gcols):
+        r[:] = jnp.where(sel, jnp.take_along_axis(g, srci, axis=1), r[:])
+    w_ref[:] = jnp.where(sel, w_slot, w_ref[:])
+    tot_ref[:] = jnp.full((1, 1), base + tot_k, jnp.int64)
+
+
+def _stack_levels(cols_per_level, maxcap: int, pad: int):
+    """[K, maxcap] int64 stack of one column across heterogeneous levels
+    (the pad value is never read: sources clamp to the level's own cap)."""
+    rows = []
+    for c in cols_per_level:
+        c = c.astype(jnp.int64)
+        if c.shape[-1] < maxcap:
+            c = jnp.concatenate(
+                [c, jnp.full((maxcap - c.shape[-1],), pad, jnp.int64)])
+        rows.append(c)
+    return jnp.stack(rows)
+
+
+def _ladder_consumer_call(key_tabs, gather_tabs, weight_tab, qlo_cols,
+                          qhi_cols, qmask, out_cap: int, join: bool):
+    """Shared pallas_call builder for both megakernels. Returns raw
+    ``(qrow, gathered int64 cols, w int64, total)`` — callers mask dead
+    slots into their consumer-facing form."""
+    K = len(weight_tab)
+    nk = len(qlo_cols)
+    ng = len(gather_tabs[0]) if gather_tabs else 0
+    m = qlo_cols[0].shape[-1]
+    caps = [w.shape[-1] for w in weight_tab]
+    maxcap = max(caps)
+    steps_tab = max(c.bit_length() for c in caps)
+    steps_q = m.bit_length()
+    pad = int(np.iinfo(np.int64).max)
+    stacked = [_stack_levels([t[ci] for t in key_tabs], maxcap, pad)
+               for ci in range(nk)]
+    stacked += [_stack_levels([t[ci] for t in gather_tabs], maxcap, 0)
+                for ci in range(ng)]
+    stacked.append(_stack_levels(weight_tab, maxcap, 0))
+    qs = [c.astype(jnp.int64).reshape(1, m) for c in qlo_cols]
+    qs += [c.astype(jnp.int64).reshape(1, m) for c in qhi_cols]
+    qs.append(qmask.astype(jnp.int64).reshape(1, m))
+    caps_arr = jnp.asarray(caps, jnp.int32).reshape(K, 1)
+
+    in_specs = [pl.BlockSpec((1, 1), lambda k: (k, 0))]
+    in_specs += [pl.BlockSpec((1, maxcap), lambda k: (k, 0))
+                 for _ in range(nk + ng + 1)]
+    in_specs += [pl.BlockSpec((1, m), lambda k: (0, 0))
+                 for _ in range(2 * nk + 1)]
+    # every program revisits the SAME output block (index 0): the buffers
+    # stay resident across the sequential grid and accumulate level windows
+    out_specs = [pl.BlockSpec((1, out_cap), lambda k: (0, 0))
+                 for _ in range(ng + 2)]
+    out_specs.append(pl.BlockSpec((1, 1), lambda k: (0, 0)))
+    out_shape = [jax.ShapeDtypeStruct((1, out_cap), jnp.int32)]
+    out_shape += [jax.ShapeDtypeStruct((1, out_cap), jnp.int64)
+                  for _ in range(ng + 1)]
+    out_shape.append(jax.ShapeDtypeStruct((1, 1), jnp.int64))
+    out = pl.pallas_call(
+        partial(_ladder_consumer_kernel, nk=nk, ng=ng, steps_tab=steps_tab,
+                steps_q=steps_q, join=join),
+        grid=(K,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret_mode(),
+    )(caps_arr, *stacked, *qs)
+    qrow = out[0].reshape(out_cap)
+    gathered = tuple(c.reshape(out_cap) for c in out[1:1 + ng])
+    w = out[1 + ng].reshape(out_cap)
+    total = out[2 + ng].reshape(())
+    return qrow, gathered, w, total
+
+
+def join_ladder_pallas(delta_keys, delta_w, levels, nk: int, out_cap: int):
+    """The fused incremental-join core (``cursor.join_ladder`` minus the
+    pair function) as ONE Pallas megakernel: both ladder probes, dead-row
+    zeroing, cross-level expansion and the level-side value/weight gather.
+    Returns ``(qrow, level_val_cols, w, valid, total)``; the caller applies
+    the delta-side gathers, the pair function and the sentinel mask."""
+    lval_dts = tuple(c.dtype for c in levels[0].vals)
+    qrow, gathered, w, total = _ladder_consumer_call(
+        [lvl.keys[:nk] for lvl in levels],
+        [lvl.vals for lvl in levels],
+        [lvl.weights for lvl in levels],
+        delta_keys, delta_keys, delta_w, out_cap, join=True)
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    valid = j < total
+    lvals = tuple(c.astype(d) for c, d in zip(gathered, lval_dts))
+    return qrow, lvals, jnp.where(valid, w, 0).astype(delta_w.dtype), \
+        valid, total
+
+
+def gather_ladder_pallas(qkeys, qlive, levels, out_cap: int,
+                         qhi_keys=None, gather_keys: int = 0):
+    """The fused group gather (``cursor.gather_ladder``) as ONE Pallas
+    megakernel, ``qhi_keys``/``gather_keys`` included. Returns the final
+    consumer-facing ``((qrow, vals, w), total)`` with dead slots already
+    canonical (qrow == q_cap, sentinel vals, weight 0)."""
+    from dbsp_tpu.zset import kernels
+
+    nk = len(qkeys)
+    q_cap = qlive.shape[-1]
+    gtabs = [(*lvl.keys[nk - gather_keys:nk], *lvl.vals) if gather_keys
+             else tuple(lvl.vals) for lvl in levels]
+    g_dts = tuple(c.dtype for c in gtabs[0])
+    qrow, gathered, w, total = _ladder_consumer_call(
+        [lvl.keys[:nk] for lvl in levels], gtabs,
+        [lvl.weights for lvl in levels],
+        qkeys, qkeys if qhi_keys is None else qhi_keys,
+        qlive, out_cap, join=False)
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    valid = j < total
+    vals = tuple(jnp.where(valid, c.astype(d), kernels.sentinel_for(d))
+                 for c, d in zip(gathered, g_dts))
+    qrow = jnp.where(valid, qrow, jnp.int32(q_cap)).astype(jnp.int32)
+    w = jnp.where(valid, w, 0).astype(levels[0].weights.dtype)
+    return (qrow, vals, w), total
 
 
 # ---------------------------------------------------------------------------

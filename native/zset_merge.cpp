@@ -852,3 +852,406 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetRankFoldFfi, ZsetRankFoldImpl,
                               ffi::Ffi::Bind()
                                   .RemainingArgs()
                                   .RemainingRets());
+
+// ---------------------------------------------------------------------------
+// Fused ladder consumers: probe + expand + gather + weight-combine, ONE call
+// ---------------------------------------------------------------------------
+//
+// The three hot trace consumers (incremental join, aggregate group gather,
+// distinct old-weight lookup) used to stitch 4+ dispatches per eval even on
+// the native path: two ladder probes, one expansion, one-or-more grouped
+// gathers, plus XLA where-mask/qrow-gather glue between them. Each handler
+// below IS the whole consumer: the per-(level, query) ranges never leave the
+// C++ call, every output slot is produced exactly once in the level-major,
+// query-major order the stitched expansion used, and the weight combine
+// happens in the same pass. Bit-identity contract: emitted (valid) slots
+// match the stitched formulation exactly; slots past the live prefix carry
+// the caller-visible dead form (join: zeroed gather buffers + w=0 — the
+// caller's post-`fn` sentinel mask normalizes them on every path; gather:
+// qrow == q_cap + per-column sentinels + w=0, the final form directly).
+// The returned total is UNCLAMPED (the runner's overflow contract).
+
+namespace {
+
+// lo/hi ladder probe shared by the fused consumers: [K, m] int32 insertion
+// points of the query rows into every level, thread-partitioned by query
+// exactly like ZsetProbeLadderImpl.
+void probe_ladder_into(int64_t K, int64_t ncols, int64_t m,
+                       const std::vector<const int64_t*>& tcols,
+                       const std::vector<int64_t>& caps,
+                       const int64_t* const* qcols, bool right,
+                       int32_t* out) {
+  const int64_t T = probe_threads(K * m);
+  const int64_t chunk = (m + T - 1) / T;
+  parallel_for_threads(T, [&](int64_t t) {
+    const int64_t i0 = t * chunk;
+    const int64_t i1 = i0 + chunk < m ? i0 + chunk : m;
+    for (int64_t k = 0; k < K; ++k) {
+      probe_block_bfs(ncols, &tcols[k * ncols], caps[k], qcols,
+                      i0, i1, right, out + k * m);
+    }
+  });
+}
+
+}  // namespace
+
+// Fused incremental join over the whole trace ladder.
+//
+// Argument layout: [delta key cols nk, delta val cols ndv, delta weights,
+// then per level: nk key cols + nlv val cols + weights, then meta S64[4] =
+// (K, nk, ndv, nlv)]; results: [gathered delta key cols nk, gathered delta
+// val cols ndv, gathered level val cols nlv (all S64[cap]), weights
+// S64[cap] (delta_w * level_w, 0 on dead slots), valid PRED[cap],
+// total S64[1]]. The caller applies the pair function + sentinel mask on
+// top (cheap elementwise XLA); everything shape-changing happens here.
+
+static ffi::Error ZsetJoinLadderImpl(ffi::RemainingArgs args,
+                                     ffi::RemainingRets rets) {
+  if (args.size() < 2 || rets.size() < 4) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_ladder: argument/result count mismatch");
+  }
+  auto meta = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 1);
+  if (!meta.has_value() || meta->element_count() != 4) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_ladder: bad meta buffer");
+  }
+  const int64_t K = meta->typed_data()[0];
+  const int64_t nk = meta->typed_data()[1];
+  const int64_t ndv = meta->typed_data()[2];
+  const int64_t nlv = meta->typed_data()[3];
+  const int64_t per_level = nk + nlv + 1;
+  if (K < 1 || nk < 1 || ndv < 0 || nlv < 0 ||
+      args.size() != static_cast<size_t>(nk + ndv + 1 + K * per_level + 1) ||
+      rets.size() != static_cast<size_t>(nk + ndv + nlv + 3)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_ladder: argument count mismatch");
+  }
+  std::vector<const int64_t*> dcols(nk + ndv);
+  int64_t m = 0;
+  for (int64_t c = 0; c < nk + ndv; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!a.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_join_ladder: S64 delta col expected");
+    }
+    dcols[c] = a->typed_data();
+    m = static_cast<int64_t>(a->element_count());
+  }
+  auto dwb = args.get<ffi::Buffer<ffi::DataType::S64>>(nk + ndv);
+  if (!dwb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_ladder: bad delta weights");
+  }
+  const int64_t* dw = dwb->typed_data();
+  m = static_cast<int64_t>(dwb->element_count());
+  std::vector<const int64_t*> tkeys(K * nk), tvals(K * nlv), tw(K);
+  std::vector<int64_t> caps(K);
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t base = nk + ndv + 1 + k * per_level;
+    for (int64_t c = 0; c < nk + nlv + 1; ++c) {
+      auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(base + c);
+      if (!a.has_value()) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "zset_join_ladder: S64 level col expected");
+      }
+      if (c < nk) tkeys[k * nk + c] = a->typed_data();
+      else if (c < nk + nlv) tvals[k * nlv + (c - nk)] = a->typed_data();
+      else tw[k] = a->typed_data();
+      caps[k] = static_cast<int64_t>(a->element_count());
+    }
+  }
+  std::vector<int64_t*> ocols(nk + ndv + nlv);
+  int64_t cap = 0;
+  for (int64_t c = 0; c < nk + ndv + nlv + 1; ++c) {
+    auto o = rets.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!o.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_join_ladder: S64 result expected");
+    }
+    if (c < nk + ndv + nlv) ocols[c] = o.value()->typed_data();
+    else cap = static_cast<int64_t>(o.value()->element_count());
+  }
+  auto owb = rets.get<ffi::Buffer<ffi::DataType::S64>>(nk + ndv + nlv);
+  auto validb = rets.get<ffi::Buffer<ffi::DataType::PRED>>(nk + ndv + nlv + 1);
+  auto totalb = rets.get<ffi::Buffer<ffi::DataType::S64>>(nk + ndv + nlv + 2);
+  if (!owb.has_value() || !validb.has_value() || !totalb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_ladder: bad w/valid/total result");
+  }
+  int64_t* ow = owb.value()->typed_data();
+  bool* valid = validb.value()->typed_data();
+
+  std::vector<int32_t> lo(static_cast<size_t>(K * m));
+  std::vector<int32_t> hi(static_cast<size_t>(K * m));
+  probe_ladder_into(K, nk, m, tkeys, caps, dcols.data(), /*right=*/false,
+                    lo.data());
+  probe_ladder_into(K, nk, m, tkeys, caps, dcols.data(), /*right=*/true,
+                    hi.data());
+  int64_t o = 0, tot = 0;
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t* const* lv = nlv ? &tvals[k * nlv] : nullptr;
+    const int64_t* lw = tw[k];
+    for (int64_t i = 0; i < m; ++i) {
+      if (dw[i] == 0) continue;  // dead delta rows match nothing
+      const int64_t a = lo[k * m + i], b = hi[k * m + i];
+      const int64_t cnt = b > a ? b - a : 0;
+      for (int64_t t = 0; t < cnt && o < cap; ++t, ++o) {
+        const int64_t s = a + t;
+        for (int64_t c = 0; c < nk + ndv; ++c) ocols[c][o] = dcols[c][i];
+        for (int64_t c = 0; c < nlv; ++c) ocols[nk + ndv + c][o] = lv[c][s];
+        ow[o] = dw[i] * lw[s];
+        valid[o] = true;
+      }
+      tot += cnt;
+    }
+  }
+  // dead tail: zero gather buffers (the caller's post-fn sentinel mask is
+  // what every path's consumers see), w = 0, valid follows j < total so
+  // an overflow launch reports its clipped slots exactly like the XLA path
+  for (int64_t j = o; j < cap; ++j) {
+    for (int64_t c = 0; c < nk + ndv + nlv; ++c) ocols[c][j] = 0;
+    ow[j] = 0;
+    valid[j] = j < tot;
+  }
+  totalb.value()->typed_data()[0] = tot;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetJoinLadderFfi, ZsetJoinLadderImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
+// Fused group gather over the whole trace ladder (the aggregate family's
+// history fetch, equality AND range forms).
+//
+// Argument layout: [query key cols nk, (distinct upper-bound cols nk when
+// has_qhi), qlive PRED[m], then per level: nk key cols + ng gather cols +
+// weights, then sentinels S64[ng], then meta S64[3] = (K, nk, has_qhi)];
+// results: [qrow S32[cap] (== m on dead slots — the trash segment),
+// ng gathered cols S64[cap] (sentinel on dead slots), weights S64[cap]
+// (0 on dead), total S64[1]] — the consumer-facing form directly, no XLA
+// post-pass at all.
+
+static ffi::Error ZsetGatherLadderImpl(ffi::RemainingArgs args,
+                                       ffi::RemainingRets rets) {
+  if (args.size() < 3 || rets.size() < 3) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_gather_ladder: argument/result count mismatch");
+  }
+  auto meta = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 1);
+  if (!meta.has_value() || meta->element_count() != 3) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_gather_ladder: bad meta buffer");
+  }
+  const int64_t K = meta->typed_data()[0];
+  const int64_t nk = meta->typed_data()[1];
+  const bool has_qhi = meta->typed_data()[2] != 0;
+  const int64_t ng = static_cast<int64_t>(rets.size()) - 3;
+  const int64_t per_level = nk + ng + 1;
+  const int64_t nq = has_qhi ? 2 * nk : nk;
+  if (K < 1 || nk < 1 || ng < 0 ||
+      args.size() != static_cast<size_t>(nq + 1 + K * per_level + 2)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_gather_ladder: argument count mismatch");
+  }
+  std::vector<const int64_t*> qlo(nk), qhi(nk);
+  int64_t m = 0;
+  for (int64_t c = 0; c < nk; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    auto b = args.get<ffi::Buffer<ffi::DataType::S64>>(
+        has_qhi ? nk + c : c);
+    if (!a.has_value() || !b.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_gather_ladder: S64 query col expected");
+    }
+    qlo[c] = a->typed_data();
+    qhi[c] = b->typed_data();
+    m = static_cast<int64_t>(a->element_count());
+  }
+  auto qliveb = args.get<ffi::Buffer<ffi::DataType::PRED>>(nq);
+  auto sentb = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 2);
+  if (!qliveb.has_value() || !sentb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_gather_ladder: bad qlive/sentinel buffer");
+  }
+  const bool* qlive = qliveb->typed_data();
+  const int64_t* sent = sentb->typed_data();
+  std::vector<const int64_t*> tkeys(K * nk), tg(K * ng), tw(K);
+  std::vector<int64_t> caps(K);
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t base = nq + 1 + k * per_level;
+    for (int64_t c = 0; c < per_level; ++c) {
+      auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(base + c);
+      if (!a.has_value()) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "zset_gather_ladder: S64 level col expected");
+      }
+      if (c < nk) tkeys[k * nk + c] = a->typed_data();
+      else if (c < nk + ng) tg[k * ng + (c - nk)] = a->typed_data();
+      else tw[k] = a->typed_data();
+      caps[k] = static_cast<int64_t>(a->element_count());
+    }
+  }
+  auto qrowb = rets.get<ffi::Buffer<ffi::DataType::S32>>(0);
+  auto owb = rets.get<ffi::Buffer<ffi::DataType::S64>>(ng + 1);
+  auto totalb = rets.get<ffi::Buffer<ffi::DataType::S64>>(ng + 2);
+  if (!qrowb.has_value() || !owb.has_value() || !totalb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_gather_ladder: bad qrow/w/total result");
+  }
+  std::vector<int64_t*> ocols(ng);
+  int64_t cap = static_cast<int64_t>(qrowb.value()->element_count());
+  for (int64_t c = 0; c < ng; ++c) {
+    auto o = rets.get<ffi::Buffer<ffi::DataType::S64>>(1 + c);
+    if (!o.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_gather_ladder: S64 result expected");
+    }
+    ocols[c] = o.value()->typed_data();
+  }
+  int32_t* qrow = qrowb.value()->typed_data();
+  int64_t* ow = owb.value()->typed_data();
+
+  std::vector<int32_t> lo(static_cast<size_t>(K * m));
+  std::vector<int32_t> hi(static_cast<size_t>(K * m));
+  probe_ladder_into(K, nk, m, tkeys, caps, qlo.data(), /*right=*/false,
+                    lo.data());
+  probe_ladder_into(K, nk, m, tkeys, caps, qhi.data(), /*right=*/true,
+                    hi.data());
+  int64_t o = 0, tot = 0;
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t* const* gv = ng ? &tg[k * ng] : nullptr;
+    const int64_t* lw = tw[k];
+    for (int64_t i = 0; i < m; ++i) {
+      if (!qlive[i]) continue;
+      const int64_t a = lo[k * m + i], b = hi[k * m + i];
+      // distinct upper bounds may produce an empty range (qhi < qlo);
+      // the stitched path's max(hi, lo) clamp == "gather nothing"
+      const int64_t cnt = b > a ? b - a : 0;
+      for (int64_t t = 0; t < cnt && o < cap; ++t, ++o) {
+        const int64_t s = a + t;
+        qrow[o] = static_cast<int32_t>(i);
+        for (int64_t c = 0; c < ng; ++c) ocols[c][o] = gv[c][s];
+        ow[o] = lw[s];
+      }
+      tot += cnt;
+    }
+  }
+  // dead slots carry the trash-segment form DIRECTLY (qrow == q_cap,
+  // sentinel cols, weight 0) — identical to the stitched path's masks
+  for (int64_t j = o; j < cap; ++j) {
+    qrow[j] = static_cast<int32_t>(m);
+    for (int64_t c = 0; c < ng; ++c) ocols[c][j] = sent[c];
+    ow[j] = 0;
+  }
+  totalb.value()->typed_data()[0] = tot;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetGatherLadderFfi, ZsetGatherLadderImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
+// Fused old-weight lookup (distinct's consumer): the accumulated weight of
+// each delta ROW (keys + vals) across every trace level — per query row,
+// one binary search per level, summing the weight when the row is present.
+// Rows are unique within a consolidated level, so presence is an exact
+// match at the left insertion point.
+//
+// Argument layout: [delta cols nc, delta weights, then per level: nc cols +
+// weights, then meta S64[2] = (K, nc)]; result: [old S64[m]].
+
+static ffi::Error ZsetOldWeightsImpl(ffi::RemainingArgs args,
+                                     ffi::RemainingRets rets) {
+  if (args.size() < 2 || rets.size() != 1) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_old_weights: argument/result count mismatch");
+  }
+  auto meta = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 1);
+  if (!meta.has_value() || meta->element_count() != 2) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_old_weights: bad meta buffer");
+  }
+  const int64_t K = meta->typed_data()[0];
+  const int64_t nc = meta->typed_data()[1];
+  if (K < 1 || nc < 1 ||
+      args.size() != static_cast<size_t>(nc + 1 + K * (nc + 1) + 1)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_old_weights: argument count mismatch");
+  }
+  std::vector<const int64_t*> dcols(nc);
+  int64_t m = 0;
+  for (int64_t c = 0; c < nc; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!a.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_old_weights: S64 delta col expected");
+    }
+    dcols[c] = a->typed_data();
+    m = static_cast<int64_t>(a->element_count());
+  }
+  auto dwb = args.get<ffi::Buffer<ffi::DataType::S64>>(nc);
+  auto oldb = rets.get<ffi::Buffer<ffi::DataType::S64>>(0);
+  if (!dwb.has_value() || !oldb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_old_weights: bad weights/result buffer");
+  }
+  const int64_t* dw = dwb->typed_data();
+  int64_t* old = oldb.value()->typed_data();
+  std::vector<const int64_t*> tcols(K * nc), tw(K);
+  std::vector<int64_t> caps(K);
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t base = nc + 1 + k * (nc + 1);
+    for (int64_t c = 0; c < nc + 1; ++c) {
+      auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(base + c);
+      if (!a.has_value()) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "zset_old_weights: S64 level col expected");
+      }
+      if (c < nc) tcols[k * nc + c] = a->typed_data();
+      else tw[k] = a->typed_data();
+      caps[k] = static_cast<int64_t>(a->element_count());
+    }
+  }
+  const int64_t T = probe_threads(K * m);
+  const int64_t chunk = (m + T - 1) / T;
+  parallel_for_threads(T, [&](int64_t t) {
+    const int64_t i0 = t * chunk;
+    const int64_t i1 = i0 + chunk < m ? i0 + chunk : m;
+    for (int64_t i = i0; i < i1; ++i) {
+      int64_t acc = 0;
+      if (dw[i] != 0) {
+        for (int64_t k = 0; k < K; ++k) {
+          const int64_t* const* tk = &tcols[k * nc];
+          int64_t lo = 0, hi = caps[k];
+          while (lo < hi) {
+            const int64_t mid = (lo + hi) >> 1;
+            int cmp = 0;
+            for (int64_t c = 0; c < nc; ++c) {
+              const int64_t tv = tk[c][mid], qv = dcols[c][i];
+              if (tv != qv) { cmp = tv < qv ? -1 : 1; break; }
+            }
+            if (cmp < 0) lo = mid + 1; else hi = mid;
+          }
+          if (lo < caps[k]) {
+            bool eq = true;
+            for (int64_t c = 0; eq && c < nc; ++c) {
+              eq = tk[c][lo] == dcols[c][i];
+            }
+            if (eq) acc += tw[k][lo];
+          }
+        }
+      }
+      old[i] = acc;
+    }
+  });
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetOldWeightsFfi, ZsetOldWeightsImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());

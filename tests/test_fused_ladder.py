@@ -1,0 +1,362 @@
+"""Bit-identity of the FUSED ladder-consumer megakernels vs the stitched
+chain they replaced, with the ``DBSP_TPU_NATIVE`` per-kernel force-off as
+the control.
+
+The trace-tax tentpole collapsed each trace consumer (incremental join,
+aggregate group gather, distinct old-weight lookup) from a stitched
+probe-ladder/expand/gather chain — 4+ dispatches with XLA where-mask glue —
+into ONE megakernel call (native C++ on CPU, a Pallas grid-over-levels
+program on accelerators), and made the compiled CTrace post view LAZY
+(consumers probe the appended delta as its own ladder level instead of
+re-reading the written slot). All of that is only legal because every
+backend produces identical batches:
+
+* kernel level: join_ladder / gather_ladder (equality AND range form) /
+  old_weights_ladder across native megakernel, Pallas interpret, stitched
+  native, and pure XLA — on adversarial ladders (duplicate keys across
+  levels, EMPTY levels, full-capacity levels, cancelling weights, dead
+  query rows, int32 weights, out_cap overflow with exact unclamped totals);
+* engine level: q1–q8 accumulated outputs, host AND compiled, fused vs the
+  force-off + lazy-post-off control (the stitched pre-change code path);
+* dispatch level: the compiled q4 hot loop must ACTUALLY select the fused
+  kernels (non-vacuous — the lint front's import-based tier-1 twin).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dbsp_tpu.zset import cursor, kernels
+from dbsp_tpu.zset.batch import Batch
+
+pytestmark = pytest.mark.fast
+
+FUSED_OFF = "join_ladder,gather_ladder,old_weights"
+
+
+def _consolidated(rng, n_live, cap, nk=2, nv=1, key_range=40,
+                  allow_neg=True, weight_dtype=np.int64):
+    lo = -3 if allow_neg else 1
+    rows = []
+    for _ in range(n_live):
+        key = tuple(int(rng.integers(0, key_range)) for _ in range(nk + nv))
+        w = int(rng.integers(lo, 4)) or 1
+        rows.append((key, w))
+    cols = [np.array([r[0][i] for r in rows], dtype=np.int64)
+            for i in range(nk + nv)]
+    ws = np.array([r[1] for r in rows], dtype=weight_dtype)
+    return Batch.from_columns(cols[:nk], cols[nk:], ws, cap=cap)
+
+
+def _adversarial_ladders(rng, weight_dtype=np.int64):
+    full = Batch.from_columns(
+        [np.arange(64, dtype=np.int64), np.arange(64, dtype=np.int64) % 7],
+        [np.zeros(64, np.int64)], np.ones(64, weight_dtype), cap=64)
+    yield [_consolidated(rng, max(2, c // 3), c, weight_dtype=weight_dtype)
+           for c in (256, 64, 32, 16)]
+    yield [_consolidated(rng, 20, 64, weight_dtype=weight_dtype),
+           Batch.empty((jnp.int64, jnp.int64), (jnp.int64,), cap=32,
+                       weight_dtype=jnp.dtype(weight_dtype)),
+           _consolidated(rng, 10, 16, weight_dtype=weight_dtype)]
+    yield [full, _consolidated(rng, 30, 64, key_range=8,
+                               weight_dtype=weight_dtype)]
+
+
+# env settings per backend: (DBSP_TPU_NATIVE, DBSP_TPU_PALLAS)
+BACKENDS = {
+    "native_megakernel": ("1", "0"),
+    "pallas_interpret": ("0", "interpret"),
+    "stitched_native": (FUSED_OFF, "0"),
+    "pure_xla": ("0", "0"),
+}
+
+
+def _with_backend(monkeypatch, backend, fn):
+    native, pallas = BACKENDS[backend]
+    monkeypatch.setenv("DBSP_TPU_NATIVE", native)
+    monkeypatch.setenv("DBSP_TPU_PALLAS", pallas)
+    try:
+        return fn()
+    finally:
+        monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
+        monkeypatch.setenv("DBSP_TPU_PALLAS", "0")
+
+
+def _assert_same(got, want, ctx=""):
+    for g, w in zip(got, want):
+        g, w = np.asarray(g), np.asarray(w)
+        assert g.dtype == w.dtype, f"{ctx}: dtype {g.dtype} != {w.dtype}"
+        np.testing.assert_array_equal(g, w, err_msg=ctx)
+
+
+@pytest.mark.parametrize("weight_dtype", [np.int64, np.int32])
+def test_join_ladder_backends_bitidentical(monkeypatch, weight_dtype):
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    rng = np.random.default_rng(0)
+    for ladder in _adversarial_ladders(rng, weight_dtype):
+        delta = _consolidated(rng, 20, 32, weight_dtype=weight_dtype)
+        ref = None
+        for backend in BACKENDS:
+            out, total = _with_backend(
+                monkeypatch, backend,
+                lambda: cursor.join_ladder(delta, ladder, 2, fn, 1024))
+            cur = (*out.cols, out.weights, np.asarray(total))
+            if ref is None:
+                ref = cur
+            else:
+                _assert_same(cur, ref, f"join_ladder {backend}")
+
+
+def test_gather_ladder_backends_bitidentical(monkeypatch):
+    rng = np.random.default_rng(1)
+    for ladder in _adversarial_ladders(rng):
+        delta = _consolidated(rng, 24, 32)
+        qkeys = delta.keys
+        qlive = np.asarray(delta.weights) != 0
+        qlive[-3:] = False
+        qlive = jnp.asarray(qlive)
+        ref = None
+        for backend in BACKENDS:
+            (qrow, vals, w), total = _with_backend(
+                monkeypatch, backend,
+                lambda: cursor.gather_ladder(qkeys, qlive, ladder, 1024))
+            cur = (qrow, *vals, w, np.asarray(total))
+            if ref is None:
+                ref = cur
+            else:
+                _assert_same(cur, ref, f"gather_ladder {backend}")
+
+
+def test_range_gather_ladder_backends_bitidentical(monkeypatch):
+    """The range form (distinct qhi bounds + probed-key gather-back — the
+    CRolling/radix consumers), including EMPTY ranges where qhi < qlo."""
+    rng = np.random.default_rng(2)
+    levels = tuple(_consolidated(rng, 30, 64, nk=2, nv=2) for _ in range(3))
+    qp = jnp.asarray(rng.integers(0, 8, 16).astype(np.int64))
+    qlo = jnp.asarray(rng.integers(0, 20, 16).astype(np.int64))
+    qhi = qlo + jnp.asarray(rng.integers(-2, 10, 16).astype(np.int64))
+    qlive = jnp.asarray(rng.integers(0, 2, 16).astype(bool))
+    ref = None
+    for backend in BACKENDS:
+        (qrow, vals, w), total = _with_backend(
+            monkeypatch, backend,
+            lambda: cursor.gather_ladder((qp, qlo), qlive, levels, 512,
+                                         qhi_keys=(qp, qhi), gather_keys=1))
+        cur = (qrow, *vals, w, np.asarray(total))
+        if ref is None:
+            ref = cur
+        else:
+            _assert_same(cur, ref, f"range gather {backend}")
+
+
+@pytest.mark.parametrize("weight_dtype", [np.int64, np.int32])
+def test_old_weights_ladder_backends_bitidentical(monkeypatch, weight_dtype):
+    rng = np.random.default_rng(3)
+    for ladder in _adversarial_ladders(rng, weight_dtype):
+        delta = _consolidated(rng, 16, 32, weight_dtype=weight_dtype)
+        ref = None
+        for backend in ("native_megakernel", "stitched_native", "pure_xla"):
+            old = _with_backend(
+                monkeypatch, backend,
+                lambda: cursor.old_weights_ladder(delta, ladder))
+            if ref is None:
+                ref = np.asarray(old)
+            else:
+                _assert_same((old,), (ref,), f"old_weights {backend}")
+
+
+def test_overflow_totals_exact_on_every_backend(monkeypatch):
+    """out_cap overflow: every backend must report the SAME unclamped
+    total — it is the requirement the runner's grow/replay contract keys
+    off (a clamped or drifted total silently loses rows)."""
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    rng = np.random.default_rng(4)
+    delta = _consolidated(rng, 40, 64, key_range=5)
+    levels = [_consolidated(rng, 60, 128, key_range=5) for _ in range(2)]
+    totals = {}
+    for backend in BACKENDS:
+        _, jt = _with_backend(
+            monkeypatch, backend,
+            lambda: cursor.join_ladder(delta, levels, 2, fn, 16))
+        (_, _, _), gt = _with_backend(
+            monkeypatch, backend,
+            lambda: cursor.gather_ladder(
+                delta.keys, delta.weights != 0, levels, 16))
+        totals[backend] = (int(jt), int(gt))
+    vals = set(totals.values())
+    assert len(vals) == 1, f"overflow totals drifted: {totals}"
+    assert totals["pure_xla"][0] > 16, "shape must actually overflow"
+
+
+def test_fused_kernels_count_dispatch(monkeypatch):
+    """Force-off knob non-vacuity at the cursor level: the fused label is
+    counted on the hot path and goes to ZERO (with the stitched fallback
+    engaged) under DBSP_TPU_NATIVE force-off."""
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    rng = np.random.default_rng(5)
+    levels = [_consolidated(rng, 10, 32), _consolidated(rng, 5, 16)]
+    delta = _consolidated(rng, 8, 16)
+    monkeypatch.setenv("DBSP_TPU_PALLAS", "0")
+    before = dict(kernels.KERNEL_DISPATCH_COUNTS)
+    monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
+    cursor.join_ladder(delta, levels, 2, fn, 256)
+    monkeypatch.setenv("DBSP_TPU_NATIVE", FUSED_OFF)
+    cursor.join_ladder(delta, levels, 2, fn, 256)
+
+    def delta_of(kern, backend):
+        return kernels.KERNEL_DISPATCH_COUNTS.get((kern, backend), 0) - \
+            before.get((kern, backend), 0)
+
+    assert delta_of("join_ladder", "native") == 1
+    assert delta_of("join_ladder", "xla") == 1
+
+
+# ---------------------------------------------------------------------------
+# engine-level bit-identity: fused vs the stitched + materialized control
+# ---------------------------------------------------------------------------
+
+# the full legacy control: fused megakernels forced off AND the lazy
+# CTrace post view disabled — the pre-tentpole code path
+CONTROL_ENV = {"DBSP_TPU_NATIVE": FUSED_OFF, "DBSP_TPU_TRACE_LAZY_POST": "0"}
+
+QUERIES_FAST = ("q4", "q8")          # join+aggregate / join+distinct
+QUERIES_ALL = ("q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8")
+
+
+def _accumulate(out_batch, integral):
+    if out_batch is None:
+        return
+    for r, w in out_batch.to_dict().items():
+        integral[r] = integral.get(r, 0) + w
+        if integral[r] == 0:
+            del integral[r]
+
+
+def _run_host(qname, workers=1, ticks=2, per_tick=800):
+    import jax
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    # backend dispatch happens at TRACE time: a cached jit from the prior
+    # env setting would make the A/B comparison vacuous
+    jax.clear_caches()
+    gen = NexmarkGenerator(GeneratorConfig(seed=7))
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, getattr(queries, qname)(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(workers, build)
+    integral, n = {}, 0
+    for _ in range(ticks):
+        gen.feed(handles, n, n + per_tick)
+        handle.step()
+        _accumulate(out.take(), integral)
+        n += per_tick
+    return integral
+
+
+def _run_compiled(qname, ticks=3, per_tick=40):
+    import jax
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import compile_circuit
+    from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
+                                  queries)
+
+    jax.clear_caches()  # see _run_host — trace-time dispatch
+    cfg = GeneratorConfig(seed=7)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, getattr(queries, qname)(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * per_tick, per_tick)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    integral = {}
+
+    def capture(next_tick):
+        _accumulate(ch.output(out), integral)
+
+    ch.run_ticks(0, ticks, validate_every=1, on_validated=capture)
+    return integral
+
+
+@pytest.mark.parametrize("qname", QUERIES_ALL)
+def test_host_engine_fused_vs_stitched(monkeypatch, qname):
+    """q1–q8, host engine: fused megakernels vs the force-off stitched
+    control accumulate identical outputs."""
+    want = _run_host(qname)
+    for k, v in CONTROL_ENV.items():
+        monkeypatch.setenv(k, v)
+    assert _run_host(qname) == want
+
+
+@pytest.mark.parametrize("qname", QUERIES_FAST)
+def test_compiled_engine_fused_vs_stitched(monkeypatch, qname):
+    """Compiled engine (fast tier: the join+aggregate and join+distinct
+    shapes): fused megakernels + lazy post view vs the full legacy
+    control. The remaining queries run in the slow-tier matrix below."""
+    want = _run_compiled(qname)
+    assert want, f"{qname} produced no output — vacuous comparison"
+    for k, v in CONTROL_ENV.items():
+        monkeypatch.setenv(k, v)
+    assert _run_compiled(qname) == want
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qname", QUERIES_ALL)
+def test_compiled_engine_fused_vs_stitched_full(monkeypatch, qname):
+    want = _run_compiled(qname)
+    for k, v in CONTROL_ENV.items():
+        monkeypatch.setenv(k, v)
+    assert _run_compiled(qname) == want
+
+
+def test_sharded_host_fused_vs_stitched(monkeypatch):
+    """[W, cap] operands: the 2-worker host q4 (lifted fused cursors under
+    shard_map) equals its own stitched control AND the 1-worker run."""
+    want = _run_host("q4", workers=1)
+    got_sharded = _run_host("q4", workers=2)
+    assert got_sharded == want
+    for k, v in CONTROL_ENV.items():
+        monkeypatch.setenv(k, v)
+    assert _run_host("q4", workers=2) == want
+
+
+def test_compiled_q4_dispatches_fused_ladder_kernels(monkeypatch):
+    """Non-vacuous hot path (the lint kernel front's tier-1 twin): the
+    compiled q4 loop must actually SELECT the fused megakernels, and the
+    force-off control must drop them to zero with the stitched fallback
+    engaged."""
+    from dbsp_tpu.zset import kernels as zk
+
+    monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
+    before = dict(zk.KERNEL_DISPATCH_COUNTS)
+    _run_compiled("q4", ticks=2)
+
+    def delta_of(kern, backend):
+        return zk.KERNEL_DISPATCH_COUNTS.get((kern, backend), 0) - \
+            before.get((kern, backend), 0)
+
+    assert delta_of("join_ladder", "native") > 0
+    assert delta_of("gather_ladder", "native") > 0
+
+    monkeypatch.setenv("DBSP_TPU_NATIVE", FUSED_OFF)
+    before = dict(zk.KERNEL_DISPATCH_COUNTS)
+    _run_compiled("q4", ticks=2)
+    assert delta_of("join_ladder", "native") == 0
+    assert delta_of("gather_ladder", "native") == 0
+    assert delta_of("join_ladder", "xla") > 0
+    assert delta_of("gather_ladder", "xla") > 0

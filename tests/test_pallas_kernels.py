@@ -108,6 +108,67 @@ def test_pallas_disabled_by_default_on_cpu(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# fused ladder-consumer megakernels (join_ladder / gather_ladder)
+# ---------------------------------------------------------------------------
+
+
+def test_join_ladder_megakernel_interpret_bitidentical(pallas_interpret,
+                                                       monkeypatch):
+    """The grid-over-levels join megakernel vs the pure-XLA stitched chain
+    on the adversarial ladders — whole-Batch output + unclamped total."""
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    rng = np.random.default_rng(30)
+    for ladder in _adversarial_ladders(rng):
+        delta = _consolidated(rng, 20, 32)
+        got, gt = cursor.join_ladder(delta, ladder, 2, fn, 1024)
+        monkeypatch.setenv("DBSP_TPU_PALLAS", "0")
+        monkeypatch.setenv("DBSP_TPU_NATIVE", "0")
+        want, wt = cursor.join_ladder(delta, ladder, 2, fn, 1024)
+        monkeypatch.setenv("DBSP_TPU_PALLAS", "interpret")
+        monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
+        assert int(gt) == int(wt)
+        for g, w in zip((*got.cols, got.weights), (*want.cols,
+                                                   want.weights)):
+            assert g.dtype == w.dtype
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_gather_ladder_megakernel_interpret_bitidentical(pallas_interpret,
+                                                         monkeypatch):
+    rng = np.random.default_rng(31)
+    for ladder in _adversarial_ladders(rng):
+        delta = _consolidated(rng, 24, 32)
+        qlive = jnp.asarray(np.asarray(delta.weights) != 0)
+        got = cursor.gather_ladder(delta.keys, qlive, ladder, 1024)
+        monkeypatch.setenv("DBSP_TPU_PALLAS", "0")
+        monkeypatch.setenv("DBSP_TPU_NATIVE", "0")
+        want = cursor.gather_ladder(delta.keys, qlive, ladder, 1024)
+        monkeypatch.setenv("DBSP_TPU_PALLAS", "interpret")
+        monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
+        (gq, gv, gw), gt = got
+        (wq, wv, ww), wt = want
+        assert int(gt) == int(wt)
+        for g, w in zip((gq, *gv, gw), (wq, *wv, ww)):
+            assert g.dtype == w.dtype
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_ladder_megakernels_dispatch_pallas(pallas_interpret):
+    """The cursor entry points route to the megakernels (and count the
+    dispatch) when the override is active."""
+    fn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    rng = np.random.default_rng(32)
+    levels = [_consolidated(rng, 10, 32), _consolidated(rng, 5, 16)]
+    delta = _consolidated(rng, 8, 16)
+    before = dict(kernels.KERNEL_DISPATCH_COUNTS)
+    cursor.join_ladder(delta, levels, 2, fn, 256)
+    cursor.gather_ladder(delta.keys, delta.weights != 0, levels, 256)
+    for kern in ("join_ladder", "gather_ladder"):
+        assert kernels.KERNEL_DISPATCH_COUNTS.get((kern, "pallas"), 0) > \
+            before.get((kern, "pallas"), 0), kern
+
+
+# ---------------------------------------------------------------------------
 # rank-merge inner loop
 # ---------------------------------------------------------------------------
 

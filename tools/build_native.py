@@ -49,7 +49,17 @@ LIBRARIES = (
      "src": os.path.join("native", "zset_merge.cpp"),
      "so": os.path.join("native", "libzset_merge.so"),
      "flags": ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC"],
-     "ffi_include": True},
+     "ffi_include": True,
+     # every FFI entry point the engine registers (zset/native_merge.py):
+     # the staleness lint checks each is exported, so a cached binary
+     # predating a new kernel (the source hash would catch an EDIT, but a
+     # preserved-mtime stale binary could still miss fresh symbols) is a
+     # red lint naming the missing entry point, not a runtime dlsym error
+     "symbols": ["ZsetMergeFfi", "ZsetProbeFfi", "ZsetConsolidateFfi",
+                 "ZsetExpandFfi", "ZsetGatherFfi", "ZsetCompactFfi",
+                 "ZsetProbeLadderFfi", "ZsetRankFoldFfi",
+                 "ZsetJoinLadderFfi", "ZsetGatherLadderFfi",
+                 "ZsetOldWeightsFfi"]},
     {"name": "nexmark_gen",
      "src": os.path.join("native", "nexmark_gen.cpp"),
      "so": os.path.join("native", "libnexmark_gen.so"),
@@ -184,6 +194,19 @@ def check_tree(root: str = _ROOT) -> List[str]:
                 f"{lib['so']}: embedded source hash {got[:12]}… != "
                 f"checked-out {lib['src']} hash {src_sha[:12]}… (cached "
                 f"binary drifted from source) — {fix}")
+        if lib.get("symbols"):
+            try:
+                handle = ctypes.CDLL(so)
+            except OSError:
+                handle = None
+                violations.append(f"{lib['so']}: unloadable — {fix}")
+            for sym in lib["symbols"] if handle is not None else ():
+                try:
+                    getattr(handle, sym)
+                except AttributeError:
+                    violations.append(
+                        f"{lib['so']}: missing FFI entry point {sym!r} "
+                        f"(binary predates the kernel) — {fix}")
         rec = recorded.get(name)
         if rec is None:
             continue  # no local build record for this lib — nothing more

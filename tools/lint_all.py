@@ -37,6 +37,16 @@ the tier-1 test in tests/test_analysis.py):
    ``bench.py --workers-sweep`` mini-protocol, in subprocesses. The
    import-based tier-1 consumers (tests/test_analysis.py) run the static
    fronts only; tests/test_multichip.py carries the runtime coverage.
+4b. **Kernel front** (CLI only; DBSP_TPU_LINT_KERNELS=0 skips) — a mini
+   compiled q4 run in a subprocess must actually DISPATCH the fused
+   ladder megakernels (``kernel_paths`` shows ``join_ladder:native`` and
+   ``gather_ladder:native`` with count > 0 — the fusion cannot silently
+   fall back to the stitched chain), and a second run under the
+   ``DBSP_TPU_NATIVE`` force-off must show ZERO fused-native dispatches
+   with the stitched XLA fallback engaged — so the A/B control knob
+   bench.py leans on is proven live, not vacuous. The import-based
+   tier-1 consumer is tests/test_cursor.py::
+   test_compiled_q4_dispatches_fused_ladder_kernels.
 5. **Profiler dryrun** (CLI only; DBSP_TPU_LINT_PROFILE=0 skips) —
    ``opprofile.dryrun("q4")`` in a subprocess: one measured segmented
    profile end to end, red on schema drift, segmented/fused divergence,
@@ -334,6 +344,108 @@ def run_multichip() -> list:
     return violations
 
 
+def _kernel_dryrun_child() -> None:
+    """Subprocess body for the kernel front: compile the q4 circuit, run a
+    few ticks, print the fused-consumer dispatch-count deltas as JSON."""
+    import json
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import compile_circuit
+    from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
+                                  queries)
+    from dbsp_tpu.zset import kernels as zk
+
+    cfg = GeneratorConfig(seed=3)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * 40, 40)
+        return {hp: p, ha: a, hb: b}
+
+    before = dict(zk.KERNEL_DISPATCH_COUNTS)
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    ch.run_ticks(0, 3, validate_every=1)
+    delta = {f"{k}:{b}": int(v - before.get((k, b), 0))
+             for (k, b), v in sorted(zk.KERNEL_DISPATCH_COUNTS.items())
+             if v - before.get((k, b), 0)}
+    print(json.dumps(delta))
+
+
+def run_kernel_dryrun() -> list:
+    """4b. **Kernel front** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_KERNELS=0`` skips): the q4 dryrun must dispatch the
+    fused ladder megakernels (non-vacuous: ``join_ladder:native`` and
+    ``gather_ladder:native`` counted > 0), and the ``DBSP_TPU_NATIVE``
+    force-off run must show zero fused-native dispatches with the
+    stitched XLA fallback live — proving both the hot path and its A/B
+    control."""
+    import json
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_KERNELS", "1") == "0":
+        print("lint_all: kernel_dryrun: skipped (DBSP_TPU_LINT_KERNELS=0)")
+        return []
+
+    def child(extra_env):
+        # pin the Pallas knob too: an inherited DBSP_TPU_PALLAS force-on
+        # would dispatch join_ladder:pallas instead of :native and turn
+        # both assertions below falsely red on a healthy tree
+        env = dict(os.environ, JAX_PLATFORMS="cpu", DBSP_TPU_PALLAS="0",
+                   **extra_env)
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "from tools.lint_all import _kernel_dryrun_child; "
+                 "_kernel_dryrun_child()"],
+                cwd=_ROOT, env=env, capture_output=True, text=True,
+                timeout=600)
+        except subprocess.TimeoutExpired:
+            return None, "kernel dryrun timed out after 600s"
+        if p.returncode != 0:
+            return None, (f"kernel dryrun failed:\n{p.stdout[-800:]}\n"
+                          f"{p.stderr[-800:]}")
+        try:
+            return json.loads(p.stdout.strip().splitlines()[-1]), None
+        except (ValueError, IndexError):
+            return None, f"kernel dryrun emitted no JSON:\n{p.stdout[-400:]}"
+
+    violations = []
+    paths, err = child({"DBSP_TPU_NATIVE": "1"})
+    if err:
+        return [err]
+    for kern in ("join_ladder", "gather_ladder"):
+        if not paths.get(f"{kern}:native"):
+            violations.append(
+                f"q4 dryrun never dispatched the fused {kern} megakernel "
+                f"(kernel_paths: {json.dumps(paths)}) — the trace-tax "
+                "fusion silently fell back to the stitched chain")
+    off = "join_ladder,gather_ladder,old_weights"
+    paths_off, err = child({"DBSP_TPU_NATIVE": off})
+    if err:
+        return violations + [err]
+    for kern in ("join_ladder", "gather_ladder"):
+        if paths_off.get(f"{kern}:native"):
+            violations.append(
+                f"DBSP_TPU_NATIVE={off} still dispatched {kern}:native "
+                f"({json.dumps(paths_off)}) — the force-off control is "
+                "vacuous and A/B runs would measure nothing")
+        if not paths_off.get(f"{kern}:xla"):
+            violations.append(
+                f"force-off run never engaged the stitched {kern} XLA "
+                f"fallback ({json.dumps(paths_off)})")
+    return violations
+
+
 def run_profile_dryrun() -> list:
     """5. **Profiler dryrun** (subprocess; CLI runs it by default,
     ``DBSP_TPU_LINT_PROFILE=0`` skips — tests/test_opprofile.py carries
@@ -396,6 +508,7 @@ def main() -> int:
               ("check_dashboard", run_check_dashboard),
               ("analyzer_selfcheck", run_analyzer_selfcheck),
               ("multichip", run_multichip),
+              ("kernel_dryrun", run_kernel_dryrun),
               ("profile_dryrun", run_profile_dryrun),
               ("lineage_dryrun", run_lineage_dryrun)]
     failed = 0

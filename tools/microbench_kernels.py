@@ -20,7 +20,15 @@ Kernels & shapes (ROOFLINE §1):
   * gather_ladder    — the fused group gather (probe + expand + leveled
                        gather) of 4096 query keys against a 4-level
                        ladder (262k..4k rows) into 8192 slots — ROOFLINE
-                       §1's "group gather" row, end to end.
+                       §1's "group gather" row, end to end. Dispatches the
+                       ONE-call megakernel (native on CPU, Pallas on
+                       accelerators) unless forced off;
+  * join_ladder      — the fused incremental-join consumer (both probes +
+                       expansion + both-side gathers + weight product +
+                       pair apply) of a 16k-row delta against the same
+                       4-level ladder shape into 65536 slots — the
+                       CJoin/JoinOp hot path end to end, megakernel
+                       dispatch included.
 
 Every entry dispatches through the engine's own backend switch, so the
 measured path follows DBSP_TPU_NATIVE / DBSP_TPU_PALLAS — A/B a single
@@ -174,6 +182,28 @@ def run(reps: int = 5) -> dict:
                  "slots",
         "ms": _time(lambda qk, ql: cursor.gather_ladder(
             qk, ql, glevels, 8_192)[0], qkeys, qlive, reps=reps)}
+
+    # 8b) fused incremental-join consumer: the whole join_ladder megakernel
+    #     (probe pair + expansion + both-side gathers + weight product +
+    #     pair apply) for a 16k-row delta over a 4-level ladder — the
+    #     CJoin/JoinOp hot path the trace-tax fusion collapsed to one call
+    jlevels = []
+    for i, cap in enumerate((1_048_576, 262_144, 65_536, 16_384)):
+        kc = _cols(cap, 2, seed=50 + i)
+        vc = _cols(cap, 2, sort_first=False, seed=60 + i)
+        jlevels.append(Batch(kc, vc, jnp.ones((cap,), jnp.int64),
+                             runs=(cap,)))
+    jq = 16_384
+    jdelta = Batch(tuple(c[:jq] for c in _cols(jq, 2, seed=70)),
+                   tuple(c[:jq] for c in _cols(jq, 1, sort_first=False,
+                                               seed=71)),
+                   jnp.ones((jq,), jnp.int64), runs=(jq,))
+    jfn = lambda k, lv, rv: (k, (*lv, *rv))  # noqa: E731
+    out["join_ladder"] = {
+        "shape": f"{jq}-row delta x 4 levels (1048576..16384 rows) -> "
+                 "65536 slots",
+        "ms": _time(lambda d: cursor.join_ladder(
+            d, tuple(jlevels), 2, jfn, 65_536)[0], jdelta, reps=reps)}
 
     # 9) flight-recorder steady-state overhead: one tick event recorded
     #    into the bounded ring (dbsp_tpu/obs/flight.py) — pure host work,

@@ -65,6 +65,39 @@ REF_KERNEL_MS = 8.2
 REF_PRED_MS = 1.74  # 13.9 MB/tick at 8 GB/s
 REF_GAP = REF_KERNEL_MS / REF_PRED_MS  # the "4.7x" ROADMAP item 5 names
 
+# Gap-refit HISTORY: every same-host A/B ratio recorded by a prior round,
+# each scaling the PR-4 reference calibration in sequence — the current
+# round's --bench/--bench-off pair multiplies ON TOP of these, so the
+# headline gap chains measured ratios instead of ever comparing raw ms
+# across hosts. Entries are (label, bench-pair file prefix, ratio); the
+# prefix lets :func:`refit_base_for` stop the chain when the LIVE pair is
+# one already recorded here (re-calibrating against an old committed pair
+# must not multiply its own ratio in twice).
+RECORDED_REFITS = (
+    ("PR-7 native/Pallas kernel set", "BENCH_local_native_kernels", 0.87),
+)
+
+
+def refit_base_for(source_off: str):
+    """(base gap, applied refit entries) to chain UNDER a live A/B whose
+    control file is ``source_off``: refits recorded from that same pair
+    (or later) are excluded so the live ratio replaces — never
+    double-counts — its own recorded entry."""
+    gap, applied = REF_GAP, []
+    for label, prefix, ratio in RECORDED_REFITS:
+        if os.path.basename(source_off).startswith(prefix):
+            break
+        gap *= ratio  # 4.1x entering this round on the current pair
+        applied.append((label, prefix, ratio))
+    return gap, applied
+
+# the current round's committed A/B pair (fused ladder megakernels + lazy
+# trace post view vs the stitched + materialized control on the same
+# host) — the default --bench / --bench-off targets so a plain regenerate
+# reproduces the committed calibration
+DEFAULT_BENCH = "BENCH_local_megakernels.json"
+DEFAULT_BENCH_OFF = "BENCH_local_megakernels_off.json"
+
 
 def _host_bandwidth_gbs() -> float:
     """Measured streaming (copy) bandwidth of THIS host, GB/s — the
@@ -232,8 +265,10 @@ def _bench_measurement(path: str | None = None):
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # "_off" files are A/B control runs (native kernels forced off) —
-    # never a default calibration target
+    # never a default calibration target; the current round's committed
+    # pair is tried first so a plain regenerate reproduces its refit
     cands = ([path] if path else
+             [os.path.join(root, DEFAULT_BENCH)] +
              sorted((p for p in
                      glob.glob(os.path.join(root, "BENCH_local*.json"))
                      if "_off" not in os.path.basename(p)),
@@ -332,8 +367,11 @@ def per_node_section(report: dict) -> list:
       "{} ticks of {} events each on a {}-core CI host — segmented per-"
       "node wall time asserted BIT-IDENTICAL to the fused step program, "
       "{:.1%} of segmented tick time attributed to named nodes, "
-      "segmentation overhead x{:.2f} vs the fused tick (lost fusion + "
-      "undonated state pass-throughs; SHARES are the deliverable, "
+      "segmentation overhead x{:.2f} vs the fused tick (lost fusion; "
+      "identity pass-throughs — state a node returns untouched — are "
+      "ELIDED from segment outputs and reconstructed from the operands, "
+      "obs/opprofile.py, so a trace node is charged for what it computes, "
+      "not for echoing its deep levels; SHARES are the deliverable, "
       "absolute ms are not).\n".format(
           proto.get("profiled_ticks", m.get("ticks", "?")),
           proto.get("events_per_tick", "?"),
@@ -351,6 +389,13 @@ def per_node_section(report: dict) -> list:
             int(r.get("rows_out", 0)) // ticks,
             ("{:.2g}".format(r["bytes"]) if r.get("bytes") else "-")))
     w("")
+    ctrace_ms = sum(r.get("total_ms", 0.0) for r in ops
+                    if r.get("kind") == "CTrace")
+    w("**Combined CTrace share: {:.0%}** (the two hot q4 trace nodes were "
+      "59% of the attributed tick before the fused ladder megakernels + "
+      "lazy post view — the trace-tax collapse ROADMAP item 1 asked "
+      "for; the cost now lives in the consumers' own reductions, where "
+      "the roofline says it belongs).\n".format(ctrace_ms / total_ms))
     top = ops[:3]
     w("**Top-3 glue costs (named):** " + "; ".join(
         "**{}** ({}, node {}) — {:.0%} of attributed tick time".format(
@@ -392,12 +437,10 @@ def main():
     root_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     meas = _bench_measurement(args.bench)
     # the A/B refit control defaults to the committed force-off run: its
-    # pair (BENCH_local_native_kernels.json) is also the default --bench
-    # pick, so a plain regenerate reproduces the committed calibration
-    # instead of silently reverting the headline gap to the raw
-    # cross-host figure
-    bench_off = args.bench_off or os.path.join(
-        root_dir, "BENCH_local_native_kernels_off.json")
+    # pair (DEFAULT_BENCH) is also the default --bench pick, so a plain
+    # regenerate reproduces the committed calibration instead of silently
+    # reverting the headline gap to the raw cross-host figure
+    bench_off = args.bench_off or os.path.join(root_dir, DEFAULT_BENCH_OFF)
     meas_off = _bench_measurement(bench_off) \
         if os.path.exists(bench_off) or args.bench_off else None
     if args.per_node:
@@ -457,9 +500,11 @@ def main():
     # meaningless — container core speed varies ~3x round to round.
     ab_ratio = None
     gap = host_gap
+    applied_refits = []
     if meas_off is not None and meas_off["kernel_ms"] > 0:
         ab_ratio = meas_cpu_ms / meas_off["kernel_ms"]
-        gap = REF_GAP * ab_ratio
+        base, applied_refits = refit_base_for(meas_off["source"])
+        gap = base * ab_ratio
     adj = model["tpu"]["pred_v5e_events_per_s"] / gap
     host_note = ""
     if meas["host_share"] is not None:
@@ -478,18 +523,33 @@ def main():
           host_gbs, host_gap, host_note))
     if ab_ratio is not None:
         w("**Kernel-side gap refit (same-host A/B):** the control run "
-          "({} — the pre-change code on the SAME host) measures {:.1f} "
-          "ms/tick kernel-side; the extended native/Pallas kernel set "
-          "cuts that to {:.1f} ms/tick — a x{:.2f} kernel-side factor "
-          "under identical protocol, state and container. Scaling the "
-          "PR-4 reference calibration ({:.1f} ms vs {:.2f} ms = {:.1f}x) "
-          "by that factor re-fits the kernel-side gap to **{:.1f}x**. "
-          "(Raw cross-host ms are NOT comparable: this round's container "
-          "has ~2-3x slower cores at similar memory bandwidth than the "
-          "PR-4 recording host, which is exactly why the refit is "
-          "A/B-based.)\n".format(
-              meas_off["source"], meas_off["kernel_ms"], meas_cpu_ms,
-              ab_ratio, REF_KERNEL_MS, REF_PRED_MS, REF_GAP, gap))
+          "({} — the fused ladder megakernels forced off via "
+          "`DBSP_TPU_NATIVE` plus `DBSP_TPU_TRACE_LAZY_POST=0`, i.e. the "
+          "pre-change code path on the SAME host) measures {:.1f} ms/tick "
+          "kernel-side; the fused consumers + lazy trace post view cut "
+          "that to {:.1f} ms/tick — a x{:.2f} kernel-side factor under "
+          "identical protocol, state and container. Chaining it onto the "
+          "recorded refit history re-fits the kernel-side gap to "
+          "**{:.1f}x**. (Raw cross-host ms are NOT comparable: container "
+          "core speed varies ~3x round to round at similar memory "
+          "bandwidth, which is exactly why every refit is A/B-based.)\n"
+          .format(meas_off["source"], meas_off["kernel_ms"], meas_cpu_ms,
+                  ab_ratio, gap))
+        w("Gap-refit history (each row scales the previous one):\n")
+        w("| round | A/B evidence | kernel-side ratio | gap after |")
+        w("|---|---|---|---|")
+        w("| PR-4 reference | BENCH_local_fused_cursors.json calibration "
+          "({:.1f} ms vs {:.2f} ms predicted) | — | {:.1f}x |".format(
+              REF_KERNEL_MS, REF_PRED_MS, REF_GAP))
+        running = REF_GAP
+        for label, prefix, ratio in applied_refits:
+            running *= ratio
+            w("| {} | {}[_off].json, same-host A/B | x{:.2f} | {:.1f}x |"
+              .format(label, prefix, ratio, running))
+        w("| this round (fused ladder megakernels + lazy post view) | "
+          "{} vs {} | x{:.2f} | **{:.1f}x** |".format(
+              meas["source"], meas_off["source"], ab_ratio, gap))
+        w("")
     w("Applying the {:.1f}x gap to the v5e projection as a conservative "
       "discount gives **~{:.0f}M events/s on one v5e chip** — "
       "{:.0f}x the reference protocol's 10M/s offered rate, before "
@@ -518,8 +578,14 @@ def main():
       "(`_bench_measurement`) — pass `--bench PATH` to calibrate against "
       "a specific run. The remaining gap is what a bandwidth model can "
       "speak to: scatter irregularity and probe lowering, now attacked "
-      "by the fused trace cursors (zset/cursor.py: one ladder-wide probe "
-      "+ one cross-level expansion per consumer), the sorted-run "
+      "by the FUSED ladder consumers (zset/cursor.py: the whole "
+      "join/gather/old-weights consumer — probe pair + cross-level "
+      "expansion + gathers + weight combine — is ONE megakernel call per "
+      "eval on the native CPU path, `join_ladder`/`gather_ladder`/"
+      "`old_weights` in `kernel_paths`), the LAZY compiled trace post "
+      "view (compiled/cnodes.py: consumers probe the appended delta as "
+      "its own ladder level instead of re-reading the written slot — "
+      "`DBSP_TPU_TRACE_LAZY_POST=0` is the control), the sorted-run "
       "consolidation regimes (zset/batch.py: skip / rank-merge fold / "
       "native argsort / sort, counted in "
       "`dbsp_tpu_zset_consolidate_total{path}`), and the full native "
@@ -528,11 +594,13 @@ def main():
       "galloping block-copy merges; dispatch visible in "
       "`dbsp_tpu_zset_kernel_dispatch_total{kernel,backend}` and bench "
       "JSON `kernel_paths`, per-kernel A/B via DBSP_TPU_NATIVE). On "
-      "accelerator backends the ladder probe and rank-merge inner loops "
-      "select hand-written Pallas programs (zset/pallas_kernels.py, "
-      "DBSP_TPU_PALLAS) instead of trusting XLA's while-loop fusion "
-      "guesses — interpret-mode bit-identity is tier-1-gated; the first "
-      "live tunnel run measures them compiled. What remained aggregate "
+      "accelerator backends the ladder consumers, the ladder probe and "
+      "the rank-merge inner loop select hand-written Pallas programs "
+      "(zset/pallas_kernels.py: grid-over-levels megakernels with static "
+      "[K, maxcap] blocks, DBSP_TPU_PALLAS) instead of trusting XLA's "
+      "while-loop fusion guesses — interpret-mode bit-identity is "
+      "tier-1-gated; the first live tunnel run (tools/aot_tpu.py) "
+      "measures them compiled. What remained aggregate "
       "here — WHICH step-program glue the gap lives in — is now a "
       "per-operator measurement: §3c below names it, from the committed "
       "`PROFILE_q4.json` (obs/opprofile.py segmented profile; "
