@@ -327,18 +327,22 @@ def static_append(trace: Batch, delta: Batch) -> Tuple[Batch, jnp.ndarray]:
 
 
 def join_levels(delta: Batch, levels: Sequence[Batch], nk: int, fn,
-                out_cap: int) -> Tuple[Batch, jnp.ndarray]:
+                out_cap: int, sorted_emit=None) -> Tuple[Batch, jnp.ndarray]:
     """Join a delta against ALL trace levels into ONE out_cap buffer via the
     fused trace cursor (zset/cursor.py): one probe pair over the whole
     ladder and one cross-level expansion, where the per-level loop emitted
-    K probe kernels, K expansions, and K offset-scatters. The returned
-    requirement is the UNCLAMPED total across levels — when it exceeds
-    ``out_cap`` the tail matches drop off the end and the runner's
-    validation grows the cap and replays."""
+    K probe kernels, K expansions, and K offset-scatters. With a
+    permutation pair fn (``sorted_emit`` — see ``JoinCore.sorted_emit``)
+    the native path applies the fn IN the call and the buffer comes back
+    as one consolidated run, so the post-join consolidate rank-folds
+    instead of sorting. The returned requirement is the UNCLAMPED total
+    across levels — when it exceeds ``out_cap`` the tail matches drop off
+    the end and the runner's validation grows the cap and replays."""
     from dbsp_tpu.zset import cursor
 
     assert levels, "join_levels: trace has no levels (TRACE_LEVELS >= 1)"
-    out, total = cursor.join_ladder(delta, levels, nk, fn, out_cap)
+    out, total = cursor.join_ladder(delta, levels, nk, fn, out_cap,
+                                    sorted_emit)
     return out, total.astype(jnp.int64)
 
 
@@ -614,18 +618,27 @@ class CJoin(CNode):
 
     def eval(self, ctx, state, inputs):
         left, right = inputs
-        nk = self.op._left_core.nk
-        fn = self.op._left_core.fn
-        flipped = self.op._right_core.fn
+        lcore = self.op._left_core
+        rcore = self.op._right_core
+        nk = lcore.nk
         cap_l = ensure_side_cap(self, "left", left.delta.cap)
         cap_r = ensure_side_cap(self, "right", right.delta.cap)
         # ΔL joins every level of trace(R) post-append; ΔR every level of
         # trace(L) pre-append — each side's K level results land in ONE
-        # shared buffer (requirement = total across levels), so the final
-        # consolidate sorts 2 buffers regardless of K
-        lout, ltot = join_levels(left.delta, right.post, nk, fn, cap_l)
+        # shared buffer (requirement = total across levels). With a
+        # permutation pair fn on the native path each side comes back as
+        # one consolidated run (sorted_emit), so the final consolidate is
+        # a 2-run rank fold — one linear merge, NO sort; otherwise it
+        # sorts 2 buffers regardless of K.
+        lout, ltot = join_levels(left.delta, right.post, nk, lcore.fn,
+                                 cap_l,
+                                 sorted_emit=lcore.sorted_emit(
+                                     left.delta, right.post))
         ctx.require(self, "left", ltot)
-        rout, rtot = join_levels(right.delta, left.pre, nk, flipped, cap_r)
+        rout, rtot = join_levels(right.delta, left.pre, nk, rcore.fn,
+                                 cap_r,
+                                 sorted_emit=rcore.sorted_emit(
+                                     right.delta, left.pre))
         ctx.require(self, "right", rtot)
         out = concat_batches([lout, rout])
         if not getattr(self, "defer_consolidate", False):
@@ -699,65 +712,52 @@ class CAggregate(CNode):
         return (batch, ever_neg)
 
     def eval(self, ctx, state, inputs):
-        from dbsp_tpu.operators.aggregate import (_TupleMax,
-                                                  _diff_outputs_impl,
-                                                  _gather_level_impl,
-                                                  _reduce_groups_impl,
-                                                  _unique_keys_impl)
+        from dbsp_tpu.operators.aggregate import _diff_outputs_impl
+        from dbsp_tpu.zset import cursor
 
         view: CView = inputs[0]
         out_trace, ever_neg = state
         agg = self.op.agg
         nk = len(self.op.key_dtypes)
         delta = view.delta
-        qkeys, qlive = _unique_keys_impl(delta, nk)
-        qkeys, qlive = trim_queries(ctx, self, qkeys, qlive)
-        q_cap = qlive.shape[-1]
+        if not self.caps.get("queries"):
+            self.caps["queries"] = 64  # trim_queries' seed, same contract
+        # effective query capacity = the trim_queries slice semantics: the
+        # unique-key buffer can never hold more rows than the delta has
+        q_cap = min(self.caps["queries"], delta.cap)
         fast = getattr(agg, "insert_combinable", False)
         if not self.caps["gather"]:
             self.caps["gather"] = 64 if fast else max(64, 2 * q_cap)
 
-        # own output trace holds exactly one live row per present key, so a
-        # q_cap-sized expansion always suffices
-        oqrow, ovals, ow, _ = _gather_level_impl(qkeys, qlive, out_trace,
-                                                 q_cap)
-        old_vals, old_present = _reduce_groups_impl(
-            ((oqrow, ovals, ow),), _TupleMax(len(agg.out_dtypes)), q_cap)
-
         ever_neg = ever_neg | jnp.any(delta.weights < 0)
+        # the ladder gate rides as a RUNTIME value: on the fast path the
+        # slow re-gather engages only once ANY retraction has entered the
+        # stream (a positive delta may then partially cancel a net-negative
+        # trace row — combine would be unsound); no retrace when it flips
+        flag = ever_neg if fast else jnp.asarray(True)
+        # ONE fused call: unique touched keys (run-boundary scan of the
+        # consolidated delta — the same scan feeds the fast path's segment
+        # ids, never recomputed), previous outputs from the out trace
+        # (exact q_cap expansion: it holds one live row per present key),
+        # the touched groups' ladder histories netted + reduced, and the
+        # fast path's delta-side reduction (cursor.agg_ladder — native
+        # megakernel / Pallas / stitched XLA control)
+        (qkeys, qlive, nq, old_vals, old_present, lad_vals, lad_present,
+         d_vals, d_present, gtot) = cursor.agg_ladder(
+            delta, nk, out_trace, view.post, agg, q_cap,
+            self.caps["gather"], fast, flag)
+        ctx.require(self, "queries", nq)
+        ctx.require(self, "gather", gtot)
         if fast:
-            # segment id per delta row: live rows are a packed prefix of the
-            # consolidated delta, in qkeys order
-            anylive = delta.weights != 0
-            first = ~kernels.rows_equal_prev(delta.keys[:nk], n=delta.cap)
-            seg = jnp.cumsum(jnp.where(first & anylive, 1, 0)) - 1
-            seg = jnp.where(anylive, seg, q_cap).astype(jnp.int32)
-            d_vals = tuple(o[:q_cap] for o in agg.reduce(
-                delta.vals, delta.weights, seg, q_cap + 1))
-            one = jnp.where(delta.weights > 0, 1, 0)
-            d_present = jax.ops.segment_max(
-                one, seg, num_segments=q_cap + 1)[:q_cap] > 0
-            fast_vals = agg.combine(old_vals, old_present, d_vals, d_present)
+            fast_vals = agg.combine(old_vals, old_present, d_vals,
+                                    d_present)
             fast_present = old_present | d_present
-            # re-gather every touched group once ANY retraction has entered
-            # the stream (a positive delta may then partially cancel a
-            # net-negative trace row — combine would be unsound); stays
-            # empty (lo==hi) on append-only streams
             slow = qlive & jnp.broadcast_to(ever_neg, qlive.shape)
-            spart, stot = gather_levels(qkeys, slow, view.post,
-                                        self.caps["gather"])
-            ctx.require(self, "gather", stot)
-            slow_vals, slow_present = _reduce_groups_impl(
-                (spart,), agg, q_cap, net=len(view.post) > 1)
             new_vals = tuple(jnp.where(slow, sv.astype(fv.dtype), fv)
-                             for sv, fv in zip(slow_vals, fast_vals))
-            new_present = jnp.where(slow, slow_present, fast_present)
+                             for sv, fv in zip(lad_vals, fast_vals))
+            new_present = jnp.where(slow, lad_present, fast_present)
         else:
-            part, tot = gather_levels(qkeys, qlive, view.post,
-                                      self.caps["gather"])
-            ctx.require(self, "gather", tot)
-            new_vals, new_present = _reduce_groups_impl(
-                (part,), agg, q_cap, net=len(view.post) > 1)
+            new_vals, new_present = lad_vals, lad_present
 
         cols, w = _diff_outputs_impl(qkeys, qlive, new_vals, new_present,
                                      old_vals, old_present)

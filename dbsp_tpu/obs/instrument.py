@@ -84,8 +84,9 @@ def export_kernel_dispatch(registry: MetricsRegistry) -> None:
         "(native = C++ FFI custom call, xla = pure-XLA lowering, "
         "pallas = hand-written Pallas program); the fused ladder-consumer "
         "megakernels report as kernel=join_ladder / gather_ladder / "
-        "old_weights, whose xla rows are the stitched-chain fallback "
-        "(the DBSP_TPU_NATIVE force-off A/B control)",
+        "old_weights and the reduction offensive as kernel=segment_reduce "
+        "/ agg_ladder / join_sorted, whose xla rows are the stitched-chain "
+        "fallback (the DBSP_TPU_NATIVE force-off A/B control)",
         labels=("kernel", "backend"))
 
     def _collect() -> None:

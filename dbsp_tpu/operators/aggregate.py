@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +55,17 @@ class Aggregator:
     ``reduce`` sees every gathered row (including absent ones, net w <= 0) and
     must ignore non-present rows itself; identity segments are reported
     through the separate nonempty mask, so identity values never escape.
+
+    The built-ins declare their reduction DECLARATIVELY via
+    :meth:`reduce_spec` — a tuple of ``(op, source column)`` pairs from the
+    shared five-op vocabulary (count / sum / min / max / avg) — and inherit
+    ``reduce`` from the spec through :func:`segment_reduce`, which
+    dispatches the whole spec as ONE native custom call on CPU
+    (``ZsetSegmentReduceFfi``) instead of 2-4 XLA dispatches per output.
+    The spec is also what lets the compiled engine's fused aggregate
+    megakernel (``cursor.agg_ladder``) run the reduction inside the trace
+    walk; spec-less aggregators (``Fold``) keep their hand-written
+    ``reduce`` and the stitched path.
     """
 
     out_dtypes: Tuple = ()
@@ -66,10 +77,18 @@ class Aggregator:
     #: bids) cost O(delta) instead of O(touched history) per tick.
     insert_combinable = False
 
+    def reduce_spec(self) -> Optional[Tuple[Tuple[str, int], ...]]:
+        """``((op, src_col), ...)`` per output — ``None`` for opaque
+        (hand-written) reductions, which the fused paths skip."""
+        return None
+
     def reduce(self, val_cols: Tuple[jnp.ndarray, ...], weights: jnp.ndarray,
                seg: jnp.ndarray, num_segments: int
                ) -> Tuple[jnp.ndarray, ...]:
-        raise NotImplementedError
+        spec = self.reduce_spec()
+        if spec is None:
+            raise NotImplementedError
+        return segment_reduce(spec, val_cols, weights, seg, num_segments)
 
     def combine(self, a_vals: Tuple[jnp.ndarray, ...], a_present,
                 b_vals: Tuple[jnp.ndarray, ...], b_present
@@ -85,9 +104,8 @@ class Count(Aggregator):
     out_dtypes = (jnp.int64,)
     name = "count"
 
-    def reduce(self, val_cols, weights, seg, num_segments):
-        w = jnp.maximum(weights, 0)
-        return (jax.ops.segment_sum(w, seg, num_segments=num_segments),)
+    def reduce_spec(self):
+        return (("count", 0),)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,10 +114,8 @@ class Sum(Aggregator):
     out_dtypes = (jnp.int64,)
     name = "sum"
 
-    def reduce(self, val_cols, weights, seg, num_segments):
-        w = jnp.maximum(weights, 0)
-        return (jax.ops.segment_sum(val_cols[self.col] * w, seg,
-                                    num_segments=num_segments),)
+    def reduce_spec(self):
+        return (("sum", self.col),)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,12 +125,8 @@ class Max(Aggregator):
     name = "max"
     insert_combinable = True
 
-    def reduce(self, val_cols, weights, seg, num_segments):
-        v = val_cols[self.col]
-        lo = jnp.iinfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.integer) \
-            else -jnp.inf
-        masked = jnp.where(weights > 0, v, lo)
-        return (jax.ops.segment_max(masked, seg, num_segments=num_segments),)
+    def reduce_spec(self):
+        return (("max", self.col),)
 
     def combine(self, a_vals, a_present, b_vals, b_present):
         a, b = a_vals[0], b_vals[0].astype(a_vals[0].dtype)
@@ -129,12 +141,8 @@ class Min(Aggregator):
     name = "min"
     insert_combinable = True
 
-    def reduce(self, val_cols, weights, seg, num_segments):
-        v = val_cols[self.col]
-        hi = jnp.iinfo(v.dtype).max if jnp.issubdtype(v.dtype, jnp.integer) \
-            else jnp.inf
-        masked = jnp.where(weights > 0, v, hi)
-        return (jax.ops.segment_min(masked, seg, num_segments=num_segments),)
+    def reduce_spec(self):
+        return (("min", self.col),)
 
     def combine(self, a_vals, a_present, b_vals, b_present):
         a, b = a_vals[0], b_vals[0].astype(a_vals[0].dtype)
@@ -145,21 +153,16 @@ class Min(Aggregator):
 @dataclasses.dataclass(frozen=True)
 class Average(Aggregator):
     """Integer average sum//count (deterministic across worker counts, unlike
-    float accumulation order)."""
+    float accumulation order). Truncating division (SQL/Rust semantics),
+    not Python floor: -7 / 2 == -3, matching the reference engine on
+    negative sums — the shared "avg" op implements exactly that."""
 
     col: int = 0
     out_dtypes = (jnp.int64,)
     name = "avg"
 
-    def reduce(self, val_cols, weights, seg, num_segments):
-        w = jnp.maximum(weights, 0)
-        s = jax.ops.segment_sum(val_cols[self.col] * w, seg,
-                                num_segments=num_segments)
-        c = jnp.maximum(jax.ops.segment_sum(w, seg,
-                                            num_segments=num_segments), 1)
-        # truncating division (SQL/Rust semantics), not Python floor:
-        # -7 / 2 == -3, matching the reference engine on negative sums
-        return (jnp.where(s >= 0, s // c, -((-s) // c)),)
+    def reduce_spec(self):
+        return (("avg", self.col),)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,21 +187,155 @@ class Fold(Aggregator):
 
 
 # ---------------------------------------------------------------------------
+# Shared segment-reduction dispatch (the five-op Aggregator vocabulary)
+# ---------------------------------------------------------------------------
+
+
+def _seg_out_dtype(op: str, col: int, val_cols, weights):
+    """Result dtype of one reduction op under the XLA formulation — what
+    the native kernel's int64 accumulators re-narrow to (two's-complement
+    truncation == wrapping narrow-dtype accumulation, so int32-weight
+    paths stay bit-identical)."""
+    if op == "count":
+        return weights.dtype
+    if op == "present":
+        return jnp.int64  # jnp.where(w > 0, 1, 0) under x64
+    v = val_cols[col]
+    if op in ("min", "max"):
+        return v.dtype
+    return jnp.promote_types(v.dtype, weights.dtype)  # sum / avg
+
+
+def segment_reduce(spec, val_cols, weights: jnp.ndarray, seg: jnp.ndarray,
+                   num_segments: int) -> Tuple[jnp.ndarray, ...]:
+    """Run a whole reduce spec — ``((op, src_col), ...)`` over the shared
+    count/sum/min/max/avg(/present) vocabulary — per segment id, as ONE
+    native custom call on CPU (``ZsetSegmentReduceFfi``; the
+    ``DBSP_TPU_NATIVE=segment_reduce`` force-off and non-int dtypes fall
+    back to the ``jax.ops.segment_*`` formulation below). Semantics per op
+    (bit-identical on every backend): count = Σ max(w, 0); sum =
+    Σ v·max(w, 0); min/max over rows with w > 0 (empty segments fill with
+    the source dtype's identity); avg = truncating sum/count division;
+    present = any w > 0 (as the 0/1 int the XLA formulation produces).
+    Out-of-range seg ids are dropped (the trash-segment contract)."""
+    out_dtypes = tuple(_seg_out_dtype(op, col, val_cols, weights)
+                       for op, col in spec)
+    # avg DIVIDES: the fused backends accumulate in int64 and narrow the
+    # quotient, which equals the XLA formulation only when the result
+    # dtype IS int64 (for sums, truncating an int64 accumulation equals a
+    # wrapping narrow accumulation — division breaks that congruence).
+    # Narrower promotions (int32 weights x int32 vals — no engine path,
+    # weights are int64 everywhere) keep the XLA chain.
+    fused_ok = all(op != "avg" or jnp.dtype(dt) == jnp.int64
+                   for (op, _), dt in zip(spec, out_dtypes))
+    if fused_ok and weights.ndim == 1 and num_segments >= 1:
+        if kernels.pallas_requested():
+            from dbsp_tpu.zset import pallas_kernels
+
+            if pallas_kernels.use_pallas("segment_reduce",
+                                         (*val_cols, weights)):
+                kernels.count_kernel_dispatch("segment_reduce", "pallas")
+                return pallas_kernels.segment_reduce_pallas(
+                    spec, val_cols, weights, seg, num_segments, out_dtypes)
+        if kernels.native_kernel("segment_reduce"):
+            from dbsp_tpu.zset import native_merge
+
+            if native_merge.supports((*(c.dtype for c in val_cols),
+                                      weights.dtype)):
+                kernels.count_kernel_dispatch("segment_reduce", "native")
+                return native_merge.segment_reduce_native(
+                    spec, val_cols, weights, seg, num_segments, out_dtypes)
+    kernels.count_kernel_dispatch("segment_reduce", "xla")
+    wpos = jnp.maximum(weights, 0)
+    outs: List[jnp.ndarray] = []
+    for op, col in spec:
+        if op == "count":
+            outs.append(jax.ops.segment_sum(wpos, seg,
+                                            num_segments=num_segments))
+        elif op == "sum":
+            outs.append(jax.ops.segment_sum(val_cols[col] * wpos, seg,
+                                            num_segments=num_segments))
+        elif op == "min":
+            v = val_cols[col]
+            hi = jnp.iinfo(v.dtype).max \
+                if jnp.issubdtype(v.dtype, jnp.integer) else jnp.inf
+            outs.append(jax.ops.segment_min(
+                jnp.where(weights > 0, v, hi), seg,
+                num_segments=num_segments))
+        elif op == "max":
+            v = val_cols[col]
+            lo = jnp.iinfo(v.dtype).min \
+                if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf
+            outs.append(jax.ops.segment_max(
+                jnp.where(weights > 0, v, lo), seg,
+                num_segments=num_segments))
+        elif op == "avg":
+            s = jax.ops.segment_sum(val_cols[col] * wpos, seg,
+                                    num_segments=num_segments)
+            c = jnp.maximum(jax.ops.segment_sum(
+                wpos, seg, num_segments=num_segments), 1)
+            outs.append(jnp.where(s >= 0, s // c, -((-s) // c)))
+        elif op == "present":
+            outs.append(jax.ops.segment_max(
+                jnp.where(weights > 0, 1, 0), seg,
+                num_segments=num_segments))
+        else:
+            raise ValueError(f"unknown segment-reduce op {op!r}")
+    return tuple(outs)
+
+
+def reduce_with_present(agg: "Aggregator", val_cols, weights, seg,
+                        num_segments: int):
+    """(outputs, presence) in as few dispatches as the aggregator allows:
+    spec'd aggregators append a ``present`` op to their own spec, so the
+    whole thing is ONE fused ``segment_reduce`` call; opaque ones pay
+    their hand-written reduce plus the separate presence reduction."""
+    spec = agg.reduce_spec()
+    if spec is not None:
+        res = segment_reduce((*spec, ("present", 0)), val_cols, weights,
+                             seg, num_segments)
+        return tuple(res[:-1]), res[-1]
+    outs = tuple(agg.reduce(val_cols, weights, seg, num_segments))
+    present = jax.ops.segment_max(
+        jnp.where(weights > 0, 1, 0), seg, num_segments=num_segments)
+    return outs, present
+
+
+# ---------------------------------------------------------------------------
 # Kernels
 # ---------------------------------------------------------------------------
+
+
+def _delta_groups_impl(delta: Batch, nk: int):
+    """Group structure of a consolidated delta in ONE run-boundary scan:
+    ``(unique key cols, unique live mask, row live mask, segment id per
+    row)``. The delta's sorted-run contract (``sorted_runs == 1`` — live
+    rows packed, equal keys adjacent) is what makes the single
+    prev-row comparison exact; the same ``first``-of-group mask feeds both
+    the unique-key compaction and the fast path's per-row segment ids, so
+    the boundaries are never scanned twice (they previously were —
+    ``_unique_keys_impl`` then a second ``rows_equal_prev`` in
+    CAggregate's fast path)."""
+    keys = delta.keys[:nk]
+    first = ~kernels.rows_equal_prev(keys, n=delta.cap)
+    anylive = delta.weights != 0
+    live = anylive & first
+    cols, w = kernels.compact(keys, jnp.where(live, 1, 0).astype(jnp.int32),
+                              live)
+    seg = jnp.cumsum(jnp.where(live, 1, 0)) - 1
+    return cols, w != 0, anylive, seg
 
 
 def _unique_keys_impl(delta: Batch, nk: int
                       ) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
     """Distinct live keys of a consolidated batch, compacted to the front.
 
-    Returns (key_cols, live_mask) at the delta's capacity.
-    """
-    keys = delta.keys[:nk]
-    first = ~kernels.rows_equal_prev(keys, n=delta.cap)
-    live = (delta.weights != 0) & first
-    cols, w = kernels.compact(keys, jnp.where(live, 1, 0).astype(jnp.int32), live)
-    return cols, w != 0
+    Returns (key_cols, live_mask) at the delta's capacity. The one
+    run-boundary scan lives in :func:`_delta_groups_impl`; the segment
+    ids computed there are dead code under jit for callers that only
+    need the keys."""
+    cols, qlive, _, _ = _delta_groups_impl(delta, nk)
+    return cols, qlive
 
 
 _unique_keys_jit = jax.jit(_unique_keys_impl, static_argnames=("nk",))
@@ -344,9 +481,7 @@ def _reduce_groups_impl(parts, agg: Aggregator, q_cap: int,
     # dead rows carry qrow >= q_cap (q_cap marker, or int32 sentinel after
     # a merge compaction) — clamp everything dead into the trash segment
     seg = jnp.minimum(qrow, q_cap).astype(jnp.int32)
-    outs = agg.reduce(val_cols, w, seg, q_cap + 1)
-    present = jax.ops.segment_max(
-        jnp.where(w > 0, 1, 0), seg, num_segments=q_cap + 1)
+    outs, present = reduce_with_present(agg, val_cols, w, seg, q_cap + 1)
     return tuple(o[:q_cap] for o in outs), present[:q_cap] > 0
 
 
@@ -468,19 +603,14 @@ class AggregateOp(UnaryOperator):
 
 @dataclasses.dataclass(frozen=True)
 class _TupleMax(Aggregator):
-    """Internal: recover the (unique) previous output row per key."""
+    """Internal: recover the (unique) previous output row per key — a
+    per-column "max over net-positive rows", i.e. one shared-vocabulary
+    max op per column."""
 
     ncols: int = 1
 
-    def reduce(self, val_cols, weights, seg, num_segments):
-        outs = []
-        for v in val_cols:
-            lo = (jnp.iinfo(v.dtype).min
-                  if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf)
-            outs.append(jax.ops.segment_max(
-                jnp.where(weights > 0, v, lo), seg,
-                num_segments=num_segments))
-        return tuple(outs)
+    def reduce_spec(self):
+        return tuple(("max", i) for i in range(self.ncols))
 
 
 @stream_method

@@ -39,6 +39,46 @@ from dbsp_tpu.zset.batch import Batch, bucket_cap, concat_batches
 JoinFn = Callable[[Tuple, Tuple, Tuple], Tuple[Tuple, Tuple]]
 
 
+class _ColRef:
+    """Column-identity marker for probing a join pair-fn: supports nothing
+    but being selected, so any fn that computes (arithmetic, astype, ...)
+    raises and falls off the permutation fast path."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i: int):
+        self.i = i
+
+
+def fn_permutation(fn: JoinFn, nk: int, ndv: int, nlv: int):
+    """``(n_out_keys, perm)`` when ``fn`` is a pure column SELECTION —
+    every output column is exactly one input column, so the whole pair
+    function is a permutation/projection of the raw
+    ``(probed keys, delta vals, level vals)`` column space (raw index
+    ``0..nk-1`` = key, ``nk..nk+ndv-1`` = delta val, ``nk+ndv..`` = level
+    val). ``None`` otherwise. Probed by CALLING the fn once with
+    :class:`_ColRef` markers: plain tuple indexing/splatting works (every
+    Nexmark join qualifies), anything value-dependent raises and is
+    conservatively rejected. The permutation is what lets the native
+    sorted-emit join megakernel apply the fn in-call
+    (``cursor.join_ladder(..., sorted_emit=...)``) and emit each side as
+    one consolidated run — killing the post-join full sort."""
+    ks = tuple(_ColRef(i) for i in range(nk))
+    lv = tuple(_ColRef(nk + i) for i in range(ndv))
+    rv = tuple(_ColRef(nk + ndv + i) for i in range(nlv))
+    try:
+        ok, ov = fn(ks, lv, rv)
+        out = (*tuple(ok), *tuple(ov))
+    except Exception:  # noqa: BLE001 — any computing fn lands here
+        return None
+    if not out or not all(type(c) is _ColRef for c in out):
+        return None
+    return len(tuple(ok)), tuple(c.i for c in out)
+
+
+_PERM_UNSET = object()
+
+
 def _join_level_impl(delta: Batch, level: Batch, nk: int, fn: JoinFn,
                      out_cap: int) -> Tuple[Batch, jnp.ndarray]:
     """Join a delta batch against one spine level; static out_cap.
@@ -75,17 +115,20 @@ def _join_level_impl(delta: Batch, level: Batch, nk: int, fn: JoinFn,
 _join_level = jax.jit(_join_level_impl, static_argnames=("nk", "fn", "out_cap"))
 
 
-def _join_ladder_factory(nk: int, fn: JoinFn, out_cap: int):
+def _join_ladder_factory(nk: int, fn: JoinFn, out_cap: int,
+                         sorted_emit=None):
     from dbsp_tpu.zset import cursor
 
-    return lambda d, levels: cursor.join_ladder(d, levels, nk, fn, out_cap)
+    return lambda d, levels: cursor.join_ladder(d, levels, nk, fn, out_cap,
+                                                sorted_emit)
 
 
-@partial(jax.jit, static_argnames=("nk", "fn", "out_cap"))
-def _join_ladder(delta: Batch, levels, nk: int, fn: JoinFn, out_cap: int):
+@partial(jax.jit, static_argnames=("nk", "fn", "out_cap", "sorted_emit"))
+def _join_ladder(delta: Batch, levels, nk: int, fn: JoinFn, out_cap: int,
+                 sorted_emit=None):
     from dbsp_tpu.zset import cursor
 
-    return cursor.join_ladder(delta, levels, nk, fn, out_cap)
+    return cursor.join_ladder(delta, levels, nk, fn, out_cap, sorted_emit)
 
 
 class JoinCore:
@@ -104,28 +147,58 @@ class JoinCore:
         self.fn = fn
         self.out_schema = out_schema
         self.out_cap = 0  # fused ladder output capacity (monotone)
+        self._perm = _PERM_UNSET  # fn_permutation, probed on first eval
 
-    def _launch(self, delta: Batch, levels, cap: int):
+    def sorted_emit(self, delta: Batch, levels):
+        """``(n_out_keys, perm, out_dtypes)`` when the sorted-emit join
+        megakernel may replace the pair fn for these operands: the fn is a
+        pure column permutation AND every projected source column's dtype
+        equals the declared out_schema dtype (a permutation cannot cast,
+        so a declared widening keeps the stitched path). ``None``
+        otherwise."""
+        if not levels:
+            return None
+        if self._perm is _PERM_UNSET:
+            self._perm = fn_permutation(self.fn, self.nk, len(delta.vals),
+                                        len(levels[0].vals))
+        if self._perm is None:
+            return None
+        n_out_keys, perm = self._perm
+        out_dts = tuple(jnp.dtype(d)
+                        for d in (*self.out_schema[0], *self.out_schema[1]))
+        raw = (*delta.keys[:self.nk], *delta.vals, *levels[0].vals)
+        if len(perm) != len(out_dts) or any(p >= len(raw) for p in perm):
+            return None
+        if tuple(raw[p].dtype for p in perm) != out_dts:
+            return None
+        return n_out_keys, perm, out_dts
+
+    def _launch(self, delta: Batch, levels, cap: int, sorted_emit=None):
         if delta.sharded:
-            return lifted(_join_ladder_factory, self.nk, self.fn, cap)(
-                delta, levels)
-        return _join_ladder(delta, levels, self.nk, self.fn, cap)
+            return lifted(_join_ladder_factory, self.nk, self.fn, cap,
+                          sorted_emit)(delta, levels)
+        return _join_ladder(delta, levels, self.nk, self.fn, cap,
+                            sorted_emit)
 
     def join_levels(self, delta: Batch, levels: Sequence[Batch]
                     ) -> List[Batch]:
         """Launch the fused ladder join; returns the RAW combined output
         (a 1-element list — the concat-and-consolidate call sites are
-        shared with the empty/ladder cases)."""
+        shared with the empty/ladder cases). With a permutation pair fn on
+        the native CPU path the element comes back as ONE consolidated run
+        (see :meth:`sorted_emit`), so the caller's consolidate is a skip or
+        a 2-run rank fold — never a sort."""
         if not levels:
             return []
         levels = tuple(levels)
+        se = self.sorted_emit(delta, levels)
         if not self.out_cap:
             self.out_cap = bucket_cap(max(64, delta.cap))
-        out, total = self._launch(delta, levels, self.out_cap)
+        out, total = self._launch(delta, levels, self.out_cap, se)
         t = int(np.max(jax.device_get(total)))  # ONE sync; worst worker
         if t > self.out_cap:
             self.out_cap = bucket_cap(t)
-            out, _ = self._launch(delta, levels, self.out_cap)
+            out, _ = self._launch(delta, levels, self.out_cap, se)
         return [out]
 
 

@@ -50,7 +50,17 @@ class _SqlNullAgg:
     rows whose argument carries the NULL_INT marker are ignored, and a
     group with no non-NULL rows aggregates to NULL (count: to 0) — SQL
     semantics sqlite also implements. Only used when the query has a LEFT
-    JOIN (other queries keep the linear fast path)."""
+    JOIN (other queries keep the linear fast path).
+
+    The reduction itself is NOT a third copy of the segment_sum glue: the
+    NULL mask zeroes the weights, then the op and the non-NULL count ride
+    ONE :func:`dbsp_tpu.operators.aggregate.segment_reduce` call — the
+    same five-op dispatch (native ``ZsetSegmentReduceFfi`` on CPU) every
+    built-in :class:`~dbsp_tpu.operators.aggregate.Aggregator` lowers
+    through — with only the aggregate-to-NULL fixup as an elementwise
+    tail. ``reduce_spec`` stays ``None``: the NULL mask and the fixup are
+    not expressible as a bare spec, so the fused aggregate megakernel
+    skips these (they only occur on LEFT JOIN plans)."""
 
     fn: str = "sum"
     out_dtypes = (jnp.int64,)
@@ -60,32 +70,21 @@ class _SqlNullAgg:
     def name(self):
         return f"sql-null-{self.fn}"
 
+    def reduce_spec(self):
+        return None
+
     def reduce(self, val_cols, weights, seg, num_segments):
-        import jax
+        from dbsp_tpu.operators.aggregate import segment_reduce
 
         v = val_cols[0]
         null = NULL_INT(v.dtype)
         w = jnp.where(v == null, 0, weights)
-        wpos = jnp.maximum(w, 0)
-        cnt = jax.ops.segment_sum(wpos, seg, num_segments=num_segments)
         if self.fn == "count":
-            return (cnt,)  # COUNT of all-NULL is 0, not NULL
-        if self.fn == "sum":
-            out = jax.ops.segment_sum(v * wpos, seg,
-                                      num_segments=num_segments)
-        elif self.fn == "min":
-            hi = jnp.iinfo(v.dtype).max
-            out = jax.ops.segment_min(jnp.where(w > 0, v, hi), seg,
-                                      num_segments=num_segments)
-        elif self.fn == "max":
-            lo = jnp.iinfo(v.dtype).min
-            out = jax.ops.segment_max(jnp.where(w > 0, v, lo), seg,
-                                      num_segments=num_segments)
-        else:  # avg — truncating division, matching Average
-            s = jax.ops.segment_sum(v * wpos, seg,
-                                    num_segments=num_segments)
-            c = jnp.maximum(cnt, 1)
-            out = jnp.where(s >= 0, s // c, -((-s) // c))
+            # COUNT of all-NULL is 0, not NULL
+            return segment_reduce((("count", 0),), (v,), w, seg,
+                                  num_segments)
+        out, cnt = segment_reduce(((self.fn, 0), ("count", 0)), (v,), w,
+                                  seg, num_segments)
         return (jnp.where(cnt > 0, out, jnp.asarray(null, out.dtype)),)
 
 # SQL NULL marker for outer-join padding: the dtype's MINIMUM (the maximum

@@ -210,7 +210,7 @@ def _ladder_dtypes(delta: Batch, levels: Sequence[Batch]):
 
 
 def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
-                out_cap: int) -> Tuple[Batch, jnp.ndarray]:
+                out_cap: int, sorted_emit=None) -> Tuple[Batch, jnp.ndarray]:
     """Join a delta against ALL trace levels: one probe pair, one expansion,
     one output buffer. Replaces the per-level ``_join_level_impl`` loop
     (operators/join.py) and the compiled offset-scatter (cnodes).
@@ -219,6 +219,19 @@ def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
     UNCLAMPED cross-level requirement — when it exceeds ``out_cap`` the
     tail matches drop off the end and the caller grows + relaunches
     (host) or the runner's validation replays (compiled).
+
+    ``sorted_emit`` — ``(n_out_keys, perm, out_dtypes)`` when the pair
+    function is a pure column PERMUTATION of the raw (probed keys, delta
+    vals, level vals) columns (``operators.join.fn_permutation`` probes the
+    fn to find out) — selects the SORTED-EMIT megakernel on the native CPU
+    path: the projection is applied in-call and the side's buffer comes
+    back as ONE consolidated run (``runs=(out_cap,)``), so the caller's
+    post-join ``concat().consolidate()`` rank-folds two runs with a single
+    linear merge instead of full-sorting the doubled buffer, and the
+    pair-fn + dead-slot-mask XLA passes disappear. The emitted Z-set is
+    identical (netting only canonicalizes), so the post-consolidation
+    batch is bit-identical to every other backend; the
+    ``DBSP_TPU_NATIVE=join_sorted`` force-off is the A/B control.
 
     Backend dispatch (1-D operands, int64-widenable columns): ONE native
     megakernel custom call on CPU (probe + expand + both-side gathers +
@@ -230,6 +243,21 @@ def join_ladder(delta: Batch, levels: Sequence[Batch], nk: int, fn,
     assert levels, "join_ladder: trace has no levels"
     dk = delta.keys[:nk]
     if nk >= 1 and delta.weights.ndim == 1 and out_cap >= 1:
+        # Pallas takes precedence: there is no sorted-emit Pallas mode
+        # (the TPU rank-merge regime owns consolidation there), and a
+        # DBSP_TPU_PALLAS force-on must actually measure the Pallas
+        # program — a native kernel preempting it would silently turn the
+        # Pallas-vs-XLA A/B into a native measurement
+        if sorted_emit is not None and not kernels.pallas_requested() and \
+                kernels.native_kernel("join_sorted"):
+            from dbsp_tpu.zset import native_merge
+
+            n_out_keys, perm, out_dts = sorted_emit
+            if native_merge.supports((*_ladder_dtypes(delta, levels),
+                                      *out_dts)):
+                kernels.count_kernel_dispatch("join_sorted", "native")
+                return native_merge.join_ladder_sorted_native(
+                    delta, levels, nk, perm, n_out_keys, out_dts, out_cap)
         if kernels.pallas_requested():
             from dbsp_tpu.zset import pallas_kernels
 
@@ -344,6 +372,123 @@ def gather_ladder(qkeys: Cols, qlive: jnp.ndarray, levels: Sequence[Batch],
                  for v in _select_gather(gcols, level, src))
     qrow = jnp.where(valid, qrow, jnp.int32(q_cap)).astype(jnp.int32)
     return (qrow, vals, w), total
+
+
+def agg_ladder(delta: Batch, nk: int, out_trace: Batch,
+               levels: Sequence[Batch], agg, q_cap: int, gather_cap: int,
+               fast: bool, flag: jnp.ndarray):
+    """The WHOLE general-aggregate reduce chain over a trace ladder in one
+    entry point — unique touched keys (run-boundary scan of the
+    consolidated delta), the previous outputs from the operator's own
+    out-trace (exact-match probe + per-column ``_TupleMax``), the touched
+    groups' ladder histories netted across levels and reduced by the
+    aggregator's :func:`~dbsp_tpu.operators.aggregate.segment_reduce`
+    spec, and (``fast`` mode) the delta's own reduction from the same run
+    scan. ``flag`` is the RUNTIME ladder gate: ``ever_negative`` on the
+    insert-combinable fast path (the slow re-gather engages only once a
+    retraction has entered the stream), constant true on the general path.
+
+    Returns ``(qkeys, qlive, nq, old_vals, old_present, lad_vals,
+    lad_present, d_vals, d_present, gather_total)`` — ``nq`` and
+    ``gather_total`` are the UNCLAMPED ``queries``/``gather`` capacity
+    requirements (the standard grow/replay contract; on overflow the
+    clamped buffers match the stitched chain bit for bit and are discarded
+    by the replay either way).
+
+    Backend dispatch mirrors :func:`join_ladder`: ONE native megakernel
+    custom call on CPU for spec'd aggregators
+    (``native_merge.agg_ladder_native`` — the gathered history never
+    materializes at all); a composed Pallas lowering when Pallas is
+    selected (the grid-over-levels gather megakernel + the Pallas segment
+    reduce); else the stitched unique-keys/gather/net/reduce chain below
+    (also the ``DBSP_TPU_NATIVE=agg_ladder`` force-off control)."""
+    from dbsp_tpu.operators import aggregate as A
+
+    assert levels, "agg_ladder: trace has no levels"
+    spec = agg.reduce_spec()
+    # the fused backends assume the CAggregate state shape: the out trace
+    # carries exactly one value column per aggregate output, and the
+    # ladder levels share the delta's value schema (they are its integral)
+    fusable = (spec is not None and nk >= 1 and delta.weights.ndim == 1
+               and q_cap >= 1 and gather_cap >= 1
+               and len(out_trace.vals) == len(spec)
+               and len(levels[0].vals) == len(delta.vals)
+               # avg divides — fused int64 accumulation equals the XLA
+               # wrap only for int64 results (see segment_reduce)
+               and all(op != "avg" or jnp.promote_types(
+                           levels[0].vals[col].dtype,
+                           levels[0].weights.dtype) == jnp.int64
+                       for op, col in spec))
+    if fusable:
+        lad_dts = tuple(
+            A._seg_out_dtype(op, col, levels[0].vals, levels[0].weights)
+            for op, col in spec)
+        d_dts = tuple(
+            A._seg_out_dtype(op, col, delta.vals, delta.weights)
+            for op, col in spec)
+        _all_cols = (*delta.cols, delta.weights, *out_trace.cols,
+                     out_trace.weights,
+                     *(c for lvl in levels for c in (*lvl.cols,
+                                                     lvl.weights)))
+        if kernels.pallas_requested():
+            from dbsp_tpu.zset import pallas_kernels
+
+            if pallas_kernels.use_pallas("agg_ladder", _all_cols):
+                kernels.count_kernel_dispatch("agg_ladder", "pallas")
+                return pallas_kernels.agg_ladder_pallas(
+                    delta, nk, out_trace, levels, agg, q_cap, gather_cap,
+                    fast, flag)
+        if kernels.native_kernel("agg_ladder"):
+            from dbsp_tpu.zset import native_merge
+
+            if native_merge.supports(c.dtype for c in _all_cols):
+                kernels.count_kernel_dispatch("agg_ladder", "native")
+                return native_merge.agg_ladder_native(
+                    delta, nk, out_trace, levels, spec, q_cap, gather_cap,
+                    fast, flag, lad_dts, d_dts)
+    kernels.count_kernel_dispatch("agg_ladder", "xla")
+    return _agg_ladder_stitched(delta, nk, out_trace, levels, agg, q_cap,
+                                gather_cap, fast, flag)
+
+
+def _agg_ladder_stitched(delta: Batch, nk: int, out_trace: Batch, levels,
+                         agg, q_cap: int, gather_cap: int, fast: bool,
+                         flag):
+    """The pure-XLA fallback and force-off A/B control: the chain
+    CAggregate.eval used to stitch inline, with the run-boundary scan done
+    ONCE (``_delta_groups_impl`` feeds both the unique-key compaction and
+    the fast path's segment ids — the boundaries were previously scanned
+    twice)."""
+    from dbsp_tpu.operators import aggregate as A
+
+    qkeys_full, qlive_full, anylive, seg_full = A._delta_groups_impl(
+        delta, nk)
+    nq = jnp.sum(qlive_full)
+    qkeys = tuple(c[..., :q_cap] for c in qkeys_full)
+    qlive = qlive_full[..., :q_cap]
+
+    # previous outputs: the out trace holds one live row per present key,
+    # so a q_cap expansion is exact
+    oqrow, ovals, ow, _ = A._gather_level_impl(qkeys, qlive, out_trace,
+                                               q_cap)
+    old_vals, old_present = A._reduce_groups_impl(
+        ((oqrow, ovals, ow),), A._TupleMax(len(agg.out_dtypes)), q_cap)
+
+    if fast:
+        seg = jnp.where(anylive, seg_full, q_cap).astype(jnp.int32)
+        d_vals = tuple(o[:q_cap] for o in agg.reduce(
+            delta.vals, delta.weights, seg, q_cap + 1))
+        one = jnp.where(delta.weights > 0, 1, 0)
+        d_present = jax.ops.segment_max(
+            one, seg, num_segments=q_cap + 1)[:q_cap] > 0
+    else:
+        d_vals, d_present = None, None  # general path never reads them
+    mask = qlive & jnp.broadcast_to(flag, qlive.shape)
+    part, gtot = gather_ladder(qkeys, mask, levels, gather_cap)
+    lad_vals, lad_present = A._reduce_groups_impl(
+        (part,), agg, q_cap, net=len(levels) > 1)
+    return (qkeys, qlive, nq, old_vals, old_present, lad_vals, lad_present,
+            d_vals, d_present, gtot.astype(jnp.int64))
 
 
 def old_weights_ladder(delta: Batch, levels: Sequence[Batch]) -> jnp.ndarray:

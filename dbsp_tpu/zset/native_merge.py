@@ -91,15 +91,24 @@ RANK_FOLD_TARGET = "dbsp_zset_rank_fold"
 JOIN_LADDER_TARGET = "dbsp_zset_join_ladder"
 GATHER_LADDER_TARGET = "dbsp_zset_gather_ladder"
 OLD_WEIGHTS_TARGET = "dbsp_zset_old_weights"
+SEGMENT_REDUCE_TARGET = "dbsp_zset_segment_reduce"
+AGG_LADDER_TARGET = "dbsp_zset_agg_ladder"
+JOIN_SORTED_TARGET = "dbsp_zset_join_sorted"
 
 # every native kernel the per-kernel force-off knob can address (the
-# DBSP_TPU_NATIVE csv grammar — see :func:`kernel_enabled`). The last
-# three are the FUSED ladder consumers: forcing one off falls back to the
-# stitched probe/expand/gather chain (which still dispatches the granular
-# kernels above), so A/B runs can isolate exactly the fusion win.
+# DBSP_TPU_NATIVE csv grammar — see :func:`kernel_enabled`). `join_ladder`
+# / `gather_ladder` / `old_weights` are the FUSED ladder consumers (PR 12):
+# forcing one off falls back to the stitched probe/expand/gather chain
+# (which still dispatches the granular kernels above). `segment_reduce` /
+# `agg_ladder` / `join_sorted` are the reduction offensive: the Aggregator
+# zoo's opcode segment reduction, the whole-CAggregate megakernel, and the
+# sorted-emit join mode whose per-side consolidated runs kill the
+# post-join sort — forcing those off restores the previous round's code
+# path exactly, so an A/B isolates just this fusion layer.
 KERNELS = ("merge", "consolidate", "probe", "probe_ladder", "expand",
            "gather", "compact", "rank_fold", "join_ladder",
-           "gather_ladder", "old_weights")
+           "gather_ladder", "old_weights", "segment_reduce", "agg_ladder",
+           "join_sorted")
 
 
 def _build() -> str:
@@ -158,7 +167,10 @@ def _load() -> ctypes.CDLL:
                     (RANK_FOLD_TARGET, "ZsetRankFoldFfi"),
                     (JOIN_LADDER_TARGET, "ZsetJoinLadderFfi"),
                     (GATHER_LADDER_TARGET, "ZsetGatherLadderFfi"),
-                    (OLD_WEIGHTS_TARGET, "ZsetOldWeightsFfi")):
+                    (OLD_WEIGHTS_TARGET, "ZsetOldWeightsFfi"),
+                    (SEGMENT_REDUCE_TARGET, "ZsetSegmentReduceFfi"),
+                    (AGG_LADDER_TARGET, "ZsetAggLadderFfi"),
+                    (JOIN_SORTED_TARGET, "ZsetJoinLadderSortedFfi")):
                 _FFI.register_ffi_target(
                     target, _FFI.pycapsule(getattr(_lib, symbol)),
                     platform="cpu")
@@ -548,6 +560,175 @@ def old_weights_ladder_native(delta, levels) -> jnp.ndarray:
     out = _FFI.ffi_call(OLD_WEIGHTS_TARGET, result,
                         vmap_method="sequential")(*ops)
     return _retag(out, delta.weights)[0].astype(delta.weights.dtype)
+
+
+# Segment-reduction opcodes shared with the C++ SegAccum (zset_merge.cpp)
+# and the Pallas twin — ONE vocabulary for every backend of the Aggregator
+# zoo's five reductions (+ the presence mask).
+SEG_OPS = {"count": 0, "sum": 1, "min": 2, "max": 3, "avg": 4, "present": 5}
+
+
+def seg_op_identity(op: str, src_dtype) -> int:
+    """The accumulator init / empty-segment fill of one reduction op, as a
+    host int — EXACTLY what the ``jax.ops.segment_*`` formulation fills
+    empty segments with (min fills with the SOURCE dtype's max, max — and
+    present, which IS a segment_max over 0/1 — with its min, the additive
+    ops with 0), so the native kernel's untouched segments can never drift
+    from the XLA fills."""
+    if op == "min":
+        return int(jnp.iinfo(jnp.dtype(src_dtype)).max)
+    if op in ("max", "present"):
+        return int(jnp.iinfo(jnp.dtype(src_dtype)).min)
+    return 0
+
+
+def _ops_meta(spec, val_dtypes) -> list:
+    """[opcode, src_col, identity] triples for a reduce spec (tuples of
+    (op name, source column)) — the meta layout the C++ kernels consume."""
+    out = []
+    for op, col in spec:
+        src = val_dtypes[col] if op in ("min", "max") else jnp.int64
+        out.extend((SEG_OPS[op], col, seg_op_identity(op, src)))
+    return out
+
+
+def segment_reduce_native(spec, val_cols, weights: jnp.ndarray,
+                          seg: jnp.ndarray, num_segments: int, out_dtypes):
+    """ONE custom call running a whole reduce spec (ZsetSegmentReduceImpl)
+    — drop-in for the CPU branch of ``operators.aggregate.segment_reduce``:
+    every op's jax.ops.segment_* chain (mask + reduce, 2-4 dispatches per
+    output) collapses into a single pass over (vals, weights, seg)."""
+    _load()
+    val_dtypes = tuple(c.dtype for c in val_cols)
+    meta = jnp.asarray([len(val_cols), *_ops_meta(spec, val_dtypes)],
+                       jnp.int64)
+    result = tuple(jax.ShapeDtypeStruct((num_segments,), jnp.int64)
+                   for _ in spec)
+    out = _FFI.ffi_call(SEGMENT_REDUCE_TARGET, result,
+                        vmap_method="sequential")(
+        *(c.astype(jnp.int64) for c in val_cols),
+        weights.astype(jnp.int64), seg.astype(jnp.int32), meta)
+    out = _retag(out, weights)
+    return tuple(c.astype(d) for c, d in zip(out, out_dtypes))
+
+
+def agg_ladder_native(delta, nk: int, out_trace, levels, spec,
+                      q_cap: int, gather_cap: int, fast: bool,
+                      flag: jnp.ndarray, lad_dtypes, d_dtypes):
+    """The WHOLE CAggregate reduce chain in one custom call
+    (ZsetAggLadderImpl): run-boundary unique keys, the out-trace exact-match
+    probe (per-column TupleMax of the previous outputs), the touched
+    groups' ladder history walk — cross-level netting + the aggregator's
+    segment reduction folded into the walk, nothing materialized — and, in
+    fast (insert-combinable) mode, the delta's own reduction in the same
+    run scan. ``flag`` is the RUNTIME ladder gate (ever_negative on the
+    fast path; constant true on the general path). Returns
+    ``(qkeys, qlive, nq, old_vals, old_present, lad_vals, lad_present,
+    d_vals, d_present, gather_total)`` with the stitched chain's exact
+    dtypes and clamping behavior."""
+    _load()
+    dk = delta.keys[:nk]
+    key_dts = tuple(c.dtype for c in dk)
+    old_dts = tuple(c.dtype for c in out_trace.vals)
+    nov = len(spec)
+    lval_dts = tuple(c.dtype for c in levels[0].vals)
+    meta = [len(levels), nk, len(delta.vals), len(levels[0].vals), nov,
+            1 if fast else 0, gather_cap]
+    meta += _ops_meta(spec, lval_dts)
+    meta += [seg_op_identity("max", d) for d in old_dts]  # TupleMax inits
+    meta += [int(kernels_sentinel(d)) for d in key_dts]
+    ops = [c.astype(jnp.int64) for c in (*dk, *delta.vals)]
+    ops.append(delta.weights.astype(jnp.int64))
+    ops.extend(c.astype(jnp.int64)
+               for c in (*out_trace.keys[:nk], *out_trace.vals,
+                         out_trace.weights))
+    for lvl in levels:
+        ops.extend(c.astype(jnp.int64)
+                   for c in (*lvl.keys[:nk], *lvl.vals, lvl.weights))
+    ops.append(flag.astype(jnp.int64).reshape(1))
+    ops.append(jnp.asarray(meta, jnp.int64))
+    result = (*(jax.ShapeDtypeStruct((q_cap,), jnp.int64)
+                for _ in range(nk)),
+              jax.ShapeDtypeStruct((q_cap,), jnp.bool_),
+              jax.ShapeDtypeStruct((1,), jnp.int64),
+              *(jax.ShapeDtypeStruct((q_cap,), jnp.int64)
+                for _ in range(nov)),
+              jax.ShapeDtypeStruct((q_cap,), jnp.bool_),
+              *(jax.ShapeDtypeStruct((q_cap,), jnp.int64)
+                for _ in range(nov)),
+              jax.ShapeDtypeStruct((q_cap,), jnp.bool_),
+              *(jax.ShapeDtypeStruct((q_cap,), jnp.int64)
+                for _ in range(nov)),
+              jax.ShapeDtypeStruct((q_cap,), jnp.bool_),
+              jax.ShapeDtypeStruct((1,), jnp.int64))
+    out = _FFI.ffi_call(AGG_LADDER_TARGET, result,
+                        vmap_method="sequential")(*ops)
+    out = _retag(out, delta.weights)
+    qkeys = tuple(c.astype(d) for c, d in zip(out[:nk], key_dts))
+    qlive = out[nk]
+    nq = out[nk + 1].reshape(())
+    i = nk + 2
+    old_vals = tuple(c.astype(d) for c, d in zip(out[i:i + nov], old_dts))
+    old_present = out[i + nov]
+    i += nov + 1
+    lad_vals = tuple(c.astype(d) for c, d in zip(out[i:i + nov],
+                                                 lad_dtypes))
+    lad_present = out[i + nov]
+    i += nov + 1
+    if fast:
+        d_vals = tuple(c.astype(d)
+                       for c, d in zip(out[i:i + nov], d_dtypes))
+        d_present = out[i + nov]
+    else:
+        d_vals, d_present = None, None  # general path never reads them
+    gtotal = out[i + nov + 1].reshape(())
+    return (qkeys, qlive, nq, old_vals, old_present, lad_vals, lad_present,
+            d_vals, d_present, gtotal)
+
+
+def kernels_sentinel(dtype) -> int:
+    from dbsp_tpu.zset import kernels
+
+    return int(kernels.sentinel_scalar(dtype))
+
+
+def join_ladder_sorted_native(delta, levels, nk: int, perm, n_out_keys: int,
+                              out_dtypes, out_cap: int):
+    """Sorted-emit join megakernel (ZsetJoinLadderSortedImpl): the whole
+    fused join with a permutation pair-fn applied IN the call and the
+    side's buffer emitted as ONE consolidated run (sorted by the projected
+    columns, equal rows netted, packed, sentinel tail). Returns
+    ``(Batch tagged runs=(out_cap,), unclamped total)`` — the caller's
+    post-join ``concat().consolidate()`` then rank-folds two runs with one
+    linear native merge instead of a full argsort."""
+    _load()
+    K = len(levels)
+    dk = delta.keys[:nk]
+    n_out = len(perm)
+    sentinels = tuple(kernels_sentinel(d) for d in out_dtypes)
+    ops = [c.astype(jnp.int64) for c in (*dk, *delta.vals)]
+    ops.append(delta.weights.astype(jnp.int64))
+    for lvl in levels:
+        ops.extend(c.astype(jnp.int64)
+                   for c in (*lvl.keys[:nk], *lvl.vals, lvl.weights))
+    ops.append(jnp.asarray(sentinels, jnp.int64))
+    ops.append(jnp.asarray(
+        [K, nk, len(delta.vals), len(levels[0].vals), n_out, *perm],
+        jnp.int64))
+    result = (*(jax.ShapeDtypeStruct((out_cap,), jnp.int64)
+                for _ in range(n_out + 1)),
+              jax.ShapeDtypeStruct((1,), jnp.int64))
+    out = _FFI.ffi_call(JOIN_SORTED_TARGET, result,
+                        vmap_method="sequential")(*ops)
+    out = _retag(out, delta.weights)
+    cols = tuple(c.astype(d) for c, d in zip(out[:n_out], out_dtypes))
+    w_dt = jnp.promote_types(delta.weights.dtype, levels[0].weights.dtype)
+    w = out[n_out].astype(w_dt)
+    total = out[n_out + 1].reshape(())
+    from dbsp_tpu.zset.batch import Batch
+
+    return Batch(cols[:n_out_keys], cols[n_out_keys:], w,
+                 runs=(out_cap,)), total
 
 
 def rank_fold_native(cols, weights: jnp.ndarray, runs):

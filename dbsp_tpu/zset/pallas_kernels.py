@@ -382,6 +382,112 @@ def gather_ladder_pallas(qkeys, qlive, levels, out_cap: int,
 
 
 # ---------------------------------------------------------------------------
+# Segment reduction (the Aggregator zoo's five-op vocabulary)
+# ---------------------------------------------------------------------------
+
+
+_SEG_BLOCK = 128  # segments per program — one lane-width output block
+
+
+def _segment_reduce_kernel(*refs, nv: int, ops):
+    """One program = one block of segment ids: broadcast-compare the whole
+    (vals, weights, seg) row set against the block's ids and reduce along
+    the row axis — a scatter-free formulation (TPU segment scatters are
+    exactly the lowering the engine does not trust), bit-identical to the
+    ``jax.ops.segment_*`` semantics including identity fills for empty
+    segments and dropped out-of-range ids."""
+    vals = [refs[i][:] for i in range(nv)]            # [1, n] int64
+    wv = refs[nv][:]                                  # [1, n]
+    segv = refs[nv + 1][:]                            # [1, n]
+    out_refs = refs[nv + 2:]
+    sb = out_refs[0].shape[-1]
+    s0 = pl.program_id(0) * sb
+    sid = s0 + jax.lax.broadcasted_iota(jnp.int64, (sb, 1), 0)
+    mask = segv == sid                                # [sb, n]
+    wpos = jnp.maximum(wv, 0)
+    live = mask & (wv > 0)
+    for r, (op, col, ident) in zip(out_refs, ops):
+        if op == "count":
+            out = jnp.sum(jnp.where(mask, wpos, 0), axis=1)
+        elif op == "sum":
+            out = jnp.sum(jnp.where(mask, wpos * vals[col], 0), axis=1)
+        elif op == "min":
+            out = jnp.min(jnp.where(live, vals[col], ident), axis=1)
+        elif op == "max":
+            out = jnp.max(jnp.where(live, vals[col], ident), axis=1)
+        elif op == "avg":
+            s = jnp.sum(jnp.where(mask, wpos * vals[col], 0), axis=1)
+            c = jnp.maximum(jnp.sum(jnp.where(mask, wpos, 0), axis=1), 1)
+            out = jnp.where(s >= 0, s // c, -((-s) // c))
+        else:  # present: exact segment_max(where(w>0,1,0)) — EVERY row of
+            # the segment participates (retraction-only segments max to 0);
+            # only truly empty segments keep the int64-min identity fill
+            out = jnp.max(
+                jnp.where(mask, (wv > 0).astype(jnp.int64), ident), axis=1)
+        r[:] = out[None, :].astype(jnp.int64)
+
+
+def segment_reduce_pallas(spec, val_cols, weights: jnp.ndarray,
+                          seg: jnp.ndarray, num_segments: int, out_dtypes):
+    """Drop-in for the accelerator branch of
+    ``operators.aggregate.segment_reduce``: ONE Pallas program per
+    :data:`_SEG_BLOCK` segment ids runs the WHOLE reduce spec (count / sum
+    / min / max / avg / present) over the row set — where the XLA
+    formulation paid 2-4 masked segment ops per output."""
+    n = weights.shape[-1]
+    nv = len(val_cols)
+    nseg_pad = -(-num_segments // _SEG_BLOCK) * _SEG_BLOCK
+    # int-only columns by the use_pallas gate, so the int64-widened
+    # identities are exact
+    ops = tuple((op, col, _seg_ident(op, col, val_cols))
+                for op, col in spec)
+    operands = [c.astype(jnp.int64).reshape(1, n) for c in val_cols]
+    operands.append(weights.astype(jnp.int64).reshape(1, n))
+    operands.append(seg.astype(jnp.int64).reshape(1, n))
+    in_specs = [pl.BlockSpec((1, n), lambda b: (0, 0))
+                for _ in range(nv + 2)]
+    out_specs = [pl.BlockSpec((1, _SEG_BLOCK), lambda b: (0, b))
+                 for _ in spec]
+    out_shape = [jax.ShapeDtypeStruct((1, nseg_pad), jnp.int64)
+                 for _ in spec]
+    out = pl.pallas_call(
+        partial(_segment_reduce_kernel, nv=nv, ops=ops),
+        grid=(nseg_pad // _SEG_BLOCK,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret_mode(),
+    )(*operands)
+    return tuple(c.reshape(nseg_pad)[:num_segments].astype(d)
+                 for c, d in zip(out, out_dtypes))
+
+
+def _seg_ident(op: str, col: int, val_cols) -> int:
+    from dbsp_tpu.zset.native_merge import seg_op_identity
+
+    src = val_cols[col].dtype if op in ("min", "max") else jnp.int64
+    return seg_op_identity(op, src)
+
+
+def agg_ladder_pallas(delta, nk: int, out_trace, levels, agg, q_cap: int,
+                      gather_cap: int, fast: bool, flag):
+    """The accelerator lowering of ``cursor.agg_ladder``: the same chain as
+    the stitched control, with its two heavy phases on hand-written Pallas
+    programs — the grid-over-levels GATHER megakernel
+    (:func:`gather_ladder_pallas`, selected inside ``cursor.gather_ladder``
+    when Pallas is on) and the spec'd segment reduction
+    (:func:`segment_reduce_pallas`, selected inside
+    ``operators.aggregate.segment_reduce``). The run-boundary compaction
+    and the cross-level netting stay ``lax``-native (sort-free compaction;
+    the netting sort is the rank-merge regime's problem on TPU) — by
+    construction bit-identical to every other backend."""
+    from dbsp_tpu.zset import cursor
+
+    return cursor._agg_ladder_stitched(delta, nk, out_trace, levels, agg,
+                                       q_cap, gather_cap, fast, flag)
+
+
+# ---------------------------------------------------------------------------
 # Rank-merge inner loop (cross-rank probe + position scatter)
 # ---------------------------------------------------------------------------
 

@@ -1155,6 +1155,826 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetGatherLadderFfi, ZsetGatherLadderImpl,
                                   .RemainingArgs()
                                   .RemainingRets());
 
+// ---------------------------------------------------------------------------
+// Opcode-parameterized segment reduction (the Aggregator zoo's inner loop)
+// ---------------------------------------------------------------------------
+//
+// The whole Aggregator family (operators/aggregate.py) is five segment
+// reductions — count / weighted-sum / min / max / avg — each an XLA
+// segment_sum/segment_max chain with where-mask glue on the hot path. This
+// handler runs ANY list of them over one (vals, weights, seg) pass: one
+// custom call per reduce instead of 2-4 XLA dispatches per output column.
+// Bit-identity contract with the jax.ops.segment_* formulation:
+//   count:   acc[s] += max(w, 0)                      (init 0)
+//   sum:     acc[s] += v * max(w, 0)                  (init 0)
+//   min:     if w > 0: acc[s] = min(acc[s], v)        (init = identity, the
+//            SOURCE dtype's max — what segment_min fills empty segments with)
+//   max:     if w > 0: acc[s] = max(acc[s], v)        (init = source dtype min)
+//   avg:     truncating sum/count division: c = max(cnt, 1);
+//            s >= 0 ? s / c : -((-s) / c)             (init 0)
+//   present: acc[s] |= (w > 0)                        (init 0)
+// Rows whose seg id falls outside [0, nseg) are dropped, exactly like the
+// XLA ops' out-of-range behavior (the trash-segment contract).
+//
+// Argument layout: [val_0..val_{nv-1} S64[n], weights S64[n], seg S32[n],
+// meta S64[1 + 3*nout] = (nv, then per output: opcode, src_col, identity)];
+// results: [out_0..out_{nout-1} S64[nseg]]. Accumulation runs in int64; the
+// caller re-narrows to the XLA result dtype (two's-complement truncation of
+// an int64 sum equals a wrapping narrow-dtype accumulation, so int32-weight
+// paths stay bit-identical).
+
+namespace {
+
+enum SegOp : int64_t {
+  kSegCount = 0,
+  kSegSum = 1,
+  kSegMin = 2,
+  kSegMax = 3,
+  kSegAvg = 4,
+  kSegPresent = 5,
+};
+
+// One segment-reduction accumulator set over netted/raw rows — shared by
+// ZsetSegmentReduceImpl and the agg-ladder megakernel so the op semantics
+// cannot drift between the standalone reduce and the fused form.
+struct SegAccum {
+  int64_t nout;
+  int64_t nseg;
+  const int64_t* ops;  // 3 per output: opcode, src_col, identity
+  std::vector<std::vector<int64_t>> acc;
+  std::vector<int64_t> poscnt;  // shared max(w,0) count (avg)
+  bool need_cnt = false;
+
+  SegAccum(int64_t nout_, int64_t nseg_, const int64_t* ops_)
+      : nout(nout_), nseg(nseg_), ops(ops_), acc(nout_) {
+    for (int64_t o = 0; o < nout; ++o) {
+      acc[o].assign(static_cast<size_t>(nseg), ops[3 * o + 2]);
+      if (ops[3 * o] == kSegAvg) need_cnt = true;
+    }
+    if (need_cnt) poscnt.assign(static_cast<size_t>(nseg), 0);
+  }
+
+  // vals(c) -> the row's value in source column c (int64-widened)
+  template <typename ValFn>
+  inline void add(int64_t s, int64_t w, ValFn vals) {
+    if (s < 0 || s >= nseg) return;
+    const int64_t wpos = w > 0 ? w : 0;
+    if (need_cnt) poscnt[s] += wpos;
+    for (int64_t o = 0; o < nout; ++o) {
+      const int64_t op = ops[3 * o];
+      const int64_t col = ops[3 * o + 1];
+      int64_t* a = acc[o].data();
+      switch (op) {
+        case kSegCount: a[s] += wpos; break;
+        case kSegSum: case kSegAvg:
+          if (wpos) a[s] += vals(col) * wpos;
+          break;
+        case kSegMin:
+          if (w > 0) { const int64_t v = vals(col); if (v < a[s]) a[s] = v; }
+          break;
+        case kSegMax:
+          if (w > 0) { const int64_t v = vals(col); if (v > a[s]) a[s] = v; }
+          break;
+        case kSegPresent: {
+          // exact segment_max(where(w>0,1,0)) semantics: EVERY row in the
+          // segment participates (a retraction-only segment maxes to 0,
+          // not the empty-segment identity)
+          const int64_t one = w > 0 ? 1 : 0;
+          if (one > a[s]) a[s] = one;
+          break;
+        }
+        default: break;
+      }
+    }
+  }
+
+  // write each output's finalized values (avg divides here)
+  void finish(int64_t o, int64_t* out) const {
+    const int64_t op = ops[3 * o];
+    if (op == kSegAvg) {
+      for (int64_t s = 0; s < nseg; ++s) {
+        const int64_t sum = acc[o][s];
+        const int64_t c = poscnt[s] > 1 ? poscnt[s] : 1;
+        out[s] = sum >= 0 ? sum / c : -((-sum) / c);
+      }
+      return;
+    }
+    std::memcpy(out, acc[o].data(),
+                static_cast<size_t>(nseg) * sizeof(int64_t));
+  }
+};
+
+}  // namespace
+
+static ffi::Error ZsetSegmentReduceImpl(ffi::RemainingArgs args,
+                                        ffi::RemainingRets rets) {
+  const int64_t nout = static_cast<int64_t>(rets.size());
+  if (nout < 1 || args.size() < 3) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_segment_reduce: argument/result count mismatch");
+  }
+  auto meta = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 1);
+  if (!meta.has_value() ||
+      static_cast<int64_t>(meta->element_count()) != 1 + 3 * nout) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_segment_reduce: bad meta buffer");
+  }
+  const int64_t nv = meta->typed_data()[0];
+  if (nv < 0 || args.size() != static_cast<size_t>(nv + 3)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_segment_reduce: argument count mismatch");
+  }
+  std::vector<const int64_t*> vcols(nv);
+  for (int64_t c = 0; c < nv; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!a.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_segment_reduce: S64 val col expected");
+    }
+    vcols[c] = a->typed_data();
+  }
+  auto wb = args.get<ffi::Buffer<ffi::DataType::S64>>(nv);
+  auto segb = args.get<ffi::Buffer<ffi::DataType::S32>>(nv + 1);
+  if (!wb.has_value() || !segb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_segment_reduce: bad weights/seg buffer");
+  }
+  const int64_t n = static_cast<int64_t>(wb->element_count());
+  const int64_t* wv = wb->typed_data();
+  const int32_t* seg = segb->typed_data();
+  int64_t nseg = 0;
+  std::vector<int64_t*> outs(nout);
+  for (int64_t o = 0; o < nout; ++o) {
+    auto r = rets.get<ffi::Buffer<ffi::DataType::S64>>(o);
+    if (!r.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_segment_reduce: S64 result expected");
+    }
+    outs[o] = r.value()->typed_data();
+    nseg = static_cast<int64_t>(r.value()->element_count());
+  }
+  SegAccum accum(nout, nseg, meta->typed_data() + 1);
+  for (int64_t i = 0; i < n; ++i) {
+    accum.add(seg[i], wv[i], [&](int64_t c) { return vcols[c][i]; });
+  }
+  for (int64_t o = 0; o < nout; ++o) accum.finish(o, outs[o]);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetSegmentReduceFfi, ZsetSegmentReduceImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
+// ---------------------------------------------------------------------------
+// Fused aggregate megakernel: the WHOLE CAggregate reduce chain, one call
+// ---------------------------------------------------------------------------
+//
+// CAggregate's eval stitched unique-keys -> out-trace gather -> per-column
+// TupleMax -> ladder gather -> cross-level netting -> aggregator segment
+// reduction, each its own dispatch chain (compiled/cnodes.py). This handler
+// IS that chain: one pass over the consolidated delta finds the group
+// boundaries (run-boundary scan — the delta's sorted-run contract is what
+// makes the linear scan exact) and, in fast (insert-combinable) mode, folds
+// the delta's own reduction in the same scan; the previous outputs come from
+// one exact-match probe of the out trace (per-column max over net-positive
+// rows — the _TupleMax contract); the touched groups' histories are walked
+// per query as a K-way merge over the ladder levels' sorted ranges, netting
+// equal (val-row)s across levels IN the walk (the stitched path pays a full
+// consolidate for this), with each netted row folded straight into the
+// SegAccum ops — the gathered rows are never materialized for XLA at all.
+//
+// Bit-identity contract with the stitched chain (tests/test_fused_agg.py):
+// identical (qkeys, qlive, nq, old/lad/delta outputs + presents, gather
+// total) on every input, including the gather-cap clamp: raw gathered rows
+// are counted in the stitched level-major order and accumulation stops at
+// gather_cap, so even an overflowing launch (whose outputs the runner
+// discards and replays) matches the XLA buffers bit for bit.
+//
+// The ladder phase is gated by a RUNTIME flag operand (ever_negative in
+// fast mode — the slow-path re-gather engages only once a retraction has
+// entered the stream; constant 1 in general mode), so the fast path costs
+// O(delta) with no retrace when the flag flips.
+//
+// Argument layout: [delta nk keys + ndv vals + weights, out-trace nk keys +
+// nov vals + weights, K levels (nk keys + nlv vals + weights), flag S64[1],
+// meta S64[7 + 4*nov + nk] = (K, nk, ndv, nlv, nov, fast, gather_cap, then
+// per output (opcode, src_col, identity), then nov old-col identities, then
+// nk key sentinels)]; results: [qkeys nk S64[q_cap], qlive PRED[q_cap],
+// nq S64[1], old nov S64[q_cap], old_present PRED[q_cap], lad nov
+// S64[q_cap], lad_present PRED[q_cap], d nov S64[q_cap], d_present
+// PRED[q_cap], gather_total S64[1]].
+
+namespace {
+
+// first index in [0, n) whose row compares >= (right=false) / > (right=true)
+// the query row `qi` of qcols — per-query binary search over sorted cols
+inline int64_t lex_bound(int64_t ncols, const int64_t* const* tcols,
+                         int64_t n, const int64_t* const* qcols, int64_t qi,
+                         bool right) {
+  int64_t lo = 0, hi = n;
+  while (lo < hi) {
+    const int64_t mid = (lo + hi) >> 1;
+    int cmp = 0;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const int64_t tv = tcols[c][mid], qv = qcols[c][qi];
+      if (tv != qv) { cmp = tv < qv ? -1 : 1; break; }
+    }
+    const bool go_right = right ? cmp <= 0 : cmp < 0;
+    if (go_right) lo = mid + 1; else hi = mid;
+  }
+  return lo;
+}
+
+// Breadth-first lower-bound probe over an INDEX LIST of query lanes (the
+// live-lane variant of probe_block_bfs: dead lanes pay nothing). Writes
+// lo[k * m + idx[x]] for x in [x0, x1).
+inline void probe_lo_bfs_idx(int64_t ncols, const int64_t* const* tcols,
+                             int64_t n, const int64_t* const* qcols,
+                             const int32_t* idx, int64_t x0, int64_t x1,
+                             int64_t* lo_out) {
+  const int64_t len = x1 - x0;
+  if (len <= 0) return;
+  std::vector<int64_t> lo(static_cast<size_t>(len), 0);
+  std::vector<int64_t> hi(static_cast<size_t>(len), n);
+  int64_t steps = 0;
+  while ((int64_t{1} << steps) <= n) ++steps;
+  for (int64_t s = 0; s < steps; ++s) {
+    for (int64_t x = 0; x < len; ++x) {
+      if (lo[x] >= hi[x]) continue;
+      const int64_t mid = (lo[x] + hi[x]) >> 1;
+      const int64_t i = idx[x0 + x];
+      int cmp = 0;
+      for (int64_t c = 0; c < ncols; ++c) {
+        const int64_t tv = tcols[c][mid], qv = qcols[c][i];
+        if (tv != qv) { cmp = tv < qv ? -1 : 1; break; }
+      }
+      if (cmp < 0) lo[x] = mid + 1; else hi[x] = mid;
+    }
+  }
+  for (int64_t x = 0; x < len; ++x) lo_out[x] = lo[x];
+}
+
+// End of the equal-key run starting at `a` (== lex_bound(..., right=true)),
+// found by GALLOPING forward instead of a second full binary search:
+// equality matches are 0-or-few rows, so this is one or two cache-hot
+// compares where the upper-bound search pays log(n) cold probes. Sortedness
+// makes it exact — rows equal to the query are contiguous from `a`.
+inline int64_t equal_run_end(int64_t ncols, const int64_t* const* tcols,
+                             int64_t n, const int64_t* const* qcols,
+                             int64_t qi, int64_t a) {
+  int64_t step = 1, b = a;
+  while (b + step <= n) {
+    const int64_t probe = b + step - 1;
+    bool eq = true;
+    for (int64_t c = 0; eq && c < ncols; ++c) {
+      eq = tcols[c][probe] == qcols[c][qi];
+    }
+    if (!eq) break;
+    b += step;
+    step <<= 1;
+  }
+  int64_t e = b + step - 1 < n ? b + step - 1 : n;
+  while (b < e) {
+    const int64_t mid = (b + e) >> 1;
+    bool eq = true;
+    for (int64_t c = 0; eq && c < ncols; ++c) {
+      eq = tcols[c][mid] == qcols[c][qi];
+    }
+    if (eq) b = mid + 1; else e = mid;
+  }
+  return b;
+}
+
+}  // namespace
+
+static ffi::Error ZsetAggLadderImpl(ffi::RemainingArgs args,
+                                    ffi::RemainingRets rets) {
+  if (args.size() < 4 || rets.size() < 6) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: argument/result count mismatch");
+  }
+  auto meta = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 1);
+  if (!meta.has_value() || meta->element_count() < 7) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: bad meta buffer");
+  }
+  const int64_t* mv = meta->typed_data();
+  const int64_t K = mv[0], nk = mv[1], ndv = mv[2], nlv = mv[3],
+                nov = mv[4];
+  const bool fast = mv[5] != 0;
+  const int64_t gather_cap = mv[6];
+  const int64_t* ops = mv + 7;               // 3 per output
+  const int64_t* old_ident = mv + 7 + 3 * nov;
+  const int64_t* key_sent = mv + 7 + 4 * nov;
+  const int64_t n_args = (nk + ndv + 1) + (nk + nov + 1) +
+                         K * (nk + nlv + 1) + 2;
+  if (K < 1 || nk < 1 || ndv < 0 || nlv < 0 || nov < 1 ||
+      static_cast<int64_t>(meta->element_count()) != 7 + 4 * nov + nk ||
+      static_cast<int64_t>(args.size()) != n_args ||
+      static_cast<int64_t>(rets.size()) != nk + 3 * nov + 6) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: argument count mismatch");
+  }
+
+  auto s64_arg = [&](size_t i) -> const int64_t* {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(i);
+    return a.has_value() ? a->typed_data() : nullptr;
+  };
+  // delta
+  std::vector<const int64_t*> dkeys(nk), dvals(ndv);
+  int64_t m = 0;
+  for (int64_t c = 0; c < nk; ++c) dkeys[c] = s64_arg(c);
+  for (int64_t c = 0; c < ndv; ++c) dvals[c] = s64_arg(nk + c);
+  auto dwb = args.get<ffi::Buffer<ffi::DataType::S64>>(nk + ndv);
+  if (!dwb.has_value() || dkeys[0] == nullptr) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: bad delta buffers");
+  }
+  const int64_t* dw = dwb->typed_data();
+  m = static_cast<int64_t>(dwb->element_count());
+  // out trace
+  size_t base = static_cast<size_t>(nk + ndv + 1);
+  std::vector<const int64_t*> tkeys(nk), tvals(nov);
+  for (int64_t c = 0; c < nk; ++c) tkeys[c] = s64_arg(base + c);
+  for (int64_t c = 0; c < nov; ++c) tvals[c] = s64_arg(base + nk + c);
+  auto twb = args.get<ffi::Buffer<ffi::DataType::S64>>(base + nk + nov);
+  if (!twb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: bad out-trace buffers");
+  }
+  const int64_t* tw = twb->typed_data();
+  const int64_t ocap = static_cast<int64_t>(twb->element_count());
+  // levels
+  base += static_cast<size_t>(nk + nov + 1);
+  std::vector<const int64_t*> lkeys(K * nk), lvals(K * nlv), lw(K);
+  std::vector<int64_t> caps(K);
+  for (int64_t k = 0; k < K; ++k) {
+    for (int64_t c = 0; c < nk; ++c) {
+      lkeys[k * nk + c] = s64_arg(base + k * (nk + nlv + 1) + c);
+    }
+    for (int64_t c = 0; c < nlv; ++c) {
+      lvals[k * nlv + c] = s64_arg(base + k * (nk + nlv + 1) + nk + c);
+    }
+    auto wbuf = args.get<ffi::Buffer<ffi::DataType::S64>>(
+        base + k * (nk + nlv + 1) + nk + nlv);
+    if (!wbuf.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_agg_ladder: bad level buffers");
+    }
+    lw[k] = wbuf->typed_data();
+    caps[k] = static_cast<int64_t>(wbuf->element_count());
+  }
+  auto flagb = args.get<ffi::Buffer<ffi::DataType::S64>>(
+      args.size() - 2);
+  if (!flagb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: bad flag buffer");
+  }
+  const bool ladder_on = flagb->typed_data()[0] != 0;
+
+  // results
+  std::vector<int64_t*> qk(nk), old_out(nov), lad_out(nov), d_out(nov);
+  for (int64_t c = 0; c < nk; ++c) {
+    auto r = rets.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!r.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_agg_ladder: bad qkey result");
+    }
+    qk[c] = r.value()->typed_data();
+  }
+  auto qliveb = rets.get<ffi::Buffer<ffi::DataType::PRED>>(nk);
+  auto nqb = rets.get<ffi::Buffer<ffi::DataType::S64>>(nk + 1);
+  auto old_pb = rets.get<ffi::Buffer<ffi::DataType::PRED>>(nk + 2 + nov);
+  auto lad_pb = rets.get<ffi::Buffer<ffi::DataType::PRED>>(nk + 3 + 2 * nov);
+  auto d_pb = rets.get<ffi::Buffer<ffi::DataType::PRED>>(nk + 4 + 3 * nov);
+  auto gtotb = rets.get<ffi::Buffer<ffi::DataType::S64>>(nk + 5 + 3 * nov);
+  if (!qliveb.has_value() || !nqb.has_value() || !old_pb.has_value() ||
+      !lad_pb.has_value() || !d_pb.has_value() || !gtotb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_agg_ladder: bad scalar/mask results");
+  }
+  for (int64_t c = 0; c < nov; ++c) {
+    auto ro = rets.get<ffi::Buffer<ffi::DataType::S64>>(nk + 2 + c);
+    auto rl = rets.get<ffi::Buffer<ffi::DataType::S64>>(nk + 3 + nov + c);
+    auto rd = rets.get<ffi::Buffer<ffi::DataType::S64>>(
+        nk + 4 + 2 * nov + c);
+    if (!ro.has_value() || !rl.has_value() || !rd.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_agg_ladder: bad value results");
+    }
+    old_out[c] = ro.value()->typed_data();
+    lad_out[c] = rl.value()->typed_data();
+    d_out[c] = rd.value()->typed_data();
+  }
+  bool* qlive = qliveb.value()->typed_data();
+  bool* old_p = old_pb.value()->typed_data();
+  bool* lad_p = lad_pb.value()->typed_data();
+  bool* d_p = d_pb.value()->typed_data();
+  const int64_t q_cap =
+      static_cast<int64_t>(qliveb.value()->element_count());
+
+  // -- phase 1: run-boundary scan over the consolidated delta ------------
+  // unique live keys (their delta row index), packed; in the same scan the
+  // fast path folds the delta's own reduction per group (the stitched
+  // cumsum(first & live) segment ids, sequentially).
+  std::vector<int64_t> urow;  // delta row of each unique key, in order
+  urow.reserve(static_cast<size_t>(q_cap));
+  SegAccum d_acc(nov, q_cap, ops);
+  std::memset(d_p, 0, static_cast<size_t>(q_cap));
+  int64_t nq_total = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    if (dw[i] == 0) continue;
+    bool first = i == 0;
+    if (!first) {
+      for (int64_t c = 0; c < nk; ++c) {
+        if (dkeys[c][i] != dkeys[c][i - 1]) { first = true; break; }
+      }
+    }
+    if (first) {
+      if (nq_total < q_cap) urow.push_back(i);
+      ++nq_total;
+    }
+    if (fast) {
+      const int64_t g = nq_total - 1;
+      d_acc.add(g, dw[i], [&](int64_t c) { return dvals[c][i]; });
+      if (dw[i] > 0 && g >= 0 && g < q_cap) d_p[g] = true;
+    }
+  }
+  const int64_t nq = static_cast<int64_t>(urow.size());  // clamped
+  nqb.value()->typed_data()[0] = nq_total;               // unclamped
+  for (int64_t j = 0; j < q_cap; ++j) {
+    qlive[j] = j < nq;
+    for (int64_t c = 0; c < nk; ++c) {
+      qk[c][j] = j < nq ? dkeys[c][urow[j]] : key_sent[c];
+    }
+  }
+  for (int64_t c = 0; c < nov; ++c) d_acc.finish(c, d_out[c]);
+
+  // -- phase 2: previous outputs from the out trace (TupleMax probe) -----
+  for (int64_t c = 0; c < nov; ++c) {
+    for (int64_t j = 0; j < q_cap; ++j) old_out[c][j] = old_ident[c];
+  }
+  std::memset(old_p, 0, static_cast<size_t>(q_cap));
+  {
+    int64_t raw = 0;  // stitched path materializes at most q_cap rows
+    for (int64_t j = 0; j < nq && raw < q_cap; ++j) {
+      const int64_t i = urow[j];
+      const int64_t a = lex_bound(nk, tkeys.data(), ocap, dkeys.data(), i,
+                                  /*right=*/false);
+      const int64_t b = equal_run_end(nk, tkeys.data(), ocap,
+                                      dkeys.data(), i, a);
+      for (int64_t r = a; r < b && raw < q_cap; ++r, ++raw) {
+        const int64_t w = tw[r];
+        if (w <= 0) continue;
+        old_p[j] = true;
+        for (int64_t c = 0; c < nov; ++c) {
+          const int64_t v = tvals[c][r];
+          if (v > old_out[c][j]) old_out[c][j] = v;
+        }
+      }
+    }
+  }
+
+  // -- phase 3: ladder gather + cross-level netting + reduction ----------
+  SegAccum lad_acc(nov, q_cap, ops);
+  std::memset(lad_p, 0, static_cast<size_t>(q_cap));
+  int64_t gtotal = 0;
+  if (ladder_on) {
+    // probe every (level, query) range; clamp materialized rows at
+    // gather_cap in the stitched LEVEL-major order so overflow launches
+    // stay bit-identical to the XLA buffers the runner discards
+    std::vector<int64_t> lo_kj(static_cast<size_t>(K * nq));
+    std::vector<int64_t> take(static_cast<size_t>(K * nq));
+    int64_t raw = 0;
+    for (int64_t k = 0; k < K; ++k) {
+      const int64_t* const* tk = &lkeys[k * nk];
+      for (int64_t j = 0; j < nq; ++j) {
+        const int64_t i = urow[j];
+        const int64_t a = lex_bound(nk, tk, caps[k], dkeys.data(), i,
+                                    /*right=*/false);
+        const int64_t b = equal_run_end(nk, tk, caps[k], dkeys.data(), i,
+                                        a);
+        const int64_t cnt = b > a ? b - a : 0;
+        lo_kj[k * nq + j] = a;
+        gtotal += cnt;
+        const int64_t room = gather_cap - raw;
+        const int64_t t = cnt < room ? cnt : (room > 0 ? room : 0);
+        take[k * nq + j] = t;
+        raw += t;
+      }
+    }
+    // per query: K-way merge of the levels' sorted ranges by val row,
+    // netting equal rows across levels, each netted row folded into the
+    // ops (and the presence mask) — the gathered history never
+    // materializes
+    std::vector<int64_t> cur(K), end(K);
+    for (int64_t j = 0; j < nq; ++j) {
+      for (int64_t k = 0; k < K; ++k) {
+        cur[k] = lo_kj[k * nq + j];
+        end[k] = cur[k] + take[k * nq + j];
+      }
+      if (nlv == 0) {
+        // zero val columns: every row of the group is the SAME row — the
+        // stitched consolidate nets the whole range set into one row
+        int64_t w = 0;
+        bool any = false;
+        for (int64_t k = 0; k < K; ++k) {
+          for (int64_t r = cur[k]; r < end[k]; ++r) { w += lw[k][r]; }
+          any = any || end[k] > cur[k];
+        }
+        if (any) {
+          if (w > 0) lad_p[j] = true;
+          lad_acc.add(j, w, [&](int64_t) { return int64_t{0}; });
+        }
+        continue;
+      }
+      for (;;) {
+        int64_t kmin = -1;
+        for (int64_t k = 0; k < K; ++k) {
+          if (cur[k] >= end[k]) continue;
+          if (kmin < 0) { kmin = k; continue; }
+          int cmp = 0;
+          for (int64_t c = 0; c < nlv; ++c) {
+            const int64_t av = lvals[k * nlv + c][cur[k]];
+            const int64_t bv = lvals[kmin * nlv + c][cur[kmin]];
+            if (av != bv) { cmp = av < bv ? -1 : 1; break; }
+          }
+          if (cmp < 0) kmin = k;
+        }
+        if (kmin < 0) break;
+        // net this val row across every level positioned on an equal row
+        int64_t w = 0;
+        for (int64_t k = 0; k < K; ++k) {
+          if (cur[k] >= end[k]) continue;
+          bool eq = true;
+          for (int64_t c = 0; eq && c < nlv; ++c) {
+            eq = lvals[k * nlv + c][cur[k]] ==
+                 lvals[kmin * nlv + c][cur[kmin]];
+          }
+          if (eq) { w += lw[k][cur[k]]; }
+        }
+        const int64_t src_k = kmin, src_r = cur[kmin];
+        for (int64_t k = 0; k < K; ++k) {
+          if (cur[k] >= end[k]) continue;
+          bool eq = true;
+          for (int64_t c = 0; eq && c < nlv; ++c) {
+            eq = lvals[k * nlv + c][cur[k]] ==
+                 lvals[src_k * nlv + c][src_r];
+          }
+          if (eq) ++cur[k];
+        }
+        if (w > 0) lad_p[j] = true;
+        lad_acc.add(j, w,
+                    [&](int64_t c) { return lvals[src_k * nlv + c][src_r]; });
+      }
+    }
+  }
+  for (int64_t c = 0; c < nov; ++c) lad_acc.finish(c, lad_out[c]);
+  gtotb.value()->typed_data()[0] = gtotal;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetAggLadderFfi, ZsetAggLadderImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
+// ---------------------------------------------------------------------------
+// Sorted-emit join megakernel: the fused join whose output needs NO sort
+// ---------------------------------------------------------------------------
+//
+// When the join's pair function is a pure column PERMUTATION (the probed
+// keys / delta vals / level vals reordered and projected — every Nexmark
+// join qualifies; detected by probing the fn with column markers,
+// operators/join.py::fn_permutation), the megakernel can apply it in-call
+// and emit the side's buffer CONSOLIDATED: projected rows sorted
+// lexicographically, equal rows netted (projection can merge distinct raw
+// rows), zero nets dropped, survivors packed, sentinel dead tail. Each join
+// side then comes back as ONE sorted run, so the post-join
+// concat().consolidate() dispatches the rank-merge fold regime (2 runs, one
+// linear native merge) instead of the full argsort over the doubled buffer
+// — the q4 post-join sort dies, and the pair-fn pass + dead-slot masking
+// XLA glue disappears with it.
+//
+// The returned total is the UNCLAMPED raw expansion count (the capacity
+// requirement — netting never shrinks it, so the runner's grow/replay
+// contract is unchanged). On overflow the scratch keeps the first `cap` raw
+// rows in the stitched level-major order, exactly like the unsorted
+// megakernel's clamp.
+//
+// Argument layout: [delta nk keys + ndv vals + weights, K levels (nk keys +
+// nlv vals + weights), sentinels S64[n_out], meta S64[5 + n_out] =
+// (K, nk, ndv, nlv, n_out, then per output the RAW column index: 0..nk-1 =
+// delta key, nk..nk+ndv-1 = delta val, nk+ndv.. = level val)]; results:
+// [out_0..out_{n_out-1} S64[cap], weights S64[cap], total S64[1]].
+
+static ffi::Error ZsetJoinLadderSortedImpl(ffi::RemainingArgs args,
+                                           ffi::RemainingRets rets) {
+  if (args.size() < 3 || rets.size() < 3) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_sorted: argument/result count mismatch");
+  }
+  auto meta = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 1);
+  if (!meta.has_value() || meta->element_count() < 5) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_sorted: bad meta buffer");
+  }
+  const int64_t* mv = meta->typed_data();
+  const int64_t K = mv[0], nk = mv[1], ndv = mv[2], nlv = mv[3],
+                n_out = mv[4];
+  const int64_t* perm = mv + 5;
+  if (K < 1 || nk < 1 || ndv < 0 || nlv < 0 || n_out < 1 ||
+      static_cast<int64_t>(meta->element_count()) != 5 + n_out ||
+      args.size() != static_cast<size_t>(
+          nk + ndv + 1 + K * (nk + nlv + 1) + 2) ||
+      rets.size() != static_cast<size_t>(n_out + 2)) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_sorted: argument count mismatch");
+  }
+  std::vector<const int64_t*> dcols(nk + ndv);
+  for (int64_t c = 0; c < nk + ndv; ++c) {
+    auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!a.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_join_sorted: S64 delta col expected");
+    }
+    dcols[c] = a->typed_data();
+  }
+  auto dwb = args.get<ffi::Buffer<ffi::DataType::S64>>(nk + ndv);
+  auto sentb = args.get<ffi::Buffer<ffi::DataType::S64>>(args.size() - 2);
+  if (!dwb.has_value() || !sentb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_sorted: bad weights/sentinel buffer");
+  }
+  const int64_t* dw = dwb->typed_data();
+  const int64_t* sent = sentb->typed_data();
+  const int64_t m = static_cast<int64_t>(dwb->element_count());
+  std::vector<const int64_t*> tkeys(K * nk), tvals(K * nlv), tw(K);
+  std::vector<int64_t> caps(K);
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t base = nk + ndv + 1 + k * (nk + nlv + 1);
+    for (int64_t c = 0; c < nk + nlv + 1; ++c) {
+      auto a = args.get<ffi::Buffer<ffi::DataType::S64>>(base + c);
+      if (!a.has_value()) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "zset_join_sorted: S64 level col expected");
+      }
+      if (c < nk) tkeys[k * nk + c] = a->typed_data();
+      else if (c < nk + nlv) tvals[k * nlv + (c - nk)] = a->typed_data();
+      else tw[k] = a->typed_data();
+      caps[k] = static_cast<int64_t>(a->element_count());
+    }
+  }
+  std::vector<int64_t*> ocols(n_out);
+  int64_t cap = 0;
+  for (int64_t c = 0; c < n_out; ++c) {
+    auto o = rets.get<ffi::Buffer<ffi::DataType::S64>>(c);
+    if (!o.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "zset_join_sorted: S64 result expected");
+    }
+    ocols[c] = o.value()->typed_data();
+    cap = static_cast<int64_t>(o.value()->element_count());
+  }
+  auto owb = rets.get<ffi::Buffer<ffi::DataType::S64>>(n_out);
+  auto totalb = rets.get<ffi::Buffer<ffi::DataType::S64>>(n_out + 1);
+  if (!owb.has_value() || !totalb.has_value()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "zset_join_sorted: bad w/total result");
+  }
+  int64_t* ow = owb.value()->typed_data();
+
+  // live-lane probe plan: dead delta rows (sentinel keys) match nothing
+  // and are skipped by the emission anyway — probing them would pay a
+  // full log(cap) search into the sentinel tail per (level, lane)
+  std::vector<int32_t> liveq;
+  liveq.reserve(static_cast<size_t>(m));
+  for (int64_t i = 0; i < m; ++i) {
+    if (dw[i] != 0) liveq.push_back(static_cast<int32_t>(i));
+  }
+  const int64_t ml = static_cast<int64_t>(liveq.size());
+  // ONE binary search per (level, live lane) for lo; hi = lo + the length
+  // of the equal-key run, found by GALLOPING forward from lo (equality
+  // matches are 0-or-few rows — one or two cache-hot compares — where a
+  // second full binary search pays log(cap) cold probes; identical
+  // result: first index whose row differs)
+  std::vector<int32_t> lo(static_cast<size_t>(K * m), 0);
+  std::vector<int32_t> hi(static_cast<size_t>(K * m), 0);
+  {
+    const int64_t T = probe_threads(K * ml);
+    const int64_t chunk = T > 0 ? (ml + T - 1) / T : ml;
+    parallel_for_threads(T, [&](int64_t t) {
+      const int64_t i0 = t * chunk;
+      const int64_t i1 = i0 + chunk < ml ? i0 + chunk : ml;
+      std::vector<int64_t> lo_blk(static_cast<size_t>(
+          i1 > i0 ? i1 - i0 : 0));
+      for (int64_t k = 0; k < K; ++k) {
+        const int64_t* const* tk = &tkeys[k * nk];
+        const int64_t n = caps[k];
+        // breadth-first lower bounds over the live lanes (independent
+        // table loads per pass — overlapped misses), then one cache-hot
+        // gallop per lane for the equal-run end
+        probe_lo_bfs_idx(nk, tk, n, dcols.data(), liveq.data(), i0, i1,
+                         lo_blk.data());
+        for (int64_t x = i0; x < i1; ++x) {
+          const int64_t i = liveq[x];
+          const int64_t a = lo_blk[x - i0];
+          lo[k * m + i] = static_cast<int32_t>(a);
+          hi[k * m + i] = static_cast<int32_t>(
+              equal_run_end(nk, tk, n, dcols.data(), i, a));
+        }
+      }
+    });
+  }
+
+  // phase 1: project raw matches into the persistent scratch (level-major,
+  // clamped at cap — the stitched materialization order). Sequential: the
+  // emitted volume is delta-scale, and a threaded variant (offsets
+  // precomputed, disjoint output ranges per thread) measured SLOWER at
+  // the q4 shape — the spawn cost plus the per-thread worklist scan
+  // exceed the ~0.5 ms of writes being split.
+  static thread_local std::vector<int64_t> pool;
+  const size_t need = static_cast<size_t>((n_out + 1) * cap);
+  if (pool.size() < need) pool.resize(need);
+  std::vector<int64_t*> scr(n_out);
+  for (int64_t c = 0; c < n_out; ++c) scr[c] = pool.data() + c * cap;
+  int64_t* sw = pool.data() + n_out * cap;
+  int64_t o = 0, tot = 0;
+  for (int64_t k = 0; k < K; ++k) {
+    const int64_t* const* lv = nlv ? &tvals[k * nlv] : nullptr;
+    const int64_t* lwk = tw[k];
+    for (int64_t i = 0; i < m; ++i) {
+      if (dw[i] == 0) continue;
+      const int64_t a = lo[k * m + i], b = hi[k * m + i];
+      const int64_t cnt = b > a ? b - a : 0;
+      for (int64_t t = 0; t < cnt && o < cap; ++t, ++o) {
+        const int64_t s = a + t;
+        for (int64_t c = 0; c < n_out; ++c) {
+          const int64_t p = perm[c];
+          scr[c][o] = p < nk + ndv ? dcols[p][i] : lv[p - nk - ndv][s];
+        }
+        sw[o] = dw[i] * lwk[s];
+      }
+      tot += cnt;
+    }
+  }
+  totalb.value()->typed_data()[0] = tot;
+
+  // phase 2: consolidate the scratch in-call ((first-col, idx) pair sort +
+  // net + pack — the same scheme as ZsetConsolidateImpl), so the emitted
+  // side is ONE sorted run
+  std::vector<std::pair<int64_t, int64_t>> keyed;
+  keyed.reserve(static_cast<size_t>(o));
+  for (int64_t i = 0; i < o; ++i) {
+    if (sw[i] != 0) keyed.emplace_back(scr[0][i], i);
+  }
+  std::sort(keyed.begin(), keyed.end(),
+            [&](const std::pair<int64_t, int64_t>& x,
+                const std::pair<int64_t, int64_t>& y) {
+              if (x.first != y.first) return x.first < y.first;
+              for (int64_t c = 1; c < n_out; ++c) {
+                const int64_t xv = scr[c][x.second], yv = scr[c][y.second];
+                if (xv != yv) return xv < yv;
+              }
+              return false;
+            });
+  int64_t out_n = 0;
+  const int64_t live = static_cast<int64_t>(keyed.size());
+  for (int64_t s = 0; s < live;) {
+    int64_t e = s + 1;
+    while (e < live) {
+      bool eq = keyed[e].first == keyed[s].first;
+      for (int64_t c = 1; eq && c < n_out; ++c) {
+        eq = scr[c][keyed[s].second] == scr[c][keyed[e].second];
+      }
+      if (!eq) break;
+      ++e;
+    }
+    int64_t sum = 0;
+    for (int64_t j = s; j < e; ++j) sum += sw[keyed[j].second];
+    if (sum != 0) {
+      for (int64_t c = 0; c < n_out; ++c) {
+        ocols[c][out_n] = scr[c][keyed[s].second];
+      }
+      ow[out_n++] = sum;
+    }
+    s = e;
+  }
+  for (int64_t c = 0; c < n_out; ++c) {
+    int64_t* col = ocols[c];
+    for (int64_t j = out_n; j < cap; ++j) col[j] = sent[c];
+  }
+  for (int64_t j = out_n; j < cap; ++j) ow[j] = 0;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ZsetJoinLadderSortedFfi,
+                              ZsetJoinLadderSortedImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets());
+
 // Fused old-weight lookup (distinct's consumer): the accumulated weight of
 // each delta ROW (keys + vals) across every trace level — per query row,
 // one binary search per level, summing the weight when the row is present.

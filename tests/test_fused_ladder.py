@@ -34,7 +34,14 @@ from dbsp_tpu.zset.batch import Batch
 
 pytestmark = pytest.mark.fast
 
-FUSED_OFF = "join_ladder,gather_ladder,old_weights"
+# the full stitched control: PR-12's fused ladder consumers AND the
+# reduction-offensive layer on top of them (sorted-emit join, aggregate
+# megakernel, opcode segment reduce) all forced off
+FUSED_OFF = ("join_ladder,gather_ladder,old_weights,"
+             "join_sorted,agg_ladder,segment_reduce")
+# the reduction offensive alone forced off — the PR-12 code path, the A/B
+# control BENCH_local_aggfuse_off.json uses
+REDUCE_OFF = "join_sorted,agg_ladder,segment_reduce"
 
 
 def _consolidated(rng, n_live, cap, nk=2, nv=1, key_range=40,
@@ -337,9 +344,11 @@ def test_sharded_host_fused_vs_stitched(monkeypatch):
 
 def test_compiled_q4_dispatches_fused_ladder_kernels(monkeypatch):
     """Non-vacuous hot path (the lint kernel front's tier-1 twin): the
-    compiled q4 loop must actually SELECT the fused megakernels, and the
-    force-off control must drop them to zero with the stitched fallback
-    engaged."""
+    compiled q4 loop must actually SELECT the fused megakernels at every
+    layer of the force-off ladder — the reduction offensive on top
+    (sorted-emit join + aggregate megakernel), the PR-12 fused consumers
+    when those are forced off, and the stitched XLA chain at full
+    force-off — so every A/B control bench.py leans on is proven live."""
     from dbsp_tpu.zset import kernels as zk
 
     monkeypatch.setenv("DBSP_TPU_NATIVE", "1")
@@ -350,9 +359,22 @@ def test_compiled_q4_dispatches_fused_ladder_kernels(monkeypatch):
         return zk.KERNEL_DISPATCH_COUNTS.get((kern, backend), 0) - \
             before.get((kern, backend), 0)
 
+    # the reduction offensive owns the q4 hot loop: the join emits sorted
+    # (join_sorted supersedes join_ladder) and CAggregate is ONE megakernel
+    assert delta_of("join_sorted", "native") > 0
+    assert delta_of("agg_ladder", "native") > 0
+
+    # one layer down: the PR-12 fused consumers re-engage
+    monkeypatch.setenv("DBSP_TPU_NATIVE", REDUCE_OFF)
+    before = dict(zk.KERNEL_DISPATCH_COUNTS)
+    _run_compiled("q4", ticks=2)
+    assert delta_of("join_sorted", "native") == 0
+    assert delta_of("agg_ladder", "native") == 0
     assert delta_of("join_ladder", "native") > 0
     assert delta_of("gather_ladder", "native") > 0
+    assert delta_of("agg_ladder", "xla") > 0  # the stitched chain is live
 
+    # full force-off: the stitched XLA fallbacks carry everything
     monkeypatch.setenv("DBSP_TPU_NATIVE", FUSED_OFF)
     before = dict(zk.KERNEL_DISPATCH_COUNTS)
     _run_compiled("q4", ticks=2)

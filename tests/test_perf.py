@@ -122,7 +122,8 @@ KERNEL_BAND = float(os.environ.get("PERF_KERNEL_BAND", 2 * PERF_BAND))
 EXPECTED_KERNELS = {
     "consolidate", "rank_fold", "lex_probe", "lex_probe_ladder",
     "merge_sorted_cols", "expand_ranges", "compact", "gather_ladder",
-    "join_ladder", "flight_record",
+    "join_ladder", "join_sorted", "segment_reduce", "agg_ladder",
+    "flight_record",
 }
 
 

@@ -14,12 +14,13 @@ Exit codes: 0 = artifact written, 3 = tunnel still wedged (probe killed).
 
 Kernel selection note: the trace this script compiles runs the SAME
 backend dispatch as live serving — on a TPU backend the FUSED ladder
-consumers (join_ladder / gather_ladder), the ladder probe and the
-rank-merge inner loop select the Pallas programs — the grid-over-levels
-megakernels included (zset/pallas_kernels.py; force off with
-DBSP_TPU_PALLAS=0 to A/B the plain-XLA lowering), so the first
-successful tunnel run measures the hand-written kernels against XLA's
-fusion guesses with no extra wiring.
+consumers (join_ladder / gather_ladder), the aggregate reduction layer
+(the composed agg_ladder lowering: the grid-over-levels gather megakernel
+plus the segment-block segment_reduce program), the ladder probe and the
+rank-merge inner loop select the Pallas programs
+(zset/pallas_kernels.py; force off with DBSP_TPU_PALLAS=0 to A/B the
+plain-XLA lowering), so the first successful tunnel run measures the
+hand-written kernels against XLA's fusion guesses with no extra wiring.
 """
 
 from __future__ import annotations

@@ -59,7 +59,8 @@ LIBRARIES = (
                  "ZsetExpandFfi", "ZsetGatherFfi", "ZsetCompactFfi",
                  "ZsetProbeLadderFfi", "ZsetRankFoldFfi",
                  "ZsetJoinLadderFfi", "ZsetGatherLadderFfi",
-                 "ZsetOldWeightsFfi"]},
+                 "ZsetOldWeightsFfi", "ZsetSegmentReduceFfi",
+                 "ZsetAggLadderFfi", "ZsetJoinLadderSortedFfi"]},
     {"name": "nexmark_gen",
      "src": os.path.join("native", "nexmark_gen.cpp"),
      "so": os.path.join("native", "libnexmark_gen.so"),

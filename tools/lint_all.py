@@ -39,14 +39,16 @@ the tier-1 test in tests/test_analysis.py):
    fronts only; tests/test_multichip.py carries the runtime coverage.
 4b. **Kernel front** (CLI only; DBSP_TPU_LINT_KERNELS=0 skips) — a mini
    compiled q4 run in a subprocess must actually DISPATCH the fused
-   ladder megakernels (``kernel_paths`` shows ``join_ladder:native`` and
-   ``gather_ladder:native`` with count > 0 — the fusion cannot silently
-   fall back to the stitched chain), and a second run under the
-   ``DBSP_TPU_NATIVE`` force-off must show ZERO fused-native dispatches
-   with the stitched XLA fallback engaged — so the A/B control knob
-   bench.py leans on is proven live, not vacuous. The import-based
-   tier-1 consumer is tests/test_cursor.py::
-   test_compiled_q4_dispatches_fused_ladder_kernels.
+   megakernels at every layer of the force-off ladder: the reduction
+   offensive on top (``join_sorted:native`` + ``agg_ladder:native``
+   counted > 0 — the sorted-emit join and the whole-CAggregate megakernel
+   cannot silently fall back), the PR-12 fused consumers when those are
+   forced off (``join_ladder:native`` + ``gather_ladder:native`` re-engage
+   with the aggregate's stitched chain live), and zero fused-native
+   dispatches with the stitched XLA fallback engaged at full force-off —
+   so every A/B control knob bench.py leans on is proven live, not
+   vacuous. The import-based tier-1 consumer is tests/test_fused_ladder
+   .py::test_compiled_q4_dispatches_fused_ladder_kernels.
 5. **Profiler dryrun** (CLI only; DBSP_TPU_LINT_PROFILE=0 skips) —
    ``opprofile.dryrun("q4")`` in a subprocess: one measured segmented
    profile end to end, red on schema drift, segmented/fused divergence,
@@ -423,13 +425,35 @@ def run_kernel_dryrun() -> list:
     paths, err = child({"DBSP_TPU_NATIVE": "1"})
     if err:
         return [err]
-    for kern in ("join_ladder", "gather_ladder"):
+    for kern in ("join_sorted", "agg_ladder"):
         if not paths.get(f"{kern}:native"):
             violations.append(
                 f"q4 dryrun never dispatched the fused {kern} megakernel "
-                f"(kernel_paths: {json.dumps(paths)}) — the trace-tax "
-                "fusion silently fell back to the stitched chain")
-    off = "join_ladder,gather_ladder,old_weights"
+                f"(kernel_paths: {json.dumps(paths)}) — the reduction "
+                "offensive silently fell back to the stitched chain")
+    # one layer down: the reduction offensive off, the PR-12 fused
+    # consumers must carry the hot loop with the stitched aggregate live
+    reduce_off = "join_sorted,agg_ladder,segment_reduce"
+    paths_mid, err = child({"DBSP_TPU_NATIVE": reduce_off})
+    if err:
+        return violations + [err]
+    for kern in ("join_sorted", "agg_ladder"):
+        if paths_mid.get(f"{kern}:native"):
+            violations.append(
+                f"DBSP_TPU_NATIVE={reduce_off} still dispatched "
+                f"{kern}:native ({json.dumps(paths_mid)}) — the A/B "
+                "control BENCH_local_aggfuse_off.json rests on is vacuous")
+    for kern in ("join_ladder", "gather_ladder"):
+        if not paths_mid.get(f"{kern}:native"):
+            violations.append(
+                f"reduction-off run never re-engaged {kern}:native "
+                f"({json.dumps(paths_mid)}) — the PR-12 layer rotted")
+    if not paths_mid.get("agg_ladder:xla"):
+        violations.append(
+            f"reduction-off run never took the stitched aggregate chain "
+            f"({json.dumps(paths_mid)})")
+    off = ("join_ladder,gather_ladder,old_weights,"
+           "join_sorted,agg_ladder,segment_reduce")
     paths_off, err = child({"DBSP_TPU_NATIVE": off})
     if err:
         return violations + [err]

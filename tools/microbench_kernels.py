@@ -28,7 +28,21 @@ Kernels & shapes (ROOFLINE §1):
                        pair apply) of a 16k-row delta against the same
                        4-level ladder shape into 65536 slots — the
                        CJoin/JoinOp hot path end to end, megakernel
-                       dispatch included.
+                       dispatch included;
+  * join_sorted      — the SAME join through the sorted-emit megakernel
+                       (permutation pair fn applied in-call, side emitted
+                       as one consolidated run) PLUS the 2-run rank-fold
+                       consolidate of the concat — the whole post-join
+                       path the reduction offensive replaced, vs
+                       join_ladder + full-sort consolidate on the control;
+  * segment_reduce   — the Aggregator zoo's five-op segment reduction
+                       (count/sum/min/max/avg + present) of 16k gathered
+                       rows into 4096 groups, ONE dispatch per spec;
+  * agg_ladder       — the whole CAggregate reduce chain (unique keys +
+                       out-trace TupleMax probe + ladder gather + netting
+                       + reduction) for a 4096-group delta over the
+                       4-level gather ladder — the q4-max hot path end to
+                       end, megakernel dispatch included.
 
 Every entry dispatches through the engine's own backend switch, so the
 measured path follows DBSP_TPU_NATIVE / DBSP_TPU_PALLAS — A/B a single
@@ -204,6 +218,66 @@ def run(reps: int = 5) -> dict:
                  "65536 slots",
         "ms": _time(lambda d: cursor.join_ladder(
             d, tuple(jlevels), 2, jfn, 65_536)[0], jdelta, reps=reps)}
+
+    # 8c) sorted-emit join + the 2-run rank-fold consolidate it enables —
+    #     the whole post-join path (the control pays join_ladder + a full
+    #     argsort consolidate of the doubled buffer instead)
+    from dbsp_tpu.operators.join import fn_permutation
+
+    jperm = fn_permutation(jfn, 2, 1, 2)
+    jse = (jperm[0], jperm[1],
+           tuple(jnp.dtype(jnp.int64) for _ in range(5)))
+
+    def _join_post(d):
+        lout, _ = cursor.join_ladder(d, tuple(jlevels), 2, jfn, 65_536,
+                                     sorted_emit=jse)
+        rout, _ = cursor.join_ladder(d, tuple(jlevels[:2]), 2, jfn, 32_768,
+                                     sorted_emit=jse)
+        out = concat_batches([lout, rout]).consolidate()
+        return (*out.cols, out.weights)
+
+    out["join_sorted"] = {
+        "shape": f"{jq}-row delta x 4 levels -> 2 sorted sides + rank-fold "
+                 "consolidate",
+        "ms": _time(_join_post, jdelta, reps=reps)}
+
+    # 8d) the shared five-op segment reduction at the aggregate's gather
+    #     shape: 16k netted rows -> 4096 groups, one dispatch for the spec
+    from dbsp_tpu.operators.aggregate import segment_reduce
+
+    sr_n, sr_g = 16_384, 4_096
+    rngs = np.random.default_rng(80)
+    sv = (jnp.asarray(rngs.integers(0, 1 << 30, sr_n)),
+          jnp.asarray(rngs.integers(0, 1000, sr_n)))
+    sw = jnp.asarray(rngs.integers(-2, 3, sr_n).astype(np.int64))
+    sseg = jnp.asarray(np.sort(rngs.integers(0, sr_g, sr_n))
+                       .astype(np.int32))
+    sspec = (("max", 0), ("count", 0), ("sum", 1), ("present", 0))
+    out["segment_reduce"] = {
+        "shape": f"{sr_n} rows -> {sr_g} groups x 4 ops",
+        "ms": _time(lambda v, w, s: segment_reduce(sspec, v, w, s,
+                                                   sr_g + 1),
+                    sv, sw, sseg, reps=reps)}
+
+    # 8e) the whole CAggregate chain as ONE call: 4096-group delta over the
+    #     gather ladder + a 4096-row out trace (q4-max shape, fast path
+    #     with the ladder gate ON — the worst case, i.e. full re-gather)
+    from dbsp_tpu.operators.aggregate import Max
+
+    adelta_cols = _cols(gq, 2, seed=90)
+    akeys = tuple(c[:gq] for c in adelta_cols)
+    avals = tuple(c[:gq] for c in _cols(gq, 1, sort_first=False, seed=91))
+    adelta = Batch(akeys, avals, jnp.ones((gq,), jnp.int64), runs=(gq,))
+    ot_cols = _cols(gq, 2, seed=92)
+    ot_vals = _cols(gq, 1, sort_first=False, seed=93)
+    aot = Batch(ot_cols, (ot_vals[0],), jnp.ones((gq,), jnp.int64),
+                runs=(gq,))
+    out["agg_ladder"] = {
+        "shape": f"{gq} groups x 4 levels (262144..4096 rows) + {gq}-row "
+                 "out trace, Max fast path, gate on",
+        "ms": _time(lambda d: cursor.agg_ladder(
+            d, 2, aot, tuple(glevels), Max(0), gq, 16_384, True,
+            jnp.asarray(True))[5], adelta, reps=reps)}
 
     # 9) flight-recorder steady-state overhead: one tick event recorded
     #    into the bounded ring (dbsp_tpu/obs/flight.py) — pure host work,

@@ -75,6 +75,8 @@ REF_GAP = REF_KERNEL_MS / REF_PRED_MS  # the "4.7x" ROADMAP item 5 names
 # must not multiply its own ratio in twice).
 RECORDED_REFITS = (
     ("PR-7 native/Pallas kernel set", "BENCH_local_native_kernels", 0.87),
+    ("PR-12 fused ladder megakernels + lazy post view",
+     "BENCH_local_megakernels", 0.70),
 )
 
 
@@ -91,12 +93,13 @@ def refit_base_for(source_off: str):
         applied.append((label, prefix, ratio))
     return gap, applied
 
-# the current round's committed A/B pair (fused ladder megakernels + lazy
-# trace post view vs the stitched + materialized control on the same
-# host) — the default --bench / --bench-off targets so a plain regenerate
-# reproduces the committed calibration
-DEFAULT_BENCH = "BENCH_local_megakernels.json"
-DEFAULT_BENCH_OFF = "BENCH_local_megakernels_off.json"
+# the current round's committed A/B pair (the reduction offensive: fused
+# CAggregate megakernel + opcode segment reduce + sorted-emit join vs the
+# PR-12 code path — DBSP_TPU_NATIVE=segment_reduce,agg_ladder,join_sorted
+# — on the same host) — the default --bench / --bench-off targets so a
+# plain regenerate reproduces the committed calibration
+DEFAULT_BENCH = "BENCH_local_aggfuse.json"
+DEFAULT_BENCH_OFF = "BENCH_local_aggfuse_off.json"
 
 
 def _host_bandwidth_gbs() -> float:
@@ -391,11 +394,28 @@ def per_node_section(report: dict) -> list:
     w("")
     ctrace_ms = sum(r.get("total_ms", 0.0) for r in ops
                     if r.get("kind") == "CTrace")
-    w("**Combined CTrace share: {:.0%}** (the two hot q4 trace nodes were "
-      "59% of the attributed tick before the fused ladder megakernels + "
-      "lazy post view — the trace-tax collapse ROADMAP item 1 asked "
-      "for; the cost now lives in the consumers' own reductions, where "
-      "the roofline says it belongs).\n".format(ctrace_ms / total_ms))
+    agg_ms = sum(r.get("total_ms", 0.0) for r in ops
+                 if r.get("kind") == "CAggregate")
+    join_ms = sum(r.get("total_ms", 0.0) for r in ops
+                  if r.get("kind") == "CJoin")
+    w("**Combined CTrace share: {:.0%}; CAggregate {:.0%} ({:.1f} "
+      "ms/tick); CJoin {:.0%} ({:.1f} ms/tick).** History: the trace "
+      "nodes were 59% of the attributed tick before PR-12's fused ladder "
+      "megakernels + lazy post view; CAggregate was 29% and CJoin 20% "
+      "before the reduction offensive (the agg_ladder megakernel took "
+      "the whole CAggregate chain to one call; the sorted-emit join "
+      "killed the pair-fn/mask glue and nets in-call, and where a "
+      "post-join consolidate materializes it now rank-folds — in the "
+      "fused q4 program it is DEFERRED entirely and the downstream map's "
+      "consolidate reads netted, sorted input). SHARES renormalize "
+      "against the collapsed total, so read them with the same-host "
+      "absolute ms: the reduction round's recorded control profile (same "
+      "host, `DBSP_TPU_NATIVE=segment_reduce,agg_ladder,join_sorted`) "
+      "measured CAggregate 8.3 ms/tick (39%) and CJoin 3.6 ms/tick "
+      "(17%) — the per-node A/B factors at that recording were x0.08 "
+      "and x0.63.\n".format(
+          ctrace_ms / total_ms, agg_ms / total_ms, agg_ms / ticks,
+          join_ms / total_ms, join_ms / ticks))
     top = ops[:3]
     w("**Top-3 glue costs (named):** " + "; ".join(
         "**{}** ({}, node {}) — {:.0%} of attributed tick time".format(
@@ -523,16 +543,17 @@ def main():
           host_gbs, host_gap, host_note))
     if ab_ratio is not None:
         w("**Kernel-side gap refit (same-host A/B):** the control run "
-          "({} — the fused ladder megakernels forced off via "
-          "`DBSP_TPU_NATIVE` plus `DBSP_TPU_TRACE_LAZY_POST=0`, i.e. the "
-          "pre-change code path on the SAME host) measures {:.1f} ms/tick "
-          "kernel-side; the fused consumers + lazy trace post view cut "
-          "that to {:.1f} ms/tick — a x{:.2f} kernel-side factor under "
-          "identical protocol, state and container. Chaining it onto the "
-          "recorded refit history re-fits the kernel-side gap to "
-          "**{:.1f}x**. (Raw cross-host ms are NOT comparable: container "
-          "core speed varies ~3x round to round at similar memory "
-          "bandwidth, which is exactly why every refit is A/B-based.)\n"
+          "({} — the reduction offensive forced off via "
+          "`DBSP_TPU_NATIVE=segment_reduce,agg_ladder,join_sorted`, i.e. "
+          "the previous round's code path on the SAME host) measures "
+          "{:.1f} ms/tick kernel-side; the fused CAggregate megakernel + "
+          "sorted-emit join cut that to {:.1f} ms/tick — a x{:.2f} "
+          "kernel-side factor under identical protocol, state and "
+          "container. Chaining it onto the recorded refit history re-fits "
+          "the kernel-side gap to **{:.1f}x**. (Raw cross-host ms are NOT "
+          "comparable: container core speed varies ~3x round to round at "
+          "similar memory bandwidth, which is exactly why every refit is "
+          "A/B-based.)\n"
           .format(meas_off["source"], meas_off["kernel_ms"], meas_cpu_ms,
                   ab_ratio, gap))
         w("Gap-refit history (each row scales the previous one):\n")
@@ -546,8 +567,8 @@ def main():
             running *= ratio
             w("| {} | {}[_off].json, same-host A/B | x{:.2f} | {:.1f}x |"
               .format(label, prefix, ratio, running))
-        w("| this round (fused ladder megakernels + lazy post view) | "
-          "{} vs {} | x{:.2f} | **{:.1f}x** |".format(
+        w("| this round (the reduction offensive: CAggregate megakernel "
+          "+ sorted-emit join) | {} vs {} | x{:.2f} | **{:.1f}x** |".format(
               meas["source"], meas_off["source"], ab_ratio, gap))
         w("")
     w("Applying the {:.1f}x gap to the v5e projection as a conservative "
@@ -585,9 +606,16 @@ def main():
       "`old_weights` in `kernel_paths`), the LAZY compiled trace post "
       "view (compiled/cnodes.py: consumers probe the appended delta as "
       "its own ladder level instead of re-reading the written slot — "
-      "`DBSP_TPU_TRACE_LAZY_POST=0` is the control), the sorted-run "
-      "consolidation regimes (zset/batch.py: skip / rank-merge fold / "
-      "native argsort / sort, counted in "
+      "`DBSP_TPU_TRACE_LAZY_POST=0` is the control), the REDUCTION "
+      "layer on top of them (cursor.agg_ladder: the whole CAggregate "
+      "chain — unique keys, out-trace probe, ladder gather, cross-level "
+      "netting and the aggregator's five-op segment reduction — is ONE "
+      "native call, `agg_ladder`/`segment_reduce` in `kernel_paths`; the "
+      "join's sorted-emit mode `join_sorted` applies permutation pair "
+      "fns in-call and emits each side as one consolidated run, so the "
+      "post-join consolidate rank-folds instead of sorting), the "
+      "sorted-run consolidation regimes (zset/batch.py: skip / "
+      "rank-merge fold / native argsort / sort, counted in "
       "`dbsp_tpu_zset_consolidate_total{path}`), and the full native "
       "CPU kernel set (merge/consolidate/probe/probe-ladder/expand/"
       "gather/compact/rank-fold — anchored breadth-first C++ searches, "
