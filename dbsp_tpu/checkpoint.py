@@ -143,6 +143,20 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "maintain_stats": "derived",
         "_snap_levels": "derived",
         "_ckpt_salt": "derived",  # hard-link scope marker, per process
+        # tiered trace residency (dbsp_tpu/residency.py): the tier map and
+        # disk blob metadata are persisted (payload "residency" /
+        # "cold_blobs") so restore can leave disk-demoted levels on disk;
+        # the LRU clock, transition observability, and the store handle
+        # rebuild from a fresh run
+        "residency_cfg": "config",
+        "_tiers": "persisted",
+        "_cold_meta": "persisted",
+        "_cold_store": "runtime",
+        "_lru": "derived",
+        "_interval": "derived",
+        "residency_stats": "derived",
+        "residency_log": "derived",
+        "cold_events": "derived",
     },
     "CompiledCircuitDriver": {
         "mode": "config",
@@ -166,6 +180,8 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "config": "config",
         "checkpoint_dir": "config",
         "checkpoint_every": "config",
+        "_residency_cfg": "config",  # resolved residency budgets,
+                                     # re-applied after a host restore
         "inputs": "config",       # endpoint counters persisted via
         "outputs": "config",      # _controller_state() (see _InputEndpoint)
         "state": "runtime",
@@ -272,6 +288,21 @@ class _Encoder:
         raise TypeError(f"unsupported checkpoint value type {type(v)}")
 
 
+class _NpDecoder:
+    """Variant decoder materializing HOST numpy copies — used for
+    residency-demoted (host-tier) trace levels at restore, which must not
+    round-trip through device memory just to come back off it. Built on
+    the same loader; only ``_arr`` differs."""
+
+    def __init__(self, load_array):
+        self.load = load_array
+
+    def _arr(self, name: str) -> np.ndarray:
+        return np.array(self.load(name))  # copy: the loader cache is shared
+
+    decode = None  # assigned below (shares _Decoder.decode)
+
+
 class _Decoder:
     """Decodes against a blob loader (verifying checksums lazily).
 
@@ -316,6 +347,9 @@ class _Decoder:
                 seq = [self.decode(x) for x in v["__seq__"]]
                 return tuple(seq) if v["tuple"] else seq
         return v
+
+
+_NpDecoder.decode = _Decoder.decode  # same walk, numpy leaves
 
 
 # ---------------------------------------------------------------------------
@@ -491,14 +525,20 @@ def _fsync_dir(path: str) -> None:
 
 def _write_generation(path: str, payload: dict, enc: _Encoder,
                       linked: Dict[str, str],
-                      linked_meta: Optional[Dict[str, dict]] = None
+                      linked_meta: Optional[Dict[str, dict]] = None,
+                      copied: Optional[Dict[str, str]] = None
                       ) -> Tuple[str, dict]:
     """Write one generation atomically: blobs + manifest land in a temp
     dir, which is renamed into place before CURRENT is swapped. ``linked``
     maps blob name -> absolute source path to hard-link instead of
     serializing (clean deep levels); ``linked_meta`` carries their
     already-recorded digests so a linked blob is never re-hashed (saves
-    stay O(dirty state), not O(state)). Returns (gen name, stats)."""
+    stay O(dirty state), not O(state)). ``copied`` maps blob name ->
+    source path to COPY (new inode): the first generation's capture of a
+    cold-store blob must not share the store file's inode, or in-place
+    bit-rot would take the recovery copy down with the store (subsequent
+    generations hard-link the generation copy). Returns
+    (gen name, stats)."""
     os.makedirs(path, exist_ok=True)
     # sweep orphaned temp dirs from writers that died mid-save (SIGKILL
     # mid-serialization leaves up to a full state copy under .tmp-*; a
@@ -525,6 +565,15 @@ def _write_generation(path: str, payload: dict, enc: _Encoder,
             shutil.copy2(src, dst)
         meta = linked_meta.get(blob)
         if meta is None:  # unexpected: fall back to hashing the file
+            meta = {"sha256": _sha256_file(dst),
+                    "bytes": os.path.getsize(dst)}
+        arrays[blob] = meta
+        nbytes += meta["bytes"]
+    for blob, src in (copied or {}).items():
+        dst = os.path.join(tmp, blob + ".npy")
+        shutil.copy2(src, dst)
+        meta = linked_meta.get(blob)
+        if meta is None:
             meta = {"sha256": _sha256_file(dst),
                     "bytes": os.path.getsize(dst)}
         arrays[blob] = meta
@@ -566,6 +615,7 @@ def _write_generation(path: str, payload: dict, enc: _Encoder,
     return name, {"generation": gen_no,
                   "arrays": len(arrays),
                   "linked_arrays": len(linked),
+                  "copied_arrays": len(copied or {}),
                   "bytes": nbytes}
 
 
@@ -589,6 +639,31 @@ def _host_structure(circuit) -> list:
 
 
 def _save_host(handle, enc: _Encoder) -> dict:
+    from dbsp_tpu import residency as _res
+
+    # disk-tier spine levels are streaming-VERIFIED in place before they
+    # are serialized: encoding raw memmap bytes would stamp a bit-rotted
+    # blob with a fresh valid checksum — corruption laundered into a
+    # checkpoint that verifies clean forever. verify_meta (not a fault):
+    # no whole-tier materialization in RAM, no spine mutation, no
+    # release/sweep churn — the tiers survive the save untouched.
+    for sp in _res.circuit_spines(handle.circuit):
+        batches = getattr(sp, "batches", None)
+        if not batches:
+            continue
+        for i, b in enumerate(list(batches)):
+            if not isinstance(b.weights, np.memmap):
+                continue
+            meta = getattr(sp, "_disk_meta", {}).get(id(b)) or \
+                _res.meta_from_batch(b)
+            if sp._store().verify_meta(meta):
+                # a blob was healed: the open memmap still maps the OLD
+                # corrupted inode — re-open so the encoder reads the
+                # recovered bytes, and re-key the meta to the new object
+                fresh = _res.disk_batch(meta, sp._store())
+                sp.batches[i] = fresh
+                if sp._disk_meta.pop(id(b), None) is not None:
+                    sp._disk_meta[id(fresh)] = meta
     states = {}
     for gid, node in _walk(handle.circuit):
         sd = node.operator.state_dict()
@@ -656,6 +731,9 @@ def _save_compiled(ch, enc: _Encoder, states: Dict[str, Any],
     level_blobs: Dict[str, dict] = {}
     linked: Dict[str, str] = {}
     linked_meta: Dict[str, dict] = {}
+    copied: Dict[str, str] = {}
+    residency: Dict[str, list] = {}
+    cold_blobs: Dict[str, Dict[str, dict]] = {}
     for key, st in states.items():
         cn = ch.by_index.get(int(key))
         leveled = isinstance(cn, _cn._Leveled) and isinstance(st, tuple) \
@@ -664,24 +742,86 @@ def _save_compiled(ch, enc: _Encoder, states: Dict[str, Any],
             enc_states[key] = enc.encode(st, hint=f"s{key}")
             continue
         levels, base = st
+        tiers = getattr(ch, "_tiers", {}).get(key)
+        if tiers:
+            residency[key] = list(tiers)
         enc_levels = []
         for i, lvl in enumerate(levels):
             hint = f"s{key}_l{i}"
             fp = _level_fingerprint(ch, key, i, lvl.cap)
+            ent = getattr(ch, "_cold_meta", {}).get(key, {}).get(i)
+            disk_ent = ent if (i > 0 and ent is not None
+                               and ent.get("batch") is lvl) else None
             reuse = prev_levels.get(fp) if i > 0 else None
             if reuse is not None and prev_dir is not None and all(
                     os.path.exists(os.path.join(prev_dir, b + ".npy"))
                     for b in reuse["blobs"]):
                 # clean deep level: reuse the previous generation's encoded
                 # node verbatim and hard-link its blobs (same names — the
-                # hint is deterministic per (state, level))
+                # hint is deterministic per (state, level)). Disk-demoted
+                # levels take this path on every save AFTER the first: the
+                # generation chain links its OWN first copy, whose inode is
+                # deliberately independent of the cold store's (see below)
                 enc_levels.append(reuse["node"])
                 for b in reuse["blobs"]:
                     linked[b] = os.path.join(prev_dir, b + ".npy")
                     if b in prev_arrays:
                         linked_meta[b] = prev_arrays[b]
                 level_blobs[fp] = reuse
+                if disk_ent is not None:
+                    cold_blobs.setdefault(key, {})[str(i)] = \
+                        disk_ent["blob"]
+                    ch._store().note_recovery_dir(path)
                 continue
+            # disk-demoted level, first generation capture: its columns
+            # ALREADY live as content-addressed blobs in the cold store —
+            # verified COPY into the generation (no serialization from
+            # memory; the recorded digests ride along). A hard link here
+            # would share the store file's INODE, and in-place bit-rot
+            # would corrupt the recovery copy together with the store —
+            # defeating the fallback the cold tier's corruption contract
+            # depends on. Subsequent saves hard-link the generation copy
+            # (fp reuse above), so warm saves stay O(hot state).
+            if disk_ent is not None:
+                store = ch._store()
+                blob = disk_ent["blob"]
+                cols = [*blob["keys"], *blob["vals"], blob["weights"]]
+                nk = len(blob["keys"])
+                names = [f"{hint}_c{j}" for j in range(len(cols))]
+                if all(os.path.exists(store.blob_path(m["sha256"]))
+                       for m in cols):
+                    if store.verify_meta(blob):  # never launder rot —
+                        # and a HEAL replaced the file: re-point every
+                        # live holder off the corrupted inode
+                        lvl = _reheal_level(ch, states, key, i, lvl, blob)
+                    node = {"__batch__": {
+                        "keys": names[:nk],
+                        "vals": names[nk:-1],
+                        "weights": names[-1],
+                        "runs": blob.get("runs")}}
+                    for name, m in zip(names, cols):
+                        copied[name] = store.blob_path(m["sha256"])
+                        linked_meta[name] = {"sha256": m["sha256"],
+                                             "bytes": m["bytes"]}
+                    enc_levels.append(node)
+                    level_blobs[fp] = {"node": node, "blobs": names}
+                    cold_blobs.setdefault(key, {})[str(i)] = blob
+                    store.note_recovery_dir(path)
+                    continue
+            if isinstance(lvl.weights, np.memmap):
+                # disk level with stale/missing meta (identity guard
+                # failed): streaming-VERIFY (and heal) before serializing
+                # — encoding raw memmap bytes would launder a corrupted
+                # blob into a clean-checksummed checkpoint
+                from dbsp_tpu import residency as _res
+
+                stale_meta = _res.meta_from_batch(lvl)
+                ch._store().verify_meta(stale_meta)
+                # re-open regardless (a heal replaced the file under the
+                # open memmap; a fresh view is free either way) AND swap
+                # the fresh batch into the live holders so the engine
+                # stops reading the old inode too
+                lvl = _reheal_level(ch, states, key, i, lvl, stale_meta)
             before = set(enc.arrays)
             node = enc.encode(lvl, hint=hint)
             blobs = sorted(set(enc.arrays) - before)
@@ -706,17 +846,80 @@ def _save_compiled(ch, enc: _Encoder, states: Dict[str, Any],
                            for k, v in ch._level_versions.items()},
         "maintain_pending": bool(ch.maintain_pending),
         "level_blobs": level_blobs,
-    }, linked, linked_meta
+        "residency": residency,
+        "cold_blobs": cold_blobs,
+    }, linked, linked_meta, copied
 
 
-def _restore_compiled(ch, payload: dict, dec: _Decoder) -> Dict[str, Any]:
+def _adopt_cold_blobs(store, blob: dict, enc_node: dict,
+                      gen_dir: str) -> None:
+    """Ensure every column blob of one disk-tier level exists in the cold
+    store, hard-linking (or copying) the generation's verified files in
+    by content hash — restore never re-serializes cold state."""
+    names = []
+    if isinstance(enc_node, dict) and "__batch__" in enc_node:
+        b = enc_node["__batch__"]
+        names = [*b["keys"], *b["vals"], b["weights"]]
+    metas = [*blob["keys"], *blob["vals"], blob["weights"]]
+    for j, m in enumerate(metas):
+        dst = store.blob_path(m["sha256"])
+        if os.path.exists(dst):
+            continue
+        src = os.path.join(gen_dir, (names[j] if j < len(names)
+                                     else "") + ".npy")
+        if not os.path.exists(src):
+            continue  # fault_batch will surface/recover the miss later
+        try:
+            os.link(src, dst)
+        except OSError:
+            shutil.copy2(src, dst)
+
+
+def _reheal_level(ch, states: Dict[str, Any], key: str, i: int,
+                  old: Batch, blob: dict) -> Batch:
+    """After ``verify_meta`` healed a blob on disk, any OPEN memmap still
+    maps the corrupted inode (the heal is an ``os.replace``): re-open a
+    fresh view and swap it into every live holder whose level IS the
+    healed object — the engine states (so subsequent step programs stop
+    reading rotted bytes), the states dict being saved, and the blob
+    bookkeeping's identity anchor."""
+    from dbsp_tpu import residency as _res
+
+    fresh = _res.disk_batch(blob, ch._store())
+    for holder in (ch.states, states):
+        st = holder.get(key)
+        if isinstance(st, tuple) and len(st) == 2 and \
+                isinstance(st[0], tuple) and i < len(st[0]) and \
+                st[0][i] is old:
+            lv = list(st[0])
+            lv[i] = fresh
+            holder[key] = (tuple(lv), st[1])
+    ent = getattr(ch, "_cold_meta", {}).get(key, {}).get(i)
+    if ent is not None and ent.get("batch") is old:
+        ent["batch"] = fresh
+    return fresh
+
+
+def _restore_compiled(ch, payload: dict, dec: _Decoder,
+                      gen_dir: Optional[str] = None,
+                      path: Optional[str] = None) -> Dict[str, Any]:
     """Apply a compiled payload onto a freshly compiled handle: caps, slot
     geometry, maintain cursors, and the decoded states (re-placed over
     the worker mesh when sharded). TWO-PHASE: everything is decoded and
     device-placed BEFORE the first mutation, so a decode/placement
     failure leaves the handle exactly as built (a half-mutated engine
     served as 'fresh' would double-apply replayed inputs). Returns the
-    decoded state dict."""
+    decoded state dict.
+
+    Residency: when the restoring handle runs with active budgets
+    (``residency_cfg.active``), the payload's persisted tier map is
+    honored — disk-demoted levels are re-adopted into the cold store by
+    content hash and come back as memmap views (the restore that leaves
+    cold state on disk), host-tier levels decode straight to numpy. A
+    handle with no budgets decodes everything device-resident (legacy
+    behavior, bit-identical either way)."""
+    from dbsp_tpu import residency as _res
+
     if _compiled_structure(ch) != payload["structure"]:
         raise CheckpointError(
             "compiled circuit structure differs from the checkpointed "
@@ -725,12 +928,44 @@ def _restore_compiled(ch, payload: dict, dec: _Decoder) -> Dict[str, Any]:
         raise CheckpointError(
             f"checkpoint was taken at workers={payload.get('workers')} != "
             f"this runtime's {ch.workers}")
+    honor_tiers = getattr(ch, "residency_cfg", None) is not None and \
+        ch.residency_cfg.active and ch.workers == 1
+    residency = payload.get("residency") or {}
+    cold_blobs = payload.get("cold_blobs") or {}
+    npdec = _NpDecoder(dec.load)
     # phase 1: decode + place (no mutation of ch/cnodes yet)
     states: Dict[str, Any] = {}
+    tiers_out: Dict[str, list] = {}
+    cold_meta_out: Dict[str, Dict[int, dict]] = {}
     for key, enc_st in payload["states"].items():
         if isinstance(enc_st, dict) and "__levels__" in enc_st:
-            levels = tuple(dec.decode(lv) for lv in enc_st["__levels__"])
-            states[key] = (levels, dec.decode(enc_st["base"]))
+            tiers = residency.get(key) if honor_tiers else None
+            levels = []
+            for i, lv in enumerate(enc_st["__levels__"]):
+                tier = tiers[i] if tiers and i < len(tiers) \
+                    else _res.TIER_DEVICE
+                blob = cold_blobs.get(key, {}).get(str(i))
+                if tier == _res.TIER_DISK and blob is not None and \
+                        gen_dir is not None:
+                    store = ch._store()
+                    _adopt_cold_blobs(store, blob, lv, gen_dir)
+                    lvl = _res.disk_batch(blob, store)
+                    store.retain(blob)  # sweep-protect the restored level
+                    cold_meta_out.setdefault(key, {})[i] = {
+                        "blob": blob, "batch": lvl}
+                    if path is not None:
+                        store.note_recovery_dir(path)
+                elif tier == _res.TIER_HOST:
+                    lvl = npdec.decode(lv)
+                else:
+                    tier = _res.TIER_DEVICE
+                    lvl = dec.decode(lv)
+                levels.append(lvl)
+                if tiers:
+                    tiers[i] = tier  # downgraded disk->device when no dir
+            if tiers and any(t != _res.TIER_DEVICE for t in tiers):
+                tiers_out[key] = list(tiers)
+            states[key] = (tuple(levels), dec.decode(enc_st["base"]))
         else:
             states[key] = dec.decode(enc_st)
     if ch.workers > 1:
@@ -747,8 +982,12 @@ def _restore_compiled(ch, payload: dict, dec: _Decoder) -> Dict[str, Any]:
             cn.caps.update({k: int(v) for k, v in saved.items()})
         if key in payload.get("slots", {}):
             cn._slot_cap = int(payload["slots"][key])
+        if key in tiers_out:
+            cn.residency_tiers = tuple(tiers_out[key])
         cn._live_cache = None
     ch.states = states
+    ch._tiers = tiers_out
+    ch._cold_meta = cold_meta_out
     ch._level_versions = {k: list(v)
                           for k, v in payload["level_versions"].items()}
     ch.maintain_pending = bool(payload.get("maintain_pending", False))
@@ -796,6 +1035,7 @@ def save(target, path: str, controller: Optional[dict] = None,
     enc = _Encoder()
     linked: Dict[str, str] = {}
     linked_meta: Dict[str, dict] = {}
+    copied: Dict[str, str] = {}
     if host is not None:
         payload = _save_host(host, enc)
     else:
@@ -824,8 +1064,8 @@ def save(target, path: str, controller: Optional[dict] = None,
             states = ch.states
             base_tick = driver._tick if driver is not None else 0
             retained = []
-        payload, linked, linked_meta = _save_compiled(ch, enc, states,
-                                                      prev, path)
+        payload, linked, linked_meta, copied = _save_compiled(
+            ch, enc, states, prev, path)
         payload["retained"] = retained
         payload["tick"] = base_tick
     if tick is not None:
@@ -837,7 +1077,7 @@ def save(target, path: str, controller: Optional[dict] = None,
             n: enc.encode(b, hint=f"op_{i}")
             for i, (n, b) in enumerate(sorted(output_pending.items()))}
     name, stats = _write_generation(path, payload, enc, linked,
-                                    linked_meta)
+                                    linked_meta, copied)
     return dict(stats, tick=payload["tick"], path=path, name=name)
 
 
@@ -866,7 +1106,7 @@ def restore(target, path: str) -> dict:
             raise CheckpointError(
                 f"checkpoint engine {engine!r} cannot restore into a "
                 "compiled handle")
-        _restore_compiled(ch, payload, dec)
+        _restore_compiled(ch, payload, dec, gen_dir=gen_dir, path=path)
         tick = int(payload.get("tick", 0))
         if driver is not None:
             retained = [

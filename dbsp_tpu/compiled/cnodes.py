@@ -436,6 +436,13 @@ class CNode:
             slot = getattr(self, "_slot_cap", None)
             if slot:
                 meta["slot_cap"] = int(slot)
+            # tiered residency tag (dbsp_tpu/residency.py): per-level tier
+            # of this trace's state, maintained by the handle's enforcement
+            # OUTSIDE the jitted state pytree (tiers are host bookkeeping,
+            # never traced data). Absent = fully device-resident.
+            tiers = getattr(self, "residency_tiers", None)
+            if tiers and any(t != "device" for t in tiers):
+                meta["residency_tiers"] = list(tiers)
         return meta
 
     def init_state(self):

@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dbsp_tpu import residency as res
 from dbsp_tpu.circuit.scheduler import static_schedule
 from dbsp_tpu.compiled import cnodes
 from dbsp_tpu.compiled.cnodes import CNode
@@ -321,6 +322,26 @@ class CompiledHandle:
         # dbsp_tpu.checkpoint on first save, regenerated on restore (two
         # handles sharing a directory must never alias each other's blobs)
         self._ckpt_salt: Optional[str] = None
+        # -- tiered trace residency (device <- host <- disk) -----------------
+        # Residency bookkeeping lives OUTSIDE the jitted state pytree: the
+        # step program is traced against a HOT pytree (donated, device) and
+        # a COLD operand dict (numpy / memmap, device_put per call, buffers
+        # die with it), so a demoted level never re-materializes as a
+        # persistent program output. All transitions happen between
+        # validated intervals (maintain / restore), never in the hot loop.
+        self.residency_cfg: res.ResidencyConfig = res.ResidencyConfig.from_env()
+        self._tiers: Dict[str, List[str]] = {}    # key -> tier per level
+        self._cold_meta: Dict[str, Dict[int, dict]] = {}  # disk blob metas
+        self._cold_store = None                   # residency.ColdStore
+        self._lru: Dict[Tuple[str, int], int] = {}  # (key, lvl) -> interval
+        self._interval = 0                        # maintain-call clock
+        # transition observability: counts keyed (from, to, cause) +
+        # bounded append-only log (CompiledFlightSource polls the tail into
+        # `residency` flight events) + cold-blob corruption episodes
+        # (polled into one-shot `restore` SLO incidents)
+        self.residency_stats: Dict[Tuple[str, str, str], int] = {}
+        self.residency_log: List[dict] = []
+        self.cold_events: List[dict] = []
 
     # -- consolidate placement ----------------------------------------------
     def _place_consolidations(self) -> int:
@@ -410,14 +431,407 @@ class CompiledHandle:
             out[self._op_to_index[id(op)]] = b
         return out
 
+    # -- tiered trace residency ----------------------------------------------
+    def set_residency(self, cfg: res.ResidencyConfig) -> None:
+        """Apply one residency config (the pipeline-config / env merge) —
+        the compiled half of the unified knob. Takes effect at the next
+        maintain interval; sharded handles keep everything device-resident
+        (cold operands cannot join the SPMD collectives, the same carve-out
+        the host spine documents for sharded batches)."""
+        if cfg == self.residency_cfg:
+            return
+        self.residency_cfg = cfg
+        if self.mesh is not None:
+            return
+        if self._cold_store is not None and cfg.cold_dir and \
+                self._cold_store.path != cfg.cold_dir:
+            # the store is already materialized somewhere else (an env/
+            # default temp dir from before this config arrived): keeping
+            # it would silently strand all cold blobs outside the
+            # configured directory — the accepted-but-ignored key again.
+            # Fault the disk tier up (verified) so the old store owns
+            # nothing, then let _store() lazily recreate at the new path;
+            # enforcement re-demotes into it.
+            for cn, key, (levels, base) in list(self._leveled_nodes()):
+                tiers = list(self._tiers.get(key) or [])
+                if res.TIER_DISK not in tiers:
+                    continue
+                levels = list(levels)
+                for k, t in enumerate(tiers):
+                    if t != res.TIER_DISK:
+                        continue
+                    ent = self._cold_meta.get(key, {}).get(k)
+                    blob = ent["blob"] if ent is not None and \
+                        ent.get("batch") is levels[k] \
+                        else res.meta_from_batch(levels[k])
+                    hot = res.fault_batch(blob, self._cold_store)
+                    if ent is not None:
+                        self._cold_meta[key].pop(k, None)
+                        self._cold_store.release(ent["blob"])
+                    levels[k] = hot
+                    tiers[k] = res.TIER_HOST
+                    self._log_transition(key, k, res.TIER_DISK,
+                                         res.TIER_HOST, hot.cap, "config")
+                self._tiers[key] = tiers
+                cn.residency_tiers = tuple(tiers)
+                self.states[key] = (tuple(levels), base)
+            self._cold_store = None
+        if cfg.active:
+            # enforce immediately so a freshly deployed pipeline starts
+            # within budget instead of waiting for the first drain
+            self._enforce_residency(cause="config")
+        elif self._tiers:
+            # budgets DISABLED (explicit <= 0 config over an env knob):
+            # promote everything back so the engine actually stops paying
+            # the tiering, instead of stranding cold levels forever
+            for cn, key, (levels, base) in list(self._leveled_nodes()):
+                tiers = self._tiers.get(key)
+                if not tiers:
+                    continue
+                levels = list(levels)
+                for k, t in enumerate(tiers):
+                    if t != res.TIER_DEVICE:
+                        self._promote_level(cn, key, levels, tiers, k,
+                                            "config")
+                self._tiers.pop(key, None)
+                cn.residency_tiers = tuple(tiers)
+                self.states[key] = (tuple(levels), base)
+
+    def _store(self) -> "res.ColdStore":
+        if self._cold_store is None:
+            path = self.residency_cfg.cold_dir
+            if not path:
+                # PER-HANDLE temp store, never the process-global default:
+                # two handles sharing one store would cross-route their
+                # corruption incidents (the observer is per store) and
+                # cross-alias blob lifetimes
+                import tempfile
+
+                path = tempfile.mkdtemp(prefix="dbsp-tpu-cold-")
+            self._cold_store = res.ColdStore(path,
+                                             on_event=self._cold_event)
+        return self._cold_store
+
+    def _cold_event(self, ev: dict) -> None:
+        if len(self.cold_events) < 512:
+            self.cold_events.append(dict(ev))
+
+    def _log_transition(self, key: str, lvl: int, tier_from: str,
+                        tier_to: str, rows: int, cause: str) -> None:
+        k = (tier_from, tier_to, cause)
+        self.residency_stats[k] = self.residency_stats.get(k, 0) + 1
+        if len(self.residency_log) < 4096:  # bounded; stats stay exact
+            self.residency_log.append(
+                {"node": key, "level": int(lvl), "tier_from": tier_from,
+                 "tier_to": tier_to, "rows": int(rows), "cause": cause})
+
+    def _leveled_nodes(self):
+        for cn in self.cnodes:
+            if isinstance(cn, cnodes._Leveled):
+                st = self.states.get(str(cn.node.index))
+                if st is not None and isinstance(st, tuple) and \
+                        len(st) == 2 and isinstance(st[0], tuple):
+                    yield cn, str(cn.node.index), st
+
+    def tier_rows_by_node(self) -> Dict[str, Dict[str, int]]:
+        """Per-trace resident row CAPACITY per tier, ONE walk over the
+        leveled nodes (metrics scrapes and bench sampling index this
+        instead of re-walking per key)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for cn, k, (levels, _b) in self._leveled_nodes():
+            row = {res.TIER_DEVICE: 0, res.TIER_HOST: 0, res.TIER_DISK: 0}
+            tiers = self._tiers.get(k) or [res.TIER_DEVICE] * len(levels)
+            for lvl, t in zip(levels, tiers):
+                row[t] += lvl.cap
+            out[k] = row
+        return out
+
+    def tier_rows(self, key: Optional[str] = None) -> Dict[str, int]:
+        """Resident row CAPACITY per tier over the leveled traces (one
+        trace when ``key`` given) — the compiled analog of
+        ``Spine.tier_rows``; what the residency gauges and the growth
+        bench sample."""
+        out = {res.TIER_DEVICE: 0, res.TIER_HOST: 0, res.TIER_DISK: 0}
+        for k, row in self.tier_rows_by_node().items():
+            if key is not None and k != key:
+                continue
+            for t, rows in row.items():
+                out[t] += rows
+        return out
+
+    def device_resident_rows(self, key: Optional[str] = None) -> int:
+        """Device-resident leveled-trace capacity — what the device budget
+        bounds (the residency hard-cap tests read this)."""
+        return self.tier_rows(key)[res.TIER_DEVICE]
+
+    def _split_states(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """(hot pytree, cold operand dict) for one step dispatch. The hot
+        dict rides the donated argument; cold levels ride separately so
+        XLA device_puts them per call (transient buffers) and the program
+        never returns them as persistent outputs."""
+        if not self._tiers:
+            return self.states, {}
+        hot = dict(self.states)
+        cold: Dict[str, Any] = {}
+        for key, tiers in self._tiers.items():
+            if all(t == res.TIER_DEVICE for t in tiers):
+                continue
+            levels, base = hot[key]
+            cold[key] = {str(i): levels[i]
+                         for i, t in enumerate(tiers)
+                         if t != res.TIER_DEVICE}
+            hot[key] = (tuple(l for i, l in enumerate(levels)
+                              if tiers[i] == res.TIER_DEVICE), base)
+        return hot, cold
+
+    @staticmethod
+    def _interleave(hot_levels, lvmap) -> tuple:
+        """THE one cold-level reinsertion rule (hot levels in order, cold
+        levels at their recorded STR indices — pytree dict keys) — shared
+        by the traced merge, the post-step rejoin, and the snapshot
+        restore so the three paths can never reassemble different
+        layouts."""
+        K = len(hot_levels) + len(lvmap)
+        it = iter(hot_levels)
+        return tuple(lvmap[str(i)] if str(i) in lvmap else next(it)
+                     for i in range(K))
+
+    def _rejoin_states(self, states: Dict[str, Any],
+                       cold: Dict[str, Any]) -> Dict[str, Any]:
+        """Reassemble full level tuples after a step: program outputs for
+        hot levels, the SAME host-side batch objects for cold ones (cold
+        batches are immutable — the program never donates them)."""
+        for key, lvmap in cold.items():
+            if key not in states:
+                continue
+            hot_levels, base = states[key]
+            states[key] = (self._interleave(hot_levels, lvmap), base)
+        return states
+
+    @staticmethod
+    def _with_cold(states, cold):
+        """(traced) merge cold operands back into full level tuples."""
+        if not cold:
+            return states
+        out = dict(states)
+        for key, lvmap in cold.items():
+            hot, base = out[key]
+            out[key] = (CompiledHandle._interleave(hot, lvmap), base)
+        return out
+
+    @staticmethod
+    def _without_cold(new_states, cold):
+        """(traced) strip cold levels from the returned states so they
+        never become persistent program outputs."""
+        for key, lvmap in (cold or {}).items():
+            if key not in new_states:
+                continue
+            full, base = new_states[key]
+            hot = tuple(l for i, l in enumerate(full)
+                        if str(i) not in lvmap)
+            new_states[key] = (hot, base)
+        return new_states
+
+    def _promote_level(self, cn, key: str, levels: list, tiers: list,
+                       k: int, cause: str) -> None:
+        """Promote one level to device for a WRITE (maintain drains merge
+        into it). Disk levels take the VERIFIED read (the corruption
+        detection point — recovery + incident via the cold store)."""
+        t = tiers[k]
+        if t == res.TIER_DEVICE:
+            return
+        if t == res.TIER_DISK:
+            ent = self._cold_meta.get(key, {}).get(k)
+            if ent is not None and ent.get("batch") is levels[k]:
+                # meta dropped only AFTER the verified read succeeds — a
+                # ColdError mid-promotion must leave the level tracked so
+                # a retry still verifies instead of reading the memmap raw
+                levels[k] = res.fault_batch(ent["blob"], self._store())
+                self._cold_meta.get(key, {}).pop(k, None)
+                self._store().release(ent["blob"])
+            else:
+                # IDENTITY mismatch (the save path's `batch is lvl` guard,
+                # applied to the runtime promote): an overflow restore can
+                # rewind a level to an OLDER disk batch than the recorded
+                # meta describes — faulting through the stale meta would
+                # merge the wrong content into the replay. Reconstruct the
+                # meta from the memmap's content-addressed filenames and
+                # VERIFY; the stale entry (if any) stays until its own
+                # batch reappears or _sync_tiers drops it.
+                levels[k] = res.fault_batch(
+                    res.meta_from_batch(levels[k]), self._store())
+        levels[k] = res.to_device(levels[k])
+        tiers[k] = res.TIER_DEVICE
+        self._lru[(key, k)] = self._interval
+        self._log_transition(key, k, t, res.TIER_DEVICE, levels[k].cap,
+                             cause)
+
+    def _enforce_residency(self, cause: str = "budget") -> bool:
+        """Demote/promote deep trace levels until every leveled trace fits
+        the configured budgets. Called between validated intervals only
+        (maintain / restore / config) — a tier change alters the hot
+        pytree STRUCTURE, which the jitted step re-traces and caches per
+        structure (an oscillating layout reuses its program; only
+        capacity grows drop _step_jit). Policy: deepest-first demotion
+        (deep levels are
+        re-merged the least — one move buys the most headroom), level 0
+        never demotes (the step program writes it every tick), and a host
+        level only demotes to disk after ``lru_intervals`` maintain
+        intervals without a write; promotion back to device happens for
+        recently-written levels when headroom exists (the LRU clock —
+        drain-writes promote eagerly in :meth:`maintain` itself)."""
+        cfg = self.residency_cfg
+        if cfg is None or not cfg.active or self.mesh is not None:
+            return False
+        changed = False
+        for cn, key, (levels, base) in list(self._leveled_nodes()):
+            K = len(levels)
+            if K < 2 or getattr(cn, "_gc_refresh", False):
+                continue
+            tiers = list(self._tiers.get(key) or [res.TIER_DEVICE] * K)
+            if len(tiers) != K:
+                tiers = (tiers + [res.TIER_DEVICE] * K)[:K]
+            levels = list(levels)
+
+            def rows_in(tier):
+                return sum(l.cap for l, t in zip(levels, tiers)
+                           if t == tier)
+
+            if cfg.device_rows is not None:
+                for k in range(K - 1, 0, -1):
+                    if rows_in(res.TIER_DEVICE) <= cfg.device_rows:
+                        break
+                    if tiers[k] != res.TIER_DEVICE:
+                        continue
+                    levels[k] = res.to_host(levels[k])
+                    tiers[k] = res.TIER_HOST
+                    self._log_transition(key, k, res.TIER_DEVICE,
+                                         res.TIER_HOST, levels[k].cap,
+                                         cause)
+                    changed = True
+            if cfg.host_rows is not None:
+                for k in range(K - 1, 0, -1):
+                    if rows_in(res.TIER_HOST) <= cfg.host_rows:
+                        break
+                    if tiers[k] != res.TIER_HOST:
+                        continue
+                    if self._interval - self._lru.get((key, k), -1 << 30) \
+                            < cfg.lru_intervals:
+                        continue  # recently written: not cold yet
+                    lvl, meta = res.demote_batch_to_disk(levels[k],
+                                                         self._store())
+                    self._cold_meta.setdefault(key, {})[k] = {
+                        "blob": meta, "batch": lvl}
+                    levels[k] = lvl
+                    tiers[k] = res.TIER_DISK
+                    self._log_transition(key, k, res.TIER_HOST,
+                                         res.TIER_DISK, lvl.cap, cause)
+                    changed = True
+            if cfg.device_rows is not None:
+                # promotion under headroom, re-hot levels only (LRU)
+                for k in range(1, K):
+                    if tiers[k] != res.TIER_HOST:
+                        continue
+                    if self._interval - self._lru.get((key, k), -1 << 30) \
+                            > cfg.lru_intervals:
+                        continue  # cold: stays put
+                    if rows_in(res.TIER_DEVICE) + levels[k].cap > \
+                            cfg.device_rows:
+                        continue
+                    levels[k] = res.to_device(levels[k])
+                    tiers[k] = res.TIER_DEVICE
+                    self._log_transition(key, k, res.TIER_HOST,
+                                         res.TIER_DEVICE, levels[k].cap,
+                                         "lru")
+                    changed = True
+            if any(t != res.TIER_DEVICE for t in tiers):
+                self._tiers[key] = tiers
+            else:
+                self._tiers.pop(key, None)
+            cn.residency_tiers = tuple(tiers)
+            self.states[key] = (tuple(levels), base)
+        if changed:
+            # a tier change alters the hot-pytree STRUCTURE only — the
+            # jitted step re-traces and caches per input structure, so an
+            # oscillating layout (drain promotes, budget demotes back)
+            # re-uses its compiled program instead of recompiling; only
+            # CAPACITY changes (grow) must drop _step_jit
+            self._note_cause("residency")
+        return changed
+
+    def _sync_tiers(self, cause: str = "restore") -> None:
+        """Reconcile the tier map with the ACTUAL leaf types after a path
+        that may have materialized levels (restore re-padding after a
+        grow) — the bookkeeping must never claim a tier the arrays left."""
+        for cn, key, (levels, _b) in self._leveled_nodes():
+            # DEFAULT to all-device rather than skipping untracked keys:
+            # an overflow restore can reinsert a snapshot's cold level
+            # under a tier map a later promotion emptied — skipping here
+            # would leave the bookkeeping claiming "device" while the
+            # leaf is a numpy/memmap batch, and the next dispatch would
+            # ride it through the DONATED hot pytree (re-materializing
+            # the whole level on device, unverified)
+            tiers = self._tiers.get(key) or [res.TIER_DEVICE] * len(levels)
+            tiers = (list(tiers) + [res.TIER_DEVICE] * len(levels)
+                     )[:len(levels)]
+            for k, lvl in enumerate(levels):
+                actual = res.batch_tier(lvl)
+                if actual != tiers[k]:
+                    self._log_transition(key, k, tiers[k], actual,
+                                         lvl.cap, cause)
+                    tiers[k] = actual
+                if actual != res.TIER_DISK:
+                    ent = self._cold_meta.get(key, {}).pop(k, None)
+                    if ent is not None:
+                        self._store().release(ent["blob"])
+            if any(t != res.TIER_DEVICE for t in tiers):
+                self._tiers[key] = tiers
+            else:
+                self._tiers.pop(key, None)
+            cn.residency_tiers = tuple(tiers)
+
+    def _reconcile_cold_meta(self) -> None:
+        """Re-key the disk blob bookkeeping to the ACTUAL batch objects
+        after a rewind: an overflow restore can bring back an OLDER disk
+        batch than the recorded meta describes (the meta followed a
+        promote/re-demote cycle the snapshot predates). Stale entries are
+        released; untracked disk levels get metas reconstructed from
+        their content-addressed filenames (and re-retained, so the sweep
+        cannot delete blobs the rewound state still needs)."""
+        for cn, key, (levels, _b) in self._leveled_nodes():
+            for k, lvl in enumerate(levels):
+                ent = self._cold_meta.get(key, {}).get(k)
+                is_disk = isinstance(lvl.weights, np.memmap)
+                if ent is not None and ent.get("batch") is not lvl:
+                    self._cold_meta[key].pop(k)
+                    self._store().release(ent["blob"])
+                    ent = None
+                if is_disk and ent is None:
+                    blob = res.meta_from_batch(lvl)
+                    self._store().retain(blob)
+                    self._cold_meta.setdefault(key, {})[k] = {
+                        "blob": blob, "batch": lvl}
+
+    def _sweep_cold(self) -> None:
+        """Delete zero-reference cold blobs. Called ONLY when a new
+        snapshot supersedes the old one — the one point where no overflow
+        replay can ever fault content older than the live snapshot."""
+        if self._cold_store is not None:
+            self._cold_store.sweep()
+
     # -- tracing -------------------------------------------------------------
-    def _run_nodes(self, states, tick, feeds):
+    def _run_nodes(self, states, tick, feeds, cold=None):
         """The scheduler's eval sequence as a pure traced function (shared
         by the single-worker and SPMD step builders)."""
         if self._gen_fn is not None:
             raw = self._gen_fn(tick)
             feeds = {self._op_to_index[id(getattr(h, "_op", h))]: b
                      for h, b in raw.items()}
+        # cold (host/disk-tier) levels rejoin their traces here: they are
+        # per-call operands, device_put by XLA for the duration of the
+        # call, and stripped from the returned states below so they never
+        # become persistent device buffers
+        states = self._with_cold(states, cold)
         ctx = _Ctx(feeds)
         ctx.states = states  # strict-output halves read their partner's
         values: Dict[int, Any] = {}
@@ -438,6 +852,7 @@ class CompiledHandle:
                 new_states[key] = (tuple(
                     cnodes.truncate_below(lvl, bound)
                     for lvl in levels), base)
+        new_states = self._without_cold(new_states, cold)
         req = (jnp.stack(ctx.reqs) if ctx.reqs
                else jnp.zeros((0,), jnp.int64))
         self._checks = ctx.req_index  # same order every trace
@@ -451,8 +866,8 @@ class CompiledHandle:
         # as the dominant steady-state cost). The flip side: snapshots
         # must be real copies (see snapshot()).
         if self.mesh is None:
-            def step_fn(states, tick, feeds):
-                return self._run_nodes(states, tick, feeds)
+            def step_fn(states, tick, feeds, cold):
+                return self._run_nodes(states, tick, feeds, cold)
 
             return jax.jit(step_fn, donate_argnums=(0,))
 
@@ -469,7 +884,10 @@ class CompiledHandle:
 
         W = P(WORKER_AXIS)
 
-        def step_fn(states, tick, feeds):
+        def step_fn(states, tick, feeds, cold):
+            # cold is always empty under a mesh (residency is single-
+            # worker only — see set_residency); the arg keeps the call
+            # signature uniform across both builders
             def body(states_l, tick_l, feeds_l):
                 squeeze = lambda t: jax.tree_util.tree_map(  # noqa: E731
                     lambda a: a[0], t)
@@ -500,9 +918,9 @@ class CompiledHandle:
         iteration — N ticks per dispatch at any worker count."""
         assert self._gen_fn is not None, "scan mode needs a gen_fn"
 
-        def _scan_body(states, t0, varying=False):
+        def _scan_body(states, t0, cold=None, varying=False):
             outs_shape = jax.eval_shape(
-                lambda s, t: self._run_nodes(s, t, {})[1], states, t0)
+                lambda s, t: self._run_nodes(s, t, {}, cold)[1], states, t0)
             init_outs = jax.tree_util.tree_map(
                 lambda sh: jnp.zeros(sh.shape, sh.dtype), outs_shape)
             if varying and hasattr(jax.lax, "pcast"):
@@ -519,7 +937,7 @@ class CompiledHandle:
 
             def body(carry, i):
                 st, _ = carry
-                ns, outs, req = self._run_nodes(st, t0 + i, {})
+                ns, outs, req = self._run_nodes(st, t0 + i, {}, cold)
                 # states absent from ns (stateless ticks) carry through
                 merged = {**st, **ns}
                 return (merged, outs), req
@@ -540,7 +958,7 @@ class CompiledHandle:
 
         W = P(WORKER_AXIS)
 
-        def scan_fn(states, t0):
+        def scan_fn(states, t0, cold):
             def body(states_l, t0_l):
                 squeeze = lambda t: jax.tree_util.tree_map(  # noqa: E731
                     lambda a: a[0], t)
@@ -567,8 +985,9 @@ class CompiledHandle:
         if fn is None:
             fn = cache[n] = self._make_scan(n)
         t_start = time.perf_counter_ns()
-        states, outputs, req = fn(self.states, jnp.asarray(t0, jnp.int64))
-        self.states = states
+        hot, cold = self._split_states()
+        states, outputs, req = fn(hot, jnp.asarray(t0, jnp.int64), cold)
+        self.states = self._rejoin_states(states, cold)
         self.last_outputs = outputs
         self._req = req if self._req is None else self._max_jit(self._req, req)
         if block:
@@ -608,9 +1027,10 @@ class CompiledHandle:
             self._note_cause("retrace")  # first call compiles the program
             self._step_jit = self._make_step()
         f = self._feed_indices(feeds) if feeds else {}
+        hot, cold = self._split_states()
         states, outputs, req = self._step_jit(
-            self.states, jnp.asarray(tick, jnp.int64), f)
-        self.states = states
+            hot, jnp.asarray(tick, jnp.int64), f, cold)
+        self.states = self._rejoin_states(states, cold)
         self.last_outputs = outputs
         self._req = req if self._req is None else self._max_jit(self._req, req)
 
@@ -732,6 +1152,7 @@ class CompiledHandle:
         stats["calls"] += 1
         rows_before = stats["rows_moved"]
         self.maintain_pending = False
+        self._interval += 1  # the residency LRU clock ticks per maintain
         changed = False
         prev_rt = Runtime._swap(self.runtime) if self.mesh is not None \
             else None
@@ -748,6 +1169,8 @@ class CompiledHandle:
                 if K == 1:
                     continue
                 levels = list(levels)
+                tiers = list(self._tiers.get(key)
+                             or [res.TIER_DEVICE] * K)
                 # Host-cached live counts: fetching them from the device
                 # would dispatch one eager O(cap) reduction per level per
                 # trace per interval (measured as a double-digit share of
@@ -811,6 +1234,18 @@ class CompiledHandle:
                     if n <= 0:
                         self.maintain_pending = True  # fuel ran out
                         return
+                    # a drain WRITES both sides: cold operands promote to
+                    # device first (disk reads verified — the compiled
+                    # engine's corruption-detection point); the budget
+                    # re-demotes after the sweep. A structure-only change
+                    # — the jitted step re-traces per input structure, so
+                    # no program invalidation is needed here.
+                    if tiers[k] != res.TIER_DEVICE or \
+                            tiers[k + 1] != res.TIER_DEVICE:
+                        self._promote_level(cn, key, levels, tiers, k,
+                                            "maintain")
+                        self._promote_level(cn, key, levels, tiers, k + 1,
+                                            "maintain")
                     rk1 = cn.level_keys[k + 1]
                     need = lives[k + 1] + n
                     if need > cn.caps[rk1]:
@@ -874,6 +1309,8 @@ class CompiledHandle:
                         self.maintain_pending = True  # remainder stays due
                     vers[k] += 1
                     vers[k + 1] += 1
+                    self._lru[(key, k)] = self._interval
+                    self._lru[(key, k + 1)] = self._interval
                     lives[k + 1] += n  # upper bound (netting may shrink)
                     lives[k] -= n
                     stats["rows_moved"] += n
@@ -901,12 +1338,21 @@ class CompiledHandle:
                             continue  # deep compaction defers; l0 may not
                         drain(k)
                 cn._live_cache = lives
+                if any(t != res.TIER_DEVICE for t in tiers):
+                    self._tiers[key] = tiers
+                else:
+                    self._tiers.pop(key, None)
+                cn.residency_tiers = tuple(tiers)
                 base_val = sum(lives[1:])
                 self.states[key] = (tuple(levels),
                                     jnp.full_like(base, base_val))
         finally:
             if self.mesh is not None:
                 Runtime._swap(prev_rt)
+        # budget enforcement between intervals: demote what the drains
+        # re-heated (and anything newly over budget), promote re-hot
+        # levels under headroom — every transition logged with its cause
+        changed |= self._enforce_residency(cause="budget")
         if stats["rows_moved"] > rows_before:
             self._note_cause("maintain")
         if changed:
@@ -1009,6 +1455,12 @@ class CompiledHandle:
                 levels = st[0]
                 for k in range(len(levels) - 1):
                     recv, src = levels[k + 1], levels[k]
+                    if isinstance(recv.weights, np.ndarray) or \
+                            isinstance(src.weights, np.ndarray):
+                        # cold (demoted) pair: a real drain promotes it
+                        # first — prewarming here would transfer the whole
+                        # level just to warm a kernel cache
+                        continue
                     cap = cn.caps[cn.level_keys[k + 1]]
                     if recv.cap != cap:
                         continue  # growth pending; shapes would not match
@@ -1116,6 +1568,12 @@ class CompiledHandle:
             kept: Dict[int, Batch] = {}
             fresh: Dict[int, Batch] = {}
             for i, lvl in enumerate(levels):
+                if i > 0 and isinstance(lvl.weights, np.ndarray):
+                    # cold (host/disk) level: immutable host-side buffers
+                    # the program never donates — share by reference
+                    # instead of copying through the device
+                    kept[i] = lvl
+                    continue
                 ent = cache[i] if i > 0 else None
                 if ent is not None and ent[0] == vers[i]:
                     kept[i] = ent[1]
@@ -1151,7 +1609,28 @@ class CompiledHandle:
         current capacities (no-op when capacities haven't changed)."""
         from dbsp_tpu.circuit.runtime import Runtime
 
-        states = _copy_tree(dict(snap))
+        # cold (numpy/memmap) levels in the snapshot are immutable host
+        # buffers: reinsert them by reference instead of device-copying
+        # them through _copy_tree (which would re-materialize every
+        # demoted level on device during an overflow replay)
+        snap2: Dict[str, Any] = {}
+        cold_ref: Dict[str, Dict[str, Batch]] = {}
+        for key, st in snap.items():
+            if isinstance(st, tuple) and len(st) == 2 and \
+                    isinstance(st[0], tuple):
+                levels, base = st
+                holds = {str(i): l for i, l in enumerate(levels)
+                         if isinstance(l.weights, np.ndarray)}
+                if holds:
+                    cold_ref[key] = holds
+                    snap2[key] = (tuple(l for i, l in enumerate(levels)
+                                        if str(i) not in holds), base)
+                    continue
+            snap2[key] = st
+        states = _copy_tree(snap2)
+        for key, holds in cold_ref.items():
+            hot, base = states[key]
+            states[key] = (self._interleave(hot, holds), base)
         # the restored buffers are new objects at possibly new capacities;
         # drop the deep-level copy cache and advance every version so a
         # later snapshot never pairs a stale copy with the rewound state
@@ -1177,6 +1656,13 @@ class CompiledHandle:
             if self.mesh is not None:
                 Runtime._swap(prev_rt)
         self.states = states
+        # re-padding after a grow may have materialized cold levels on
+        # device (with_cap is a jnp op): reconcile the tier map with the
+        # actual leaf types AND the blob bookkeeping with the actual
+        # batch objects, then re-demote anything over budget
+        self._sync_tiers(cause="restore")
+        self._reconcile_cold_meta()
+        self._enforce_residency(cause="restore")
 
     # -- checkpointed run -----------------------------------------------------
     def run_ticks(self, t0: int, n: int, validate_every: int = 16,
@@ -1260,6 +1746,7 @@ class CompiledHandle:
                 h0 = time.perf_counter_ns()
                 snap, snap_t = self.snapshot(), t
                 overhead["snapshot"].append(time.perf_counter_ns() - h0)
+                self._sweep_cold()  # old snapshot superseded: safe point
                 self._note_cause("snapshot")
             if on_validated is not None and t > reported:
                 # replayed intervals (t <= reported after an overflow
@@ -1298,7 +1785,16 @@ class CompiledHandle:
         ``t0`` is the tick index to profile from (matters under a
         ``gen_fn``: inputs are functions of the tick). Returns the shared
         ``/profile`` report (``opprofile.PROFILE_SCHEMA``)."""
-        from dbsp_tpu.obs.opprofile import measured_profile
+        from dbsp_tpu.obs.opprofile import ProfileError, measured_profile
+
+        if any(t != res.TIER_DEVICE for ts in self._tiers.values()
+               for t in ts):
+            raise ProfileError(
+                "segmented profiling requires fully device-resident "
+                "states: residency-demoted levels would be re-transferred "
+                "per segment and the attribution would time the tiering, "
+                "not the operators — raise DBSP_TPU_DEVICE_ROWS or "
+                "profile an unbudgeted twin")
 
         return measured_profile(self, n=n, t0=t0, feeds_list=feeds_list,
                                 spans=spans, registry=registry)

@@ -127,6 +127,9 @@ class CompiledCircuitDriver:
             self._snap = self.ch.snapshot()
             self.ch.host_overhead_ns["snapshot"].append(
                 time.perf_counter_ns() - h0)
+            # the previous interval's snapshot is gone: zero-reference
+            # cold blobs can be swept without endangering any replay
+            self.ch._sweep_cold()
         self._retained.append((self._tick, feeds))
         with (spans.span("compiled_step", cat="compiled") if spans
               is not None else contextlib.nullcontext()):
@@ -214,6 +217,15 @@ class CompiledCircuitDriver:
                                      feeds_list=feeds_list,
                                      spans=spans if spans is not None
                                      else self.spans, registry=registry)
+
+    def residency_summary(self):
+        """Tiered-residency digest of the compiled engine (per-tier rows,
+        budgets, transition count) for ``/status`` — None when residency
+        is unconfigured and nothing ever demoted. See
+        :func:`dbsp_tpu.residency.summary`."""
+        from dbsp_tpu import residency
+
+        return residency.summary(self)
 
     def restore_checkpoint(self, tick: int, retained) -> None:
         """Resume from a restored checkpoint (dbsp_tpu.checkpoint): the

@@ -130,6 +130,7 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "config": "immutable",
         "checkpoint_dir": "immutable",
         "checkpoint_every": "immutable",
+        "_residency_cfg": "immutable",
         "inputs": "gil-atomic: endpoint wiring is single-threaded deploy "
                   "work before start(); post-start the dicts are only read",
         "outputs": "gil-atomic: endpoint wiring is single-threaded deploy "
@@ -321,6 +322,8 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "_replays_seen": "lock(_lock)",
         "_rows_moved_seen": "lock(_lock)",
         "_consolidate_seen": "lock(_lock)",
+        "_residency_seen": "lock(_lock)",
+        "_cold_seen": "lock(_lock)",
         "_clock_ns": "lock(_lock)",
     },
     "ControllerFlightSource": {
@@ -333,6 +336,7 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "circuit": "immutable",
         "flight": "immutable",
         "_spines": "immutable",
+        "_spine_nids": "immutable",
         "_exchanges": "immutable",
         "_wm_ops": "immutable",
         "_depth": "lockset: mutated only by scheduler-event callbacks, "
@@ -343,6 +347,7 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "_merged_seen": "lockset: see _depth",
         "_exch_seen": "lockset: see _depth",
         "_wm_lag_seen": "lockset: see _depth",
+        "_res_seen": "lockset: see _depth",
     },
     "SLOConfig": {
         "p99_tick_seconds": "immutable",

@@ -47,6 +47,14 @@ class ControllerConfig:
     transport_timeout_s: float = 10.0
     transport_retries: int = 5
     transport_backoff_s: float = 0.05
+    # tiered trace residency (dbsp_tpu/residency.py) — the per-pipeline
+    # override of the DBSP_TPU_DEVICE_ROWS / _HOST_ROWS / _COLD_DIR env
+    # knobs, honored by BOTH engines (compiled leveled traces and host
+    # spines). None = env default; <= 0 = explicitly unbounded; cold_dir
+    # unset defaults to <checkpoint_dir>/cold when checkpointing is on.
+    device_rows: Optional[int] = None
+    host_rows: Optional[int] = None
+    cold_dir: Optional[str] = None
 
 
 class _InputEndpoint:
@@ -166,6 +174,28 @@ class Controller:
         # optional obs.FlightRecorder (PipelineObs.attach_controller wires
         # it) — checkpoint/restore events become SLO-visible through it
         self.flight = None
+        # tiered trace residency: route the unified budgets into whichever
+        # engine this controller drives (compiled handle or host spines).
+        # Applying HERE — not only on the manager deploy path — is what
+        # makes the allowlist-accepted config keys honored everywhere a
+        # controller is built (an accepted-but-ignored key is the silent
+        # failure the allowlist exists to prevent).
+        from dbsp_tpu import residency as _res
+
+        rcfg = _res.resolve(
+            device_rows=config.device_rows, host_rows=config.host_rows,
+            cold_dir=config.cold_dir or (
+                os.path.join(self.checkpoint_dir, "cold")
+                if self.checkpoint_dir else None))
+        # applied UNCONDITIONALLY: an explicit <= 0 config key resolves to
+        # an INACTIVE config that must still reach the engine to DISABLE
+        # the env budget it read at construction (gating on rcfg.active
+        # here would be the accepted-but-ignored key again, in reverse).
+        # Kept on the controller: restore_from re-applies it — a host
+        # restore rebuilds spines from decoded state, which would
+        # otherwise silently drop the per-pipeline budgets.
+        self._residency_cfg = rcfg
+        _res.apply_to_driver(handle, rcfg)
         _tsan_hook(self)
 
     # -- endpoint wiring ----------------------------------------------------
@@ -311,6 +341,14 @@ class Controller:
             raise ValueError("no checkpoint directory configured")
         with self._step_lock:
             info = _ckpt.restore(self.handle, path)
+            # a HOST restore rebuilds spines from decoded state (fresh
+            # Spine objects, module-default budgets) — re-apply the
+            # pipeline's resolved residency config so the budgets survive
+            # recovery; no-op for the compiled driver (its handle keeps
+            # residency_cfg across restore)
+            from dbsp_tpu import residency as _res
+
+            _res.apply_to_driver(self.handle, self._residency_cfg)
             c = info.get("controller") or {}
             self.steps = int(c.get("steps", info["tick"]))
             with self._pushed_lock:  # writes join note_pushed's guard

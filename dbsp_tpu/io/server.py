@@ -279,6 +279,13 @@ class CircuitServer:
         ck_err = getattr(c, "checkpoint_error", None)
         if ck_err:
             out["checkpoint_error"] = ck_err
+        # tiered trace residency (dbsp_tpu/residency.py): omitted when no
+        # budget is configured and nothing ever demoted
+        from dbsp_tpu import residency as _res
+
+        rs = _res.summary(c.handle)
+        if rs is not None:
+            out["residency"] = rs
         if self.obs is not None:
             self.obs.watch()
             out["slo"] = self.obs.slo.status_dict()

@@ -34,7 +34,17 @@ monotone id — wall-clock ``ts`` and monotonic ``t_ns``):
                       ``fallback_from`` when a corrupted newer generation
                       was skipped (the SLO watchdog turns these into
                       one-shot ``restore`` incidents; a failed restore
-                      latches a degraded state)
+                      latches a degraded state). Cold-blob corruption
+                      episodes (a disk-tier trace level failing its
+                      digest at promotion, recovered from the newest
+                      checkpoint generation recording the same hash)
+                      ride the same kind with ``cold_blob`` set — one
+                      SLO-visible incident per episode
+  ``residency``       one trace-level residency transition (tiered trace
+                      residency, dbsp_tpu/residency.py): node, level,
+                      tier_from/tier_to, rows, and the cause (budget
+                      demotion, maintain-drain promotion, fault-on-probe,
+                      lru re-promotion, config/restore)
   ``transport``       terminal transport failure of an input endpoint
                       (dead broker past the retry budget) — latched by the
                       watchdog as a degraded state
@@ -249,6 +259,11 @@ class CompiledFlightSource:
         self._replays_seen = 0
         self._rows_moved_seen = 0
         self._consolidate_seen: Dict[str, int] = {}
+        # residency transition log + cold-blob episode cursors (tiered
+        # trace residency; the logs are append-only and never cleared by
+        # reset_timing, so these cursors stay monotone)
+        self._residency_seen = 0
+        self._cold_seen = 0
         # synthetic wall anchors for batched samples (see trace_slice)
         self._clock_ns: Optional[int] = None
         _tsan_hook(self)
@@ -311,6 +326,23 @@ class CompiledFlightSource:
                     drains=stats.get("drains", 0),
                     partial_drains=stats.get("partial_drains", 0))
             self._rows_moved_seen = max(self._rows_moved_seen, moved)
+            # residency transitions -> `residency` events; cold-blob
+            # corruption episodes -> one-shot `restore` SLO incidents
+            # (recovered=True episodes fell back to the checkpoint
+            # generation's bytes; recovered=False latches degraded)
+            rlog = getattr(ch, "residency_log", ())
+            nr = len(rlog)
+            for ev in list(rlog[self._residency_seen:nr]):
+                self.flight.record("residency", **ev)
+            self._residency_seen = nr
+            clog = getattr(ch, "cold_events", ())
+            ncold = len(clog)
+            for ev in list(clog[self._cold_seen:ncold]):
+                self.flight.record(
+                    "restore", ok=bool(ev.get("recovered")),
+                    cold_blob=ev.get("sha256", "")[:12],
+                    fallback_from=ev.get("source"))
+            self._cold_seen = ncold
             self._poll_consolidate()
 
     def _poll_consolidate(self) -> None:  # holds: _lock
@@ -393,6 +425,7 @@ class HostFlightSource:
         self._step_t0: Optional[int] = None
         self._tick = 0
         self._spines: List[object] = []
+        self._spine_nids: List[str] = []
         self._exchanges: List[object] = []
         self._wm_ops: List[object] = []
         for node in self._walk(circuit):
@@ -400,10 +433,13 @@ class HostFlightSource:
             sp = getattr(op, "spine", None)
             if sp is not None and hasattr(sp, "maintain_stats"):
                 self._spines.append(sp)
+                self._spine_nids.append(str(node.index))
             if op.name in ("shard", "unshard"):
                 self._exchanges.append(op)
             if isinstance(op, WatermarkMonotonic):
                 self._wm_ops.append(op)
+        self._res_seen: List[int] = [
+            len(getattr(sp, "residency_log", ())) for sp in self._spines]
         self._merged_seen = self._merged_rows()
         self._exch_seen = self._exchange_totals()
         self._wm_lag_seen: Dict[int, float] = {}
@@ -462,6 +498,14 @@ class HostFlightSource:
                     if lag != self._wm_lag_seen.get(i):
                         self._wm_lag_seen[i] = lag
                         self.flight.record("watermark", t_ns=t1, lag=lag)
+                # tiered-residency transitions (unseen-tail per spine)
+                for i, sp in enumerate(self._spines):
+                    rlog = getattr(sp, "residency_log", ())
+                    n = len(rlog)
+                    for ev in list(rlog[self._res_seen[i]:n]):
+                        self.flight.record("residency", t_ns=t1,
+                                           node=self._spine_nids[i], **ev)
+                    self._res_seen[i] = n
             except Exception:
                 pass  # a mid-step race must not kill the circuit thread
             self.flight.record("tick", t_ns=t1, tick=self._tick,

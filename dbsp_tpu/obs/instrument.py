@@ -129,6 +129,39 @@ def _gid_str(gid: Tuple[int, ...]) -> str:
     return ".".join(map(str, gid))
 
 
+def _residency_tier_gauge(reg: MetricsRegistry, nid: str,
+                          tiers: Dict[str, int]) -> None:
+    """Per-tier resident rows of one trace (both engines share the
+    family; tier names come from dbsp_tpu/residency.py)."""
+    tier_gauge = reg.gauge(
+        "dbsp_tpu_trace_tier_resident_rows",
+        "Resident row capacity of one trace per residency tier (device = "
+        "persistent HBM/device buffers, host = process-resident numpy, "
+        "disk = memmap views over cold-store blobs; see "
+        "dbsp_tpu/residency.py)", labels=("node", "tier"))
+    for tier, rows in tiers.items():
+        tier_gauge.labels(node=nid, tier=tier).set(rows)
+
+
+def _residency_transitions(reg: MetricsRegistry,
+                           agg: Dict[Tuple[str, str, str], int]) -> None:
+    """Cumulative transition counts summed over every trace this
+    instrumentation covers — the demotion/promotion evidence the growth
+    acceptance reads. Called once per collect pass (set_total semantics:
+    per-node stats must be pre-aggregated by the caller)."""
+    if not agg:
+        return
+    trans = reg.counter(
+        "dbsp_tpu_trace_residency_transitions_total",
+        "Residency tier transitions by direction and cause (budget = "
+        "enforcement demotion, maintain = drain-write promotion, probe = "
+        "fault-on-probe promotion, lru = re-hot promotion, "
+        "config/restore = applied at deploy/restore)",
+        labels=("tier_from", "tier_to", "cause"))
+    for (frm, to, cause), n in agg.items():
+        trans.labels(tier_from=frm, tier_to=to, cause=cause).set_total(n)
+
+
 class CircuitInstrumentation:
     """Host-path hooks: scheduler events -> histograms/spans, graph walk ->
     gauges. Attach once per circuit, after build."""
@@ -220,6 +253,7 @@ class CircuitInstrumentation:
         from dbsp_tpu.timeseries.watermark import WatermarkMonotonic
 
         reg = self.registry
+        res_trans: Dict[Tuple[str, str, str], int] = {}
         for node, gid in self._walk():
             op = node.operator
             nid = _gid_str(gid)
@@ -237,6 +271,9 @@ class CircuitInstrumentation:
                               "(cold levels)",
                               labels=("node",)).labels(node=nid).set(
                                   sp.host_offloaded_rows())
+                    _residency_tier_gauge(reg, nid, sp.tier_rows())
+                    for k, n in sp.residency_stats.items():
+                        res_trans[k] = res_trans.get(k, 0) + n
                     reg.gauge("dbsp_tpu_trace_level_count",
                               "Spine LSM levels currently held",
                               labels=("node",)).labels(node=nid).set(
@@ -295,6 +332,10 @@ class CircuitInstrumentation:
                 # scrape must not take the server down on a mid-step race;
                 # the next scrape sees a consistent value
                 continue
+        try:
+            _residency_transitions(reg, res_trans)
+        except Exception:
+            pass  # same scrape-safety posture as the walk above
 
 
 class CompiledInstrumentation:
@@ -380,6 +421,11 @@ class CompiledInstrumentation:
         stats = getattr(ch, "maintain_stats", None)
         if stats:
             self.maintain_rows_total.set_total(stats.get("rows_moved", 0))
+        # ONE walk for all traces' tier partitions (per-key tier_rows
+        # calls would re-walk every leveled node per node — O(N^2) per
+        # scrape)
+        tiers_by_node = (ch.tier_rows_by_node()
+                         if hasattr(ch, "tier_rows_by_node") else {})
         for cn in ch.cnodes:
             if isinstance(cn, cnodes.CExchange):
                 # compiled skew observable: worst-worker rows at the last
@@ -403,16 +449,36 @@ class CompiledInstrumentation:
             if not isinstance(cn, cnodes._Leveled):
                 continue
             nid = str(cn.node.index)
-            caps = sum(cn.caps[k] for k in cn.level_keys)
-            self.registry.gauge(
-                "dbsp_tpu_trace_device_resident_rows",
-                "Device-resident row capacity of one compiled leveled "
-                "trace (all compiled state is device-resident)",
-                labels=("node",)).labels(node=nid).set(caps)
+            # tiered residency (dbsp_tpu/residency.py): deep levels past
+            # the budget live as host numpy / disk memmaps — the device
+            # gauge reports the DEVICE tier only, the per-tier gauge
+            # carries the full picture
+            tiers = tiers_by_node.get(nid)
+            if tiers is not None:
+                self.registry.gauge(
+                    "dbsp_tpu_trace_device_resident_rows",
+                    "Device-resident row capacity of one compiled "
+                    "leveled trace (device tier only — residency-"
+                    "demoted levels are excluded)",
+                    labels=("node",)).labels(node=nid).set(
+                        tiers["device"])
+                _residency_tier_gauge(self.registry, nid, tiers)
+                self.registry.gauge(
+                    "dbsp_tpu_trace_host_offloaded_rows",
+                    "Row capacity offloaded to host memory "
+                    "(cold levels)",
+                    labels=("node",)).labels(node=nid).set(tiers["host"])
             self.registry.gauge(
                 "dbsp_tpu_trace_level_count",
                 "Levels of one compiled leveled trace",
                 labels=("node",)).labels(node=nid).set(len(cn.level_keys))
+        if hasattr(ch, "residency_stats"):
+            try:
+                _residency_transitions(
+                    self.registry,
+                    {k: n for k, n in list(ch.residency_stats.items())})
+            except Exception:
+                pass  # scrape-safety: never take the server down
 
 
 class ControllerInstrumentation:

@@ -61,6 +61,9 @@ ALLOWED_LABEL_NAMES = frozenset((
     # dispatched to (native/xla/pallas) — both closed, enumerable sets
     # (zset/native_merge.py::KERNELS x three backends)
     "kernel", "backend",
+    # tiered trace residency (dbsp_tpu/residency.py): "tier" and the
+    # transition endpoints draw from the closed {device, host, disk} set
+    "tier", "tier_from", "tier_to",
 ))
 
 

@@ -1,0 +1,577 @@
+"""Tiered trace residency: device (HBM) <- host RAM <- disk blob store.
+
+The reference ships a RocksDB-backed ``PersistentTrace``
+(``trace/persistent/trace.rs:34``) precisely so accumulated state is not
+bounded by working memory; the classic LSM bet (O'Neil et al., Acta
+Informatica '96) is the same — keep the hot small levels fast, let cold
+deep levels live in a cheaper tier. This module is the ONE config point
+both engines route through:
+
+  * the host :class:`~dbsp_tpu.trace.spine.Spine` demotes its largest
+    device levels to host numpy past ``device_rows`` and its coldest host
+    levels to the disk blob store past ``host_rows`` (probes FAULT a disk
+    level back to host, verified against its recorded digest);
+  * the compiled engine (:class:`~dbsp_tpu.compiled.compiler
+    .CompiledHandle`) applies the same two budgets to each leveled trace's
+    deep levels between validated intervals — cold levels ride into the
+    step program as per-call operands OUTSIDE the donated state pytree
+    (numpy transfers per call and the buffers die with it; disk levels are
+    ``np.memmap`` views the OS pages in on probe), so persistent device
+    residency is bounded while every consumer still sees the identical
+    Z-set.
+
+Tier names are stable strings (metric label values): ``device`` — jax
+arrays, persistent HBM/device buffers; ``host`` — process-resident numpy;
+``disk`` — memmap views over content-addressed ``.npy`` blobs in a
+:class:`ColdStore`.
+
+The :class:`ColdStore` reuses the checkpoint store's per-blob SHA-256 +
+hard-link discipline (``dbsp_tpu/checkpoint.py`` format v2) as the cold
+format: a blob's name IS its content hash, so a checkpoint save of a
+pipeline with disk-demoted levels hard-links the already-written blobs
+instead of re-serializing them (O(hot state) saves), and a corrupted cold
+blob read falls back to re-adopting the bytes from the newest checkpoint
+generation that recorded the same digest — one SLO-visible incident, not
+silent data corruption.
+
+Knobs (env; a per-pipeline config key overrides each — see
+``ControllerConfig.device_rows/host_rows/cold_dir``):
+
+  DBSP_TPU_DEVICE_ROWS  per-trace device row budget (unset = unbounded)
+  DBSP_TPU_HOST_ROWS    per-trace host-RAM row budget (unset = unbounded)
+  DBSP_TPU_COLD_DIR     blob-store directory for the disk tier (unset =
+                        a process-scoped temp directory, created lazily)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import tempfile
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dbsp_tpu.zset.batch import Batch
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+TIERS = (TIER_DEVICE, TIER_HOST, TIER_DISK)
+
+
+def _env_rows(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    if not v:
+        return None
+    n = int(v)
+    return n if n > 0 else None
+
+
+#: module-level env defaults — read once at import like the spine's legacy
+#: ``DEVICE_BUDGET_ROWS`` (which now aliases :data:`DEVICE_ROWS`); tests
+#: monkeypatch these module attributes, so :meth:`ResidencyConfig.from_env`
+#: reads the attributes rather than os.environ again
+DEVICE_ROWS: Optional[int] = _env_rows("DBSP_TPU_DEVICE_ROWS")
+HOST_ROWS: Optional[int] = _env_rows("DBSP_TPU_HOST_ROWS")
+COLD_DIR: Optional[str] = os.environ.get("DBSP_TPU_COLD_DIR") or None
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidencyConfig:
+    """Per-pipeline residency budgets (both engines, one vocabulary).
+
+    ``device_rows`` / ``host_rows`` bound the row CAPACITY each trace may
+    keep resident in the respective tier (per trace, matching the host
+    spine's historical ``DBSP_TPU_DEVICE_ROWS`` semantics — capacity is
+    the static quantity the compiled programs actually allocate). Level 0
+    is always exempt on the compiled path: the step program writes it
+    every tick, so it is hot by construction — a budget below l0's
+    capacity degrades to "everything deep is cold", bounded residency at
+    bounded (transfer-per-probe) slowdown, the PersistentTrace contract.
+
+    ``lru_intervals`` is the LRU clock: a level must go that many
+    maintain intervals without a write before it may demote host -> disk,
+    and a recently-written host level within that window is eligible for
+    promotion back to device when budget headroom exists."""
+
+    device_rows: Optional[int] = None
+    host_rows: Optional[int] = None
+    cold_dir: Optional[str] = None
+    lru_intervals: int = 2
+
+    @property
+    def active(self) -> bool:
+        return self.device_rows is not None or self.host_rows is not None
+
+    @staticmethod
+    def from_env() -> "ResidencyConfig":
+        return ResidencyConfig(device_rows=DEVICE_ROWS, host_rows=HOST_ROWS,
+                               cold_dir=COLD_DIR)
+
+
+def resolve(device_rows=None, host_rows=None, cold_dir=None
+            ) -> ResidencyConfig:
+    """Merge explicit per-pipeline values over the env defaults — the one
+    resolution rule both engines and the controller share. ``None`` =
+    defer to env; an explicit value <= 0 = explicitly unbounded (a config
+    key must be able to DISABLE an env-set budget, not only tighten it)."""
+
+    def pick(v, env):
+        if v is None:
+            return env
+        v = int(v)
+        return v if v > 0 else None
+
+    return ResidencyConfig(device_rows=pick(device_rows, DEVICE_ROWS),
+                           host_rows=pick(host_rows, HOST_ROWS),
+                           cold_dir=cold_dir or COLD_DIR)
+
+
+# ---------------------------------------------------------------------------
+# batch tier inspection / movement
+# ---------------------------------------------------------------------------
+
+
+def batch_tier(b: Batch) -> str:
+    """Which tier a batch's buffers live in (weights column is
+    representative — all columns of a batch move together)."""
+    if isinstance(b.weights, np.memmap):
+        return TIER_DISK
+    if isinstance(b.weights, np.ndarray):
+        return TIER_HOST
+    return TIER_DEVICE
+
+
+def to_host(b: Batch) -> Batch:
+    """Copy a batch's columns to host memory (numpy). jnp kernels accept
+    numpy operands and device_put them per call, so host-tier levels stay
+    fully probe-able — each probe pays the transfer, nothing persists on
+    device (the fetched operand buffers die with the call).
+
+    ``np.array`` (a COPY), never ``np.asarray``: on the CPU backend
+    asarray can zero-copy-wrap the device buffer, and the compiled step
+    program DONATES its state pytree — a demoted level must own its
+    bytes or a later donation frees them under the view (the same
+    aliasing hazard checkpoint._Decoder documents, in reverse)."""
+    return Batch(tuple(np.array(c) for c in b.keys),
+                 tuple(np.array(c) for c in b.vals),
+                 np.array(b.weights), b.runs)
+
+
+def to_device(b: Batch) -> Batch:
+    """Materialize a cold batch as persistent device arrays —
+    ``jnp.array`` (a COPY), never ``asarray``: the result rejoins the
+    DONATED hot pytree, so it must not alias host memory the residency
+    bookkeeping (or a shared snapshot) still reads."""
+    import jax.numpy as jnp
+
+    return Batch(tuple(jnp.array(np.asarray(c)) for c in b.keys),
+                 tuple(jnp.array(np.asarray(c)) for c in b.vals),
+                 jnp.array(np.asarray(b.weights)), b.runs)
+
+
+class ColdError(RuntimeError):
+    """A disk-tier blob failed verification and could not be recovered."""
+
+
+class ColdStore:
+    """Content-addressed ``.npy`` blob store — the disk tier's format AND
+    the hard-link source for checkpoint saves.
+
+    A blob's filename is its SHA-256 (the same digest the checkpoint
+    manifest records), written atomically (temp + ``os.replace``) and
+    deduplicated by content. ``read_verified`` re-hashes on the promotion
+    path; a mismatch consults ``recovery_dirs`` (checkpoint generation
+    roots, newest generation first) for a blob whose MANIFEST records the
+    wanted digest, verifies it, re-adopts the bytes into the store, and
+    reports the episode via ``on_event`` — the cold tier can bit-rot
+    without the pipeline silently serving garbage."""
+
+    def __init__(self, path: str,
+                 on_event: Optional[Callable[[dict], None]] = None):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.recovery_dirs: List[str] = []
+        self.on_event = on_event
+        self._lock = threading.Lock()
+        # blob lifecycle: content-addressing means several levels (or
+        # several spines sharing one store) can reference one blob file —
+        # demotions RETAIN each column blob, promotions RELEASE them, and
+        # zero-reference blobs land on a condemned list that sweep()
+        # unlinks at a caller-chosen replay-safe point (the compiled
+        # engine sweeps when a NEW snapshot supersedes the old one — an
+        # overflow replay can never fault content older than the live
+        # snapshot). Without this, every demote/promote churn leaked one
+        # level-copy of .npy files until the cold dir filled the disk.
+        self._refs: Dict[str, int] = {}
+        self._condemned: List[str] = []
+
+    @staticmethod
+    def _meta_shas(meta: dict) -> List[str]:
+        return [m["sha256"]
+                for m in (*meta["keys"], *meta["vals"], meta["weights"])]
+
+    def retain(self, meta: dict) -> None:
+        """Take a reference on every column blob of one level meta."""
+        with self._lock:
+            for sha in self._meta_shas(meta):
+                self._refs[sha] = self._refs.get(sha, 0) + 1
+
+    def release(self, meta: dict) -> None:
+        """Drop references; zero-ref blobs are CONDEMNED, not unlinked —
+        :meth:`sweep` deletes them at a replay-safe point."""
+        with self._lock:
+            for sha in self._meta_shas(meta):
+                if sha not in self._refs:
+                    continue  # untracked (reconstructed meta): never ours
+                self._refs[sha] -= 1
+                if self._refs[sha] <= 0:
+                    del self._refs[sha]
+                    self._condemned.append(sha)
+
+    def sweep(self) -> int:
+        """Unlink condemned zero-reference blobs (checkpoint generations
+        keep their own hard links — recovery is unaffected). Returns the
+        number of files removed."""
+        removed = 0
+        with self._lock:
+            condemned, self._condemned = self._condemned, []
+            condemned = [s for s in condemned
+                         if self._refs.get(s, 0) <= 0]
+        for sha in condemned:
+            try:
+                os.unlink(self.blob_path(sha))
+                removed += 1
+            except OSError:
+                pass
+        return removed
+
+    def note_recovery_dir(self, path: str) -> None:
+        """Register a checkpoint store root as a corruption-recovery
+        source (idempotent; called by checkpoint save/restore)."""
+        with self._lock:
+            if path not in self.recovery_dirs:
+                self.recovery_dirs.append(path)
+
+    def blob_path(self, sha: str) -> str:
+        return os.path.join(self.path, sha + ".npy")
+
+    def put_array(self, arr: np.ndarray) -> dict:
+        """Serialize one array into the store (dedup by content). Returns
+        the checkpoint-compatible blob meta ``{"sha256", "bytes"}``."""
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr), allow_pickle=False)
+        data = buf.getvalue()
+        sha = hashlib.sha256(data).hexdigest()
+        dst = self.blob_path(sha)
+        if not os.path.exists(dst):
+            self._write_atomic(dst, data)
+        return {"sha256": sha, "bytes": len(data)}
+
+    @staticmethod
+    def _write_atomic(dst: str, data: bytes) -> None:
+        """Write-then-rename under a UNIQUE temp name: two threads
+        landing the same content hash (a process-shared store, or two
+        levels with identical columns) must not truncate each other's
+        half-written temp file — pid alone does not disambiguate
+        threads."""
+        tmp = dst + f".tmp-{os.getpid()}-{threading.get_ident()}-" \
+                    f"{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dst)
+
+    def mmap(self, meta: dict) -> np.ndarray:
+        """A disk-resident view of one blob (``np.load(mmap_mode='r')``):
+        the OS pages content in on access — the compiled engine's probes
+        fault exactly the bytes they touch. UNVERIFIED by design (per-page
+        hashing would defeat the laziness); every promotion back to host
+        goes through :meth:`read_verified`."""
+        return np.load(self.blob_path(meta["sha256"]), mmap_mode="r",
+                       allow_pickle=False)
+
+    def _event(self, ev: dict) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(ev)
+            except Exception:  # noqa: BLE001 — observer must not break IO
+                pass
+
+    def verify_meta(self, meta: dict) -> bool:
+        """Streaming-verify every column blob of one level meta IN PLACE
+        (no materialization): hash the file in chunks against the
+        recorded digest, healing a mismatch from the recovery dirs.
+        The checkpoint save path uses this so serializing a disk-tier
+        level never launders rotted bytes — without faulting the whole
+        tier into RAM (O(1) memory, one extra read of data the encoder
+        is about to read anyway). Returns True when any blob was HEALED
+        (the caller must re-open memmaps: healing replaces the file, and
+        an already-open memmap still maps the corrupted inode)."""
+        healed = False
+        for m in (*meta["keys"], *meta["vals"], meta["weights"]):
+            p = self.blob_path(m["sha256"])
+            h = hashlib.sha256()
+            n = 0
+            try:
+                with open(p, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                        n += len(chunk)
+            except OSError:
+                pass
+            if n != m["bytes"] or h.hexdigest() != m["sha256"]:
+                self._recover(m)  # heals the file (or raises ColdError)
+                healed = True
+        return healed
+
+    def read_verified(self, meta: dict) -> np.ndarray:
+        """Read + verify one blob against its recorded digest; on failure
+        recover the bytes from the newest checkpoint generation recording
+        the same digest (one event either way)."""
+        sha = meta["sha256"]
+        p = self.blob_path(sha)
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        if len(data) == meta["bytes"] and \
+                hashlib.sha256(data).hexdigest() == sha:
+            return np.load(io.BytesIO(data), allow_pickle=False)
+        return self._recover(meta)
+
+    def _recover(self, meta: dict) -> np.ndarray:
+        """Scan recovery dirs (checkpoint generation stores, newest
+        generation first) for a blob whose manifest records the wanted
+        digest; verify, re-adopt, report."""
+        sha = meta["sha256"]
+        with self._lock:
+            dirs = list(self.recovery_dirs)
+        for root in dirs:
+            try:
+                entries = sorted((e for e in os.listdir(root)
+                                  if e.startswith("gen-")), reverse=True)
+            except OSError:
+                continue
+            for gen in entries:
+                gen_dir = os.path.join(root, gen)
+                try:
+                    with open(os.path.join(gen_dir, "manifest.json")) as f:
+                        arrays = json.load(f).get(
+                            "payload", {}).get("arrays", {})
+                except (OSError, ValueError):
+                    continue
+                for name, m in arrays.items():
+                    if m.get("sha256") != sha:
+                        continue
+                    try:
+                        with open(os.path.join(gen_dir, name + ".npy"),
+                                  "rb") as f:
+                            data = f.read()
+                    except OSError:
+                        continue
+                    if hashlib.sha256(data).hexdigest() != sha:
+                        continue  # the generation's copy rotted too
+                    # re-adopt: future mmaps/reads see the good bytes
+                    self._write_atomic(self.blob_path(sha), data)
+                    self._event({"kind": "cold_blob", "sha256": sha,
+                                 "recovered": True,
+                                 "source": os.path.join(gen, name)})
+                    return np.load(io.BytesIO(data), allow_pickle=False)
+        self._event({"kind": "cold_blob", "sha256": sha, "recovered": False})
+        raise ColdError(
+            f"cold blob {sha[:12]} failed verification and no checkpoint "
+            f"generation under {dirs!r} records it")
+
+
+_DEFAULT_STORE: Optional[ColdStore] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_store() -> ColdStore:
+    """Process-scoped fallback store (``DBSP_TPU_COLD_DIR`` or a temp
+    directory) for spines/handles given budgets but no explicit store."""
+    global _DEFAULT_STORE
+    with _DEFAULT_LOCK:
+        if _DEFAULT_STORE is None:
+            path = COLD_DIR or os.path.join(
+                tempfile.gettempdir(), f"dbsp-tpu-cold-{os.getpid()}")
+            _DEFAULT_STORE = ColdStore(path)
+        return _DEFAULT_STORE
+
+
+# ---------------------------------------------------------------------------
+# batch <-> disk
+# ---------------------------------------------------------------------------
+
+
+def demote_batch_to_disk(b: Batch, store: ColdStore
+                         ) -> Tuple[Batch, dict]:
+    """Write a batch's columns into the store and return (memmap-backed
+    batch, blob metadata). The metadata is checkpoint-manifest-compatible
+    per column (``keys``/``vals``/``weights`` lists of
+    ``{"sha256", "bytes"}``) plus the batch's sorted-run aux. The blobs
+    are RETAINED — the owner must :meth:`ColdStore.release` the meta when
+    the level leaves the disk tier."""
+    meta = {"keys": [store.put_array(c) for c in b.keys],
+            "vals": [store.put_array(c) for c in b.vals],
+            "weights": store.put_array(b.weights),
+            "runs": list(b.runs) if b.runs is not None else None}
+    store.retain(meta)
+    return disk_batch(meta, store), meta
+
+
+def meta_from_batch(b: Batch) -> dict:
+    """Reconstruct a disk batch's blob metadata from its memmap filenames
+    — the store is content-addressed, so the filename IS the expected
+    digest. This is the verified-fault fallback when bookkeeping went
+    stale (a restored overflow snapshot's cold level can outlive the
+    ``_cold_meta`` entry that described it): faulting through the
+    reconstructed meta still verifies against the content hash, where a
+    raw memmap read would launder corruption."""
+
+    def m(c):
+        path = getattr(c, "filename", None)
+        if not path or not path.endswith(".npy"):
+            raise ColdError("not a blob-backed memmap batch")
+        sha = os.path.basename(path)[:-4]
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = -1  # missing: read_verified goes straight to recovery
+        return {"sha256": sha, "bytes": nbytes}
+
+    return {"keys": [m(c) for c in b.keys],
+            "vals": [m(c) for c in b.vals],
+            "weights": m(b.weights),
+            "runs": list(b.runs) if b.runs is not None else None}
+
+
+def disk_batch(meta: dict, store: ColdStore) -> Batch:
+    """Rehydrate a disk-tier batch as memmap views (lazy, unverified —
+    see :meth:`ColdStore.mmap`)."""
+    runs = tuple(meta["runs"]) if meta.get("runs") is not None else None
+    return Batch(tuple(store.mmap(m) for m in meta["keys"]),
+                 tuple(store.mmap(m) for m in meta["vals"]),
+                 store.mmap(meta["weights"]), runs)
+
+
+def fault_batch(meta: dict, store: ColdStore) -> Batch:
+    """Promote a disk-tier batch to host: verified read of every column
+    (the corruption-detection point; raises :class:`ColdError` only when
+    recovery from checkpoint generations also fails)."""
+    runs = tuple(meta["runs"]) if meta.get("runs") is not None else None
+    return Batch(tuple(store.read_verified(m) for m in meta["keys"]),
+                 tuple(store.read_verified(m) for m in meta["vals"]),
+                 store.read_verified(meta["weights"]), runs)
+
+
+# ---------------------------------------------------------------------------
+# engine wiring (the one config point)
+# ---------------------------------------------------------------------------
+
+
+def circuit_spines(circuit) -> list:
+    """Every Spine held by a circuit's operators (incl. nested children).
+
+    Walks ALL instance attributes (plus one level of list/tuple/dict
+    containers), not a fixed attr-name list: nested/recursive operators
+    hold spines under names like ``prev_a``/``cur_b`` (operators/
+    nested_ops.py) and lineage taps under ``lineage_tap`` — a budget (or
+    an explicit disable) that silently skipped those would leave their
+    levels un-governed, and the checkpoint save's verify pass would miss
+    their disk tiers."""
+    from dbsp_tpu.trace.spine import Spine
+
+    out = []
+    seen = set()
+
+    def add(sp):
+        if isinstance(sp, Spine) and id(sp) not in seen:
+            seen.add(id(sp))
+            out.append(sp)
+
+    def walk(c):
+        for node in c.nodes:
+            for val in vars(node.operator).values():
+                add(val)
+                if isinstance(val, (list, tuple)):
+                    for v in val:
+                        add(v)
+                elif isinstance(val, dict):
+                    for v in val.values():
+                        add(v)
+            if node.child is not None:
+                walk(node.child)
+
+    walk(circuit)
+    return out
+
+
+def summary(driver) -> Optional[dict]:
+    """One JSON-safe residency digest for a driver (either engine):
+    per-tier resident rows, the configured budgets, and the cumulative
+    transition count — the ``/status`` surface. None when no budget is
+    configured and nothing ever demoted (the common unbudgeted case
+    stays noise-free)."""
+    ch = getattr(driver, "ch", None)
+    if ch is not None and hasattr(ch, "tier_rows"):
+        cfg = getattr(ch, "residency_cfg", None)
+        if (cfg is None or not cfg.active) and not ch._tiers:
+            return None
+        return {"tier_rows": {k: int(v) for k, v in ch.tier_rows().items()},
+                "device_rows_budget": cfg.device_rows if cfg else None,
+                "host_rows_budget": cfg.host_rows if cfg else None,
+                "transitions": int(sum(ch.residency_stats.values())),
+                "cold_blob_events": len(getattr(ch, "cold_events", ()))}
+    circuit = getattr(driver, "circuit", None)
+    if circuit is None:
+        return None
+    spines = circuit_spines(circuit)
+    budgeted = [sp for sp in spines
+                if sp.device_budget_rows is not None
+                or sp.host_budget_rows is not None]
+    if not budgeted:
+        return None
+    tiers = {TIER_DEVICE: 0, TIER_HOST: 0, TIER_DISK: 0}
+    transitions = 0
+    for sp in spines:
+        for k, v in sp.tier_rows().items():
+            tiers[k] += v
+        transitions += sum(sp.residency_stats.values())
+    return {"tier_rows": tiers,
+            "device_rows_budget": budgeted[0].device_budget_rows,
+            "host_rows_budget": budgeted[0].host_budget_rows,
+            "transitions": int(transitions)}
+
+
+def apply_to_driver(driver, cfg: ResidencyConfig) -> None:
+    """Route one residency config into whichever engine ``driver`` runs —
+    the compiled handle's budget enforcement or every host spine's. This
+    is the build_controller hook that makes the pipeline-config keys
+    (``device_rows``/``host_rows``/``cold_dir``) ACTUALLY honored on both
+    engines (an allowlist-accepted-but-ignored key is the silent failure
+    the allowlist exists to prevent — the PR-10 lesson)."""
+    ch = getattr(driver, "ch", None)
+    if ch is not None and hasattr(ch, "set_residency"):
+        ch.set_residency(cfg)
+        return
+    circuit = getattr(driver, "circuit", None)
+    if circuit is None:
+        return
+    # the store is only materialized (mkdir) for ACTIVE budgets — an
+    # inactive config must still be applied (it may be DISABLING env
+    # knobs) but should leave no empty directories behind
+    store = ColdStore(cfg.cold_dir) if cfg.cold_dir and cfg.active \
+        else None
+    for sp in circuit_spines(circuit):
+        sp.device_budget_rows = cfg.device_rows
+        sp.host_budget_rows = cfg.host_rows
+        if store is not None:
+            sp.cold_store = store

@@ -27,14 +27,20 @@ from dbsp_tpu.zset import kernels
 from dbsp_tpu.zset.batch import Batch, Row, bucket_cap, concat_batches
 
 # Device-residency budget (rows) for EACH spine: levels beyond it live in
-# HOST memory as numpy-backed batches and transfer on probe. None = no cap.
-# The larger-than-device-memory story (reference: the RocksDB-backed
-# PersistentTrace, trace/persistent/trace.rs:34 — a drop-in Spine whose
-# cold levels spill to disk): here the hierarchy is HBM <- host RAM, the
-# tiers a TPU actually has, and the transfer unit is a whole cold level.
-DEVICE_BUDGET_ROWS: Optional[int] = (
-    int(os.environ["DBSP_TPU_DEVICE_ROWS"])
-    if os.environ.get("DBSP_TPU_DEVICE_ROWS") else None)
+# HOST memory as numpy-backed batches and transfer on probe, and — one
+# tier further (HOST_BUDGET_ROWS) — as content-addressed blobs in the
+# disk ColdStore, faulted back to host on probe with digest verification.
+# None = no cap. The larger-than-device-memory story (reference: the
+# RocksDB-backed PersistentTrace, trace/persistent/trace.rs:34 — a
+# drop-in Spine whose cold levels spill to disk): the hierarchy is
+# HBM <- host RAM <- disk, and the transfer unit is a whole cold level.
+# BOTH knobs (and the store directory) are owned by dbsp_tpu.residency —
+# the one config point the compiled engine shares — and aliased here for
+# backward compatibility (tests monkeypatch these module attributes).
+from dbsp_tpu import residency as _res  # noqa: E402
+
+DEVICE_BUDGET_ROWS: Optional[int] = _res.DEVICE_ROWS
+HOST_BUDGET_ROWS: Optional[int] = _res.HOST_ROWS
 
 # Maintenance budget (rows one maintenance call may move/merge) — the ONE
 # owner of the DBSP_TPU_MAINTAIN_BUDGET_ROWS knob; the compiled engine
@@ -58,13 +64,15 @@ def _to_cold(batch: Batch) -> Batch:
     numpy operands and device_put them per call, so cold levels stay fully
     probe-able — each probe pays the transfer, nothing persists on device
     (the fetched operand buffers die with the call)."""
-    return Batch(tuple(np.asarray(c) for c in batch.keys),
-                 tuple(np.asarray(c) for c in batch.vals),
-                 np.asarray(batch.weights))
+    return _res.to_host(batch)
 
 
 def _is_cold(batch: Batch) -> bool:
     return isinstance(batch.weights, np.ndarray)
+
+
+def _is_disk(batch: Batch) -> bool:
+    return isinstance(batch.weights, np.memmap)
 
 
 class Spine:
@@ -78,7 +86,9 @@ class Spine:
 
     def __init__(self, key_dtypes: Sequence, val_dtypes: Sequence = (),
                  device_budget_rows: Optional[int] = None,
-                 maintain_budget_rows: Optional[int] = None):
+                 maintain_budget_rows: Optional[int] = None,
+                 host_budget_rows: Optional[int] = None,
+                 cold_store=None):
         self.key_dtypes = tuple(jnp.dtype(d) for d in key_dtypes)
         self.val_dtypes = tuple(jnp.dtype(d) for d in val_dtypes)
         self.batches: List[Batch] = []
@@ -87,6 +97,22 @@ class Spine:
         self.device_budget_rows = (device_budget_rows
                                    if device_budget_rows is not None
                                    else DEVICE_BUDGET_ROWS)
+        self.host_budget_rows = (host_budget_rows
+                                 if host_budget_rows is not None
+                                 else HOST_BUDGET_ROWS)
+        # disk tier (residency.ColdStore); lazily defaulted when the host
+        # budget first forces a demotion and no store was configured
+        self.cold_store = cold_store
+        # per-batch disk blob metadata, keyed by batch object identity
+        # (the batch object stays referenced in self.batches while listed,
+        # so ids are stable for the entry's lifetime)
+        self._disk_meta: Dict[int, dict] = {}
+        # residency observability: transition counts keyed
+        # (tier_from, tier_to, cause) and a bounded transition log —
+        # exported as dbsp_tpu_trace_residency_transitions_total and
+        # polled into `residency` flight events
+        self.residency_stats: Dict[Tuple[str, str, str], int] = {}
+        self.residency_log: List[dict] = []
         self.maintain_budget_rows = (maintain_budget_rows
                                      if maintain_budget_rows is not None
                                      else MAINTAIN_BUDGET_ROWS)
@@ -107,10 +133,66 @@ class Spine:
         return sum(b.cap for b in self.batches if not _is_cold(b))
 
     def host_offloaded_rows(self) -> int:
-        """Row capacity living in HOST memory (cold levels) — the
-        complement of :meth:`device_resident_rows`; exported as
+        """Row capacity living in HOST memory (cold levels, disk-tier
+        memmaps excluded) — exported as
         ``dbsp_tpu_trace_host_offloaded_rows``."""
-        return sum(b.cap for b in self.batches if _is_cold(b))
+        return sum(b.cap for b in self.batches
+                   if _is_cold(b) and not _is_disk(b))
+
+    def disk_resident_rows(self) -> int:
+        """Row capacity living as disk blobs (memmap-backed levels)."""
+        return sum(b.cap for b in self.batches if _is_disk(b))
+
+    def tier_rows(self) -> Dict[str, int]:
+        """Resident row capacity per tier (metric label values)."""
+        return {_res.TIER_DEVICE: self.device_resident_rows(),
+                _res.TIER_HOST: self.host_offloaded_rows(),
+                _res.TIER_DISK: self.disk_resident_rows()}
+
+    def _note_transition(self, tier_from: str, tier_to: str, rows: int,
+                         cause: str) -> None:
+        key = (tier_from, tier_to, cause)
+        self.residency_stats[key] = self.residency_stats.get(key, 0) + 1
+        if len(self.residency_log) < 512:  # bounded; stats stay exact
+            self.residency_log.append(
+                {"tier_from": tier_from, "tier_to": tier_to,
+                 "rows": int(rows), "cause": cause})
+
+    def _store(self):
+        if self.cold_store is None:
+            self.cold_store = _res.default_store()
+        return self.cold_store
+
+    def _fault(self, b: Batch, cause: str = "probe") -> Batch:
+        """Fault one disk-tier batch back to host (verified read — the
+        corruption-detection point; recovery + incident semantics in
+        :meth:`dbsp_tpu.residency.ColdStore.read_verified`), replacing it
+        in the level list. Demand-driven promotion: a probe touching a
+        disk level pays exactly this."""
+        meta = self._disk_meta.get(id(b))
+        if meta is None:
+            # untracked memmap (bookkeeping went stale): the store is
+            # content-addressed, so the filenames still carry the
+            # expected digests — reconstruct and VERIFY; never read raw
+            hot = _res.fault_batch(_res.meta_from_batch(b), self._store())
+        else:
+            # meta is dropped (and its blobs released toward the sweep)
+            # only AFTER the verified read succeeds: a failed fault
+            # (ColdError before a recovery dir exists) must leave the
+            # level tracked for the retry
+            hot = _res.fault_batch(meta, self._store())
+            del self._disk_meta[id(b)]
+            self._store().release(meta)
+            self._store().sweep()  # host engine: no replay window to wait for
+        i = next(i for i, x in enumerate(self.batches) if x is b)
+        self.batches[i] = hot
+        self._note_transition(_res.TIER_DISK, _res.TIER_HOST, b.cap, cause)
+        return hot
+
+    def _fault_all(self, cause: str = "probe") -> None:
+        for b in list(self.batches):
+            if _is_disk(b):
+                self._fault(b, cause)
 
     def _enforce_budget(self) -> None:
         """Offload the largest device levels to host until the device
@@ -125,23 +207,43 @@ class Spine:
         levels. The budget is therefore enforced where it can be (unsharded
         levels), and a spine whose sharded levels alone exceed the budget
         stays over it — visibly, since metric and enforcement now agree."""
-        if self.device_budget_rows is None:
+        if self.device_budget_rows is not None:
+            hot = sorted((b for b in self.batches
+                          if not _is_cold(b) and not b.sharded),
+                         key=lambda b: b.cap, reverse=True)
+            resident = sum(b.cap for b in self.batches if not _is_cold(b))
+            # hard cap, largest level first (deep levels are re-merged the
+            # least, so one offload buys the most headroom per transfer); a
+            # budget below the delta size degrades to offload-every-insert —
+            # bounded residency at bounded (transfer-per-probe) slowdown,
+            # which is the PersistentTrace contract
+            for b in hot:
+                if resident <= self.device_budget_rows:
+                    break
+                # identity lookup: dataclass == would compare columns
+                i = next(i for i, x in enumerate(self.batches) if x is b)
+                self.batches[i] = _to_cold(b)
+                self._note_transition(_res.TIER_DEVICE, _res.TIER_HOST,
+                                      b.cap, "budget")
+                resident -= b.cap
+        if self.host_budget_rows is None:
             return
-        hot = sorted((b for b in self.batches
-                      if not _is_cold(b) and not b.sharded),
-                     key=lambda b: b.cap, reverse=True)
-        resident = sum(b.cap for b in self.batches if not _is_cold(b))
-        # hard cap, largest level first (deep levels are re-merged the
-        # least, so one offload buys the most headroom per transfer); a
-        # budget below the delta size degrades to offload-every-insert —
-        # bounded residency at bounded (transfer-per-probe) slowdown,
-        # which is the PersistentTrace contract
-        for b in hot:
-            if resident <= self.device_budget_rows:
+        # second tier: host levels past the host budget demote to the disk
+        # blob store, largest-first for the same headroom-per-transfer
+        # argument; probes FAULT them back (verified) on demand
+        warm = sorted((b for b in self.batches
+                       if _is_cold(b) and not _is_disk(b)),
+                      key=lambda b: b.cap, reverse=True)
+        resident = sum(b.cap for b in warm)
+        for b in warm:
+            if resident <= self.host_budget_rows:
                 break
-            # identity lookup: dataclass == on Batch would compare columns
+            cold, meta = _res.demote_batch_to_disk(b, self._store())
             i = next(i for i, x in enumerate(self.batches) if x is b)
-            self.batches[i] = _to_cold(b)
+            self.batches[i] = cold
+            self._disk_meta[id(cold)] = meta
+            self._note_transition(_res.TIER_HOST, _res.TIER_DISK,
+                                  b.cap, "budget")
             resident -= b.cap
 
     # -- maintenance --------------------------------------------------------
@@ -195,6 +297,12 @@ class Spine:
                 if over and not forced:
                     deferred = True
                     continue
+                # a merge READS both sides: disk-tier operands fault to
+                # host first (verified — the write path must never fold
+                # unverified bytes into the trace)
+                for b in (self.batches[i], self.batches[i + 1]):
+                    if _is_disk(b):
+                        self._fault(b, cause="maintain")
                 a = self.batches.pop(i + 1)
                 b = self.batches.pop(i)
                 m = _shrink(a.merge_with(b))
@@ -232,6 +340,7 @@ class Spine:
         snapshots, output handles, and tests.
         """
         if self._consolidated is None:
+            self._fault_all(cause="probe")  # reads every level anyway
             if not self.batches:
                 self._consolidated = Batch.empty(self.key_dtypes, self.val_dtypes)
             elif len(self.batches) == 1:
@@ -253,11 +362,13 @@ class Spine:
         Consumers (windows, GC) declare monotone lower bounds; state below
         them can never affect future outputs and is reclaimed here.
         """
+        self._fault_all(cause="gc")  # truncation rewrites every level
         new: List[Batch] = []
         for b in self.batches:
             kept = _shrink(_truncate_batch(b, bound_key))
             if kept is not None:
                 new.append(kept)
+        self._disk_meta.clear()  # every batch object was replaced
         self.batches = sorted(new, key=lambda b: b.cap, reverse=True)
         self._consolidated = None
         self._enforce_budget()
@@ -274,7 +385,12 @@ class Spine:
         """
         nk = len(self.key_dtypes)
         out = []
-        for b in self.batches:
+        for b in list(self.batches):
+            if _is_disk(b):
+                # demand-driven promotion: a probe touching a disk level
+                # faults it to host (verified read; stays host until the
+                # budget demotes it again)
+                b = self._fault(b, cause="probe")
             tk = b.keys[:nk]
             lo = kernels.lex_probe(tk, query_keys, side="left")
             hi = kernels.lex_probe(tk, query_keys, side="right")
@@ -283,6 +399,7 @@ class Spine:
 
     # -- host views ----------------------------------------------------------
     def to_dict(self) -> Dict[Row, int]:
+        self._fault_all(cause="probe")
         out: Dict[Row, int] = {}
         for b in self.batches:
             for r, w in b.to_dict().items():

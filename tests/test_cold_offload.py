@@ -1,23 +1,30 @@
-"""Larger-than-device-memory traces: cold-level offload to host RAM.
+"""Larger-than-device-memory traces: cold-level offload to host RAM and,
+one tier further, to the content-addressed disk blob store.
 
 Reference analog: the RocksDB-backed PersistentTrace
 (trace/persistent/trace.rs:34) — a drop-in Spine whose cold levels leave
-working memory. Here the tiers are HBM <- host RAM (what a TPU has): deep
-spine levels beyond a per-spine row budget become numpy-backed batches
-that transfer on probe, and device residency is bounded and ASSERTED
-while results stay exactly equal to the unbudgeted run.
+working memory. Here the tiers are HBM <- host RAM <- disk: deep spine
+levels beyond a per-spine row budget become numpy-backed batches that
+transfer on probe, levels cold past the host budget become memmap views
+over ColdStore blobs that FAULT back to host (digest-verified) on probe,
+and device residency is bounded and ASSERTED while results stay exactly
+equal to the unbudgeted run.
+
+Tier-1 (not slow): this is the host half of the residency budget path —
+the compiled half lives in tests/test_residency.py.
 """
+
+import os
 
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
+from dbsp_tpu import residency as res
 from dbsp_tpu.trace import spine as spine_mod
-from dbsp_tpu.trace.spine import Spine, _is_cold
+from dbsp_tpu.trace.spine import Spine, _is_cold, _is_disk
 from dbsp_tpu.zset.batch import Batch
-
-pytestmark = pytest.mark.slow
 
 
 def _batch(lo, n, seed=0):
@@ -109,3 +116,118 @@ def _circuit_spines(circuit):
             if isinstance(sp, Spine):
                 out.append(sp)
     return out
+
+
+# ---------------------------------------------------------------------------
+# disk tier (ColdStore-backed; tiered residency PR)
+# ---------------------------------------------------------------------------
+
+
+def test_spine_disk_tier_bounds_host_and_preserves_contents(tmp_path):
+    store = res.ColdStore(str(tmp_path / "cold"))
+    s = Spine((jnp.int64,), (jnp.int64,), device_budget_rows=1024,
+              host_budget_rows=1024, cold_store=store)
+    ref = Spine((jnp.int64,), (jnp.int64,))
+    for t in range(40):
+        s.insert(_batch(t * 300, 300, seed=t))
+        ref.insert(_batch(t * 300, 300, seed=t))
+        assert s.device_resident_rows() <= 1024
+    # the second tier engaged: blobs on disk, memmap batches in the spine
+    assert s.disk_resident_rows() > 0
+    assert any(_is_disk(b) for b in s.batches)
+    assert len(os.listdir(str(tmp_path / "cold"))) > 0
+    # tier accounting is a partition of the total capacity
+    tiers = s.tier_rows()
+    assert sum(tiers.values()) == sum(b.cap for b in s.batches)
+    # transitions were recorded with causes
+    assert s.residency_stats.get(("device", "host", "budget"), 0) > 0
+    assert s.residency_stats.get(("host", "disk", "budget"), 0) > 0
+    # a probe FAULTS disk levels to host (verified) and answers exactly
+    q = (jnp.asarray([5, 3000, 11900], dtype=jnp.int64),)
+    got = {}
+    for b, lo, hi in s.probe_ranges(q):
+        for i in range(3):
+            for j in range(int(lo[i]), int(hi[i])):
+                got[int(b.keys[0][j])] = got.get(int(b.keys[0][j]), 0) + 1
+    assert got == {5: 1, 3000: 1, 11900: 1}
+    assert s.disk_resident_rows() == 0  # everything probed faulted up
+    assert any(k[0] == "disk" and k[1] == "host"
+               for k in s.residency_stats)
+    assert s.to_dict() == ref.to_dict()
+    # truncation reaches the (faulted) cold levels too
+    s.truncate_keys_below((6000,))
+    ref.truncate_keys_below((6000,))
+    assert s.to_dict() == ref.to_dict()
+
+
+def test_host_checkpoint_never_launders_corrupt_cold_blob(tmp_path):
+    """A checkpoint save streaming-verifies disk-tier spine levels in
+    place: with a corrupted blob and no recovery source the save RAISES
+    instead of serializing the rotted bytes under a fresh valid checksum
+    (which would verify clean forever after)."""
+    from dbsp_tpu import checkpoint as ckpt
+    from dbsp_tpu.circuit import RootCircuit
+    from dbsp_tpu.operators import add_input_zset
+    from dbsp_tpu.operators.aggregate import Max
+
+    store = res.ColdStore(str(tmp_path / "cold"))
+
+    def build(c):
+        a, ha = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+        b, hb = add_input_zset(c, (jnp.int64,), (jnp.int64,))
+        j = a.join_index(b, lambda k, av, bv: (k, (av[0] + bv[0],)),
+                         (jnp.int64,), (jnp.int64,))
+        return (ha, hb), j.aggregate(Max(0)).integrate().output()
+
+    circuit, ((ha, hb), out) = RootCircuit.build(build)
+    for sp in res.circuit_spines(circuit):
+        sp.device_budget_rows = 512
+        sp.host_budget_rows = 512
+        sp.cold_store = store
+    for t in range(10):
+        ha.extend([((t * 400 + i, i % 97), 1) for i in range(400)])
+        hb.extend([((t * 400 + i, (i * 7) % 89), 1) for i in range(400)])
+        circuit.step()
+    disk_sp = next(sp for sp in res.circuit_spines(circuit)
+                   if sp.disk_resident_rows() > 0)
+    b = next(x for x in disk_sp.batches if _is_disk(x))
+    meta = disk_sp._disk_meta[id(b)]
+    p = store.blob_path(meta["weights"]["sha256"])
+    os.remove(p)
+    with open(p, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(res.ColdError):
+        ckpt.save(circuit.handle if hasattr(circuit, "handle") else
+                  _handle_of(circuit), str(tmp_path / "ck"))
+
+
+def _handle_of(circuit):
+    class _H:  # the minimal host-handle shape checkpoint._save_host reads
+        step_times_ns = []
+
+    h = _H()
+    h.circuit = circuit
+    return h
+
+
+def test_spine_corrupt_cold_blob_recovers_or_raises(tmp_path):
+    """A corrupted disk blob is NEVER silently served: with no recovery
+    source the fault raises ColdError (and reports the episode); with a
+    checkpoint generation recording the digest it re-adopts those bytes
+    (the compiled-engine end-to-end twin lives in test_residency.py)."""
+    events = []
+    store = res.ColdStore(str(tmp_path / "cold"), on_event=events.append)
+    s = Spine((jnp.int64,), (jnp.int64,), device_budget_rows=512,
+              host_budget_rows=512, cold_store=store)
+    for t in range(20):
+        s.insert(_batch(t * 300, 300, seed=t))
+    disk = [b for b in s.batches if _is_disk(b)]
+    assert disk
+    meta = s._disk_meta[id(disk[0])]
+    p = store.blob_path(meta["weights"]["sha256"])
+    os.remove(p)
+    with open(p, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(res.ColdError):
+        s.to_dict()
+    assert events and events[-1]["recovered"] is False

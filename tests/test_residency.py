@@ -1,0 +1,670 @@
+"""Tiered trace residency for the compiled engine (device <- host <- disk).
+
+The growth matrix the acceptance criteria name: the SAME circuit under
+{unbounded, tiny-device, tiny-device+disk} budgets must produce
+bit-identical outputs while device-resident rows stay provably bounded
+after every maintain interval, checkpoint saves hard-link disk-demoted
+blobs (verified by inode), restore leaves cold levels on disk, and a
+corrupted cold blob read falls back to re-promotion from the last
+checkpoint generation as one SLO-visible incident. The host-spine half
+lives in tests/test_cold_offload.py; the q4 matrix over BOTH engines
+rides the slow tier here (compiles three q4 programs).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dbsp_tpu import checkpoint as ckpt
+from dbsp_tpu import residency as res
+from dbsp_tpu.circuit import Runtime
+from dbsp_tpu.compiled import compile_circuit
+from dbsp_tpu.compiled.compiler import CompiledOverflow
+from dbsp_tpu.operators import add_input_zset
+from dbsp_tpu.operators.aggregate import Max
+from dbsp_tpu.zset.batch import Batch
+
+K = (jnp.int64,)
+V = (jnp.int64,)
+
+
+def _build(c):
+    a, ha = add_input_zset(c, K, V)
+    b, hb = add_input_zset(c, K, V)
+    j = a.join_index(b, lambda k, av, bv: (k, (av[0] + bv[0],)), K, V)
+    return (ha, hb), j.aggregate(Max(0)).integrate().output()
+
+
+def _feeds(t, ha, hb, n=400):
+    rows = [((t * n + i, i % 97), 1) for i in range(n)]
+    rb = [((t * n + i, (i * 7) % 89), 1) for i in range(n)]
+    return {ha: Batch.from_tuples(rows, K, V),
+            hb: Batch.from_tuples(rb, K, V)}
+
+
+def _step_once(ch, t, feeds):
+    """One driver-style tick: snapshot / step / validate with exact
+    replay on overflow / maintain."""
+    while True:
+        snap = ch.snapshot()
+        ch.step(tick=t, feeds=feeds, block=True)
+        try:
+            ch.validate()
+        except CompiledOverflow as e:
+            ch.grow(e)
+            ch.restore(snap)
+            continue  # exact replay of the same tick
+        ch.maintain()
+        return
+
+
+def _run_compiled(cfg, ticks=16, assert_cap=True, with_handles=False):
+    """Driver-style loop capturing per-tick outputs. Returns (outs, ch)
+    — or (outs, ch, (ha, hb), out) with ``with_handles``."""
+    handle, ((ha, hb), out) = Runtime.init_circuit(1, _build)
+    ch = compile_circuit(handle)
+    if cfg is not None:
+        ch.set_residency(cfg)
+    outs = []
+    for t in range(ticks):
+        _step_once(ch, t, _feeds(t, ha, hb))
+        outs.append(ch.output(out).to_dict())
+        if assert_cap and cfg is not None and cfg.device_rows is not None:
+            # the residency HARD CAP, after every maintain: device-resident
+            # leveled-trace capacity never exceeds the budget beyond the
+            # always-hot level 0 (written by the step program every tick)
+            for cn, key, st in ch._leveled_nodes():
+                l0 = st[0][0].cap
+                assert ch.device_resident_rows(key) <= \
+                    max(cfg.device_rows, l0), (
+                        key, ch.device_resident_rows(key),
+                        cfg.device_rows, l0)
+    if with_handles:
+        return outs, ch, (ha, hb), out
+    return outs, ch
+
+
+def _states_equal(a, b):
+    fa = [np.asarray(x) for x in jax.tree_util.tree_leaves(a)]
+    fb = [np.asarray(x) for x in jax.tree_util.tree_leaves(b)]
+    assert len(fa) == len(fb), (len(fa), len(fb))
+    for x, y in zip(fa, fb):
+        assert x.dtype == y.dtype and np.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# growth matrix (compiled, small circuit — tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_growth_matrix_bit_identical(tmp_path):
+    """{unbounded, tiny-device, tiny-device+disk}: per-tick outputs AND
+    final states bit-identical; each budgeted config's transitions are
+    non-vacuous and the unbounded control records none."""
+    outs0, ch0 = _run_compiled(None)
+    assert not ch0.residency_stats  # control: zero transitions
+
+    tiny = res.ResidencyConfig(device_rows=2048)
+    outs1, ch1 = _run_compiled(tiny)
+    assert outs1 == outs0
+    _states_equal(ch0.states, ch1.states)
+    assert any(k[:2] == ("device", "host") for k in ch1.residency_stats)
+    assert ch1.tier_rows()["host"] > 0 and ch1.tier_rows()["disk"] == 0
+
+    disk = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                               cold_dir=str(tmp_path / "cold"),
+                               lru_intervals=1)
+    outs2, ch2 = _run_compiled(disk)
+    assert outs2 == outs0
+    _states_equal(ch0.states, ch2.states)
+    stats = ch2.residency_stats
+    assert any(k[:2] == ("device", "host") for k in stats), stats
+    assert any(k[:2] == ("host", "disk") for k in stats), stats
+    # promotion observed too (maintain drains write into cold levels)
+    assert any(k[1] == "device" and k[0] in ("host", "disk")
+               for k in stats), stats
+    assert ch2.tier_rows()["disk"] > 0
+    assert os.listdir(str(tmp_path / "cold"))
+    # every transition carries a cause and the log mirrors the stats
+    assert sum(stats.values()) == len(ch2.residency_log)
+    assert all(ev["cause"] for ev in ch2.residency_log)
+
+
+def test_lazy_post_off_still_bit_identical(tmp_path):
+    """The tiering interacts with the lazy-post slotted append: force the
+    materialized post view (the PR-12 control) and assert the budgeted
+    run still matches."""
+    import dbsp_tpu.compiled.cnodes  # noqa: F401 — env read per eval
+
+    old = os.environ.get("DBSP_TPU_TRACE_LAZY_POST")
+    os.environ["DBSP_TPU_TRACE_LAZY_POST"] = "0"
+    try:
+        outs0, _ = _run_compiled(None, ticks=8)
+        cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                                  cold_dir=str(tmp_path / "c"),
+                                  lru_intervals=1)
+        outs1, _ = _run_compiled(cfg, ticks=8)
+        assert outs1 == outs0
+    finally:
+        if old is None:
+            os.environ.pop("DBSP_TPU_TRACE_LAZY_POST", None)
+        else:
+            os.environ["DBSP_TPU_TRACE_LAZY_POST"] = old
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integration: hard links by inode, restore leaves disk levels
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_mid_growth_links_cold_blobs_and_restores(tmp_path):
+    cold = str(tmp_path / "cold")
+    ckdir = str(tmp_path / "ck")
+    cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                              cold_dir=cold, lru_intervals=1)
+    outs, ch, (ha, hb), out = _run_compiled(cfg, ticks=14,
+                                            with_handles=True)
+    assert ch.tier_rows()["disk"] > 0
+    i1 = ckpt.save(ch, ckdir, tick=14)
+    # FIRST save after demotion captures the disk blobs WITHOUT
+    # re-serializing from memory — verified file COPIES (deliberately a
+    # NEW inode: a hard link to the store would let in-place bit-rot
+    # corrupt the recovery copy together with the store)
+    assert i1["copied_arrays"] > 0
+    g1 = os.path.join(ckdir, "gen-00000001")
+    for name in os.listdir(g1):
+        if not name.endswith(".npy"):
+            continue
+        p = os.path.join(g1, name)
+        for f in os.listdir(cold):
+            if f.endswith(".npy"):
+                assert not os.path.samefile(p, os.path.join(cold, f))
+    # warm save is O(hot state): the second generation HARD-LINKS the
+    # first one's cold captures (verified by inode) instead of copying
+    i2 = ckpt.save(ch, ckdir, tick=14)
+    assert i2["linked_arrays"] > 0 and i2["copied_arrays"] == 0
+    g2 = os.path.join(ckdir, "gen-00000002")
+    shared = sum(
+        1 for name in os.listdir(g2)
+        if name.endswith(".npy") and
+        os.path.exists(os.path.join(g1, name)) and
+        os.path.samefile(os.path.join(g1, name), os.path.join(g2, name)))
+    assert shared >= i2["linked_arrays"] > 0
+
+    # restore into a budgeted handle: cold levels STAY on disk and the
+    # restored pipeline continues bit-identically to the original
+    handle2, ((ha2, hb2), out2) = Runtime.init_circuit(1, _build)
+    ch2 = compile_circuit(handle2)
+    ch2.set_residency(cfg)
+    r = ckpt.restore(ch2, ckdir)
+    assert r["tick"] == 14 and r["fallback_from"] is None
+    assert ch2.tier_rows()["disk"] > 0, "restore re-materialized cold state"
+    _states_equal(ch.states, ch2.states)
+
+    # budget-less restore (legacy behavior): all device, same values
+    handle3, _ = Runtime.init_circuit(1, _build)
+    ch3 = compile_circuit(handle3)
+    ckpt.restore(ch3, ckdir)
+    tiers3 = ch3.tier_rows()
+    assert tiers3["disk"] == 0 and tiers3["host"] == 0
+    _states_equal(ch.states, ch3.states)
+
+    # continuation: original and disk-restored handles step identically
+    for t in range(14, 18):
+        _step_once(ch, t, _feeds(t, ha, hb))
+        _step_once(ch2, t, _feeds(t, ha2, hb2))
+        a = ch.output(out).to_dict()
+        b = ch2.output(out2).to_dict()
+        assert a == b, t
+    _states_equal(ch.states, ch2.states)
+
+
+def test_corrupt_cold_blob_falls_back_to_generation_incident(tmp_path):
+    """Corrupt a cold-store blob AFTER a checkpoint covered it: the next
+    verified read (a maintain-drain promotion) recovers the bytes from
+    the generation, the episode surfaces as a `restore` flight event, and
+    the SLO watchdog opens exactly one incident."""
+    from dbsp_tpu.obs.flight import CompiledFlightSource, FlightRecorder
+    from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
+
+    cold = str(tmp_path / "cold")
+    ckdir = str(tmp_path / "ck")
+    cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                              cold_dir=cold, lru_intervals=1)
+    outs, ch = _run_compiled(cfg, ticks=14)
+    ckpt.save(ch, ckdir, tick=14)
+    # reference twin for bit-identity after recovery
+    outs_ref, ch_ref = _run_compiled(None, ticks=14)
+
+    key, k, ent = next(
+        (key, k, ent) for key, m in ch._cold_meta.items()
+        for k, ent in m.items())
+    blob = ent["blob"]["weights"]
+    p = ch._store().blob_path(blob["sha256"])
+    os.remove(p)
+    with open(p, "wb") as f:
+        f.write(b"garbage")  # replaced file: the gen's hard link survives
+
+    # force the promotion (verified read) the next drain would perform
+    st = ch.states[key]
+    levels = list(st[0])
+    tiers = list(ch._tiers[key])
+    ch._promote_level(ch.by_index[int(key)], key, levels, tiers, k,
+                      cause="maintain")
+    ch._tiers[key] = tiers
+    ch.states[key] = (tuple(levels), st[1])
+    ch._step_jit = None
+
+    # recovered from the checkpoint generation, bit-identically
+    assert ch.cold_events and ch.cold_events[-1]["recovered"] is True
+    _states_equal(ch.states, ch_ref.states)
+
+    # ... and the episode is SLO-visible: flight `restore` event -> one
+    # one-shot incident
+    rec = FlightRecorder()
+    src = CompiledFlightSource(ch, rec)
+    src.poll()
+    evs = rec.events(kinds=("restore",))
+    assert evs and evs[-1]["ok"] is True and evs[-1]["cold_blob"]
+    dog = SLOWatchdog(rec, SLOConfig.from_dict(None))
+    opened = dog.evaluate()
+    assert any(i["slo"] == "restore" for i in opened)
+
+
+# ---------------------------------------------------------------------------
+# unified knobs: one config point, both engines
+# ---------------------------------------------------------------------------
+
+
+def test_in_place_bit_rot_recovers_from_generation(tmp_path):
+    """In-place corruption (the classic bit-rot shape — SAME inode, no
+    file replacement) must still recover: the generation holds an
+    independent COPY of each cold blob, not a hard link that would rot
+    together with the store."""
+    cold = str(tmp_path / "cold")
+    ckdir = str(tmp_path / "ck")
+    cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                              cold_dir=cold, lru_intervals=1)
+    outs, ch = _run_compiled(cfg, ticks=14)
+    ckpt.save(ch, ckdir, tick=14)
+    outs_ref, ch_ref = _run_compiled(None, ticks=14)
+
+    key, k, ent = next((key, k, ent)
+                       for key, m in ch._cold_meta.items()
+                       for k, ent in m.items())
+    p = ch._store().blob_path(ent["blob"]["weights"]["sha256"])
+    with open(p, "r+b") as f:  # flip one byte IN PLACE — inode unchanged
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    st = ch.states[key]
+    levels, tiers = list(st[0]), list(ch._tiers[key])
+    ch._promote_level(ch.by_index[int(key)], key, levels, tiers, k,
+                      "maintain")
+    ch._tiers[key] = tiers
+    ch.states[key] = (tuple(levels), st[1])
+    assert ch.cold_events and ch.cold_events[-1]["recovered"] is True
+    _states_equal(ch.states, ch_ref.states)
+
+
+def test_set_residency_rehomes_cold_store(tmp_path):
+    """Applying a config with an explicit cold_dir after blobs already
+    landed elsewhere must re-home the disk tier — leaving them in the
+    implicit temp store would be the accepted-but-ignored key again."""
+    first = str(tmp_path / "first")
+    second = str(tmp_path / "second")
+    cfg1 = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                               cold_dir=first, lru_intervals=1)
+    outs, ch = _run_compiled(cfg1, ticks=12)
+    assert ch.tier_rows()["disk"] > 0
+    cfg2 = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                               cold_dir=second, lru_intervals=1)
+    ch.set_residency(cfg2)
+    # the old store owns nothing the engine still points at
+    assert ch.tier_rows()["disk"] == 0 or \
+        ch._store().path == second
+    for m in ch._cold_meta.values():
+        for ent in m.values():
+            assert ent["batch"].weights.filename.startswith(second)
+    # and the state is unchanged
+    outs0, ch0 = _run_compiled(None, ticks=12)
+    _states_equal(ch0.states, ch.states)
+
+
+def test_controller_config_routes_budgets_to_host_spines(tmp_path):
+    from dbsp_tpu.io import Catalog, build_controller
+    from dbsp_tpu.operators import Count
+
+    def build(c):
+        s, h = add_input_zset(c, K, V)
+        return h, s.aggregate(Count()).integrate().output()
+
+    handle, (h, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    catalog.register_input("events", h, (jnp.int64, jnp.int64))
+    catalog.register_output("counts", out, (jnp.int64, jnp.int64))
+    build_controller(handle, catalog,
+                     {"device_rows": 4096, "host_rows": 8192,
+                      "cold_dir": str(tmp_path / "cold")})
+    spines = res.circuit_spines(handle.circuit)
+    assert spines
+    for sp in spines:
+        assert sp.device_budget_rows == 4096
+        assert sp.host_budget_rows == 8192
+        assert sp.cold_store is not None
+        assert sp.cold_store.path == str(tmp_path / "cold")
+
+
+def test_controller_config_routes_budgets_to_compiled(tmp_path):
+    from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+    from dbsp_tpu.io import Catalog, Controller
+    from dbsp_tpu.io.controller import ControllerConfig
+
+    handle, ((ha, hb), out) = Runtime.init_circuit(1, _build)
+    drv = CompiledCircuitDriver(handle)
+    catalog = Catalog()
+    ctl = Controller(drv, catalog, ControllerConfig(
+        device_rows=4096, host_rows=8192,
+        cold_dir=str(tmp_path / "cold")))
+    assert drv.ch.residency_cfg.device_rows == 4096
+    assert drv.ch.residency_cfg.host_rows == 8192
+    assert drv.ch.residency_cfg.cold_dir == str(tmp_path / "cold")
+
+
+def test_env_knob_now_engages_the_compiled_engine(monkeypatch):
+    """DBSP_TPU_DEVICE_ROWS was host-Spine-only before this PR; the
+    compiled engine now honors the same knob by default."""
+    monkeypatch.setattr(res, "DEVICE_ROWS", 2048)
+    handle, _ = Runtime.init_circuit(1, _build)
+    ch = compile_circuit(handle)
+    assert ch.residency_cfg.device_rows == 2048
+    assert ch.residency_cfg.active
+
+
+def test_config_key_can_disable_env_budget(monkeypatch):
+    """An explicit <= 0 config value must DISABLE an env-set budget, not
+    silently keep it (resolve()'s contract)."""
+    monkeypatch.setattr(res, "DEVICE_ROWS", 2048)
+    cfg = res.resolve(device_rows=0)
+    assert cfg.device_rows is None
+    cfg = res.resolve()
+    assert cfg.device_rows == 2048
+
+
+def test_disable_config_reaches_engine_and_promotes_back(monkeypatch):
+    """The controller applies an INACTIVE resolved config too: a config
+    key <= 0 must actually strip the env budget off the engine (the
+    accepted-but-ignored failure, in reverse) — and a handle whose
+    budgets are disabled mid-run promotes its cold levels back instead
+    of stranding them."""
+    from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+    from dbsp_tpu.io import Catalog, Controller
+    from dbsp_tpu.io.controller import ControllerConfig
+
+    monkeypatch.setattr(res, "DEVICE_ROWS", 2048)
+    handle, ((ha, hb), out) = Runtime.init_circuit(1, _build)
+    drv = CompiledCircuitDriver(handle)
+    assert drv.ch.residency_cfg.active  # picked up the env knob
+    Controller(drv, Catalog(), ControllerConfig(device_rows=0))
+    assert not drv.ch.residency_cfg.active  # config key disabled it
+
+    # mid-run disable: cold levels promote back to device
+    outs, ch = _run_compiled(res.ResidencyConfig(device_rows=2048),
+                             ticks=10)
+    assert ch.tier_rows()["host"] > 0
+    ch.set_residency(res.ResidencyConfig())
+    assert not ch._tiers
+    tiers = ch.tier_rows()
+    assert tiers["host"] == 0 and tiers["disk"] == 0
+    # and the state is still exactly the unbudgeted run's
+    outs0, ch0 = _run_compiled(None, ticks=10)
+    _states_equal(ch0.states, ch.states)
+
+
+def test_sharded_handles_decline_residency(monkeypatch):
+    monkeypatch.setattr(res, "DEVICE_ROWS", 64)
+    handle, _ = Runtime.init_circuit(1, _build)
+    ch = compile_circuit(handle)
+    ch.workers = 2
+    ch.mesh = object()  # simulate a mesh without building one
+    assert ch._enforce_residency() is False
+    assert not ch._tiers
+
+
+# ---------------------------------------------------------------------------
+# observability: gauges + transitions exported, flight events polled
+# ---------------------------------------------------------------------------
+
+
+def test_residency_metrics_and_flight_events(tmp_path):
+    from dbsp_tpu.obs import MetricsRegistry
+    from dbsp_tpu.obs.export import prometheus_text
+    from dbsp_tpu.obs.flight import CompiledFlightSource, FlightRecorder
+    from dbsp_tpu.obs.instrument import CompiledInstrumentation
+
+    cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                              cold_dir=str(tmp_path / "cold"),
+                              lru_intervals=1)
+    outs, ch = _run_compiled(cfg, ticks=12)
+
+    class _Drv:  # minimal driver facade for the instrumentation
+        _tick = 12
+        step_latencies_ns = ch.step_times_ns
+
+    drv = _Drv()
+    drv.ch = ch
+    reg = MetricsRegistry()
+    CompiledInstrumentation(drv, reg)
+    text = prometheus_text(reg)
+    assert 'dbsp_tpu_trace_tier_resident_rows{' in text
+    assert 'tier="disk"' in text and 'tier="device"' in text
+    assert 'dbsp_tpu_trace_residency_transitions_total{' in text
+    assert 'cause="budget"' in text
+
+    rec = FlightRecorder(capacity=8192)
+    CompiledFlightSource(ch, rec).poll()
+    evs = rec.events(kinds=("residency",))
+    assert evs, "transitions were not polled into flight events"
+    assert all(e["tier_from"] in res.TIERS and e["tier_to"] in res.TIERS
+               and e["cause"] for e in evs)
+    assert len(evs) == len(ch.residency_log)
+
+
+def test_host_residency_flight_events(tmp_path):
+    """The host engine's transitions surface through HostFlightSource."""
+    from dbsp_tpu.obs.flight import FlightRecorder, HostFlightSource
+    from dbsp_tpu.trace import spine as spine_mod
+
+    store = res.ColdStore(str(tmp_path / "cold"))
+
+    def build(c):
+        a, ha = add_input_zset(c, K, V)
+        b, hb = add_input_zset(c, K, V)
+        j = a.join_index(b, lambda k, av, bv: (k, (av[0] + bv[0],)), K, V)
+        return (ha, hb), j.aggregate(Max(0)).integrate().output()
+
+    handle, ((ha, hb), out) = Runtime.init_circuit(1, build)
+    for sp in res.circuit_spines(handle.circuit):
+        sp.device_budget_rows = 1024
+        sp.host_budget_rows = 1024
+        sp.cold_store = store
+    rec = FlightRecorder(capacity=8192)
+    HostFlightSource(handle.circuit, rec)
+    for t in range(10):
+        f = _feeds(t, ha, hb)
+        for h, b in f.items():
+            h.push_batch(b)
+        handle.step()
+    evs = rec.events(kinds=("residency",))
+    assert evs
+    assert all(e["tier_from"] in res.TIERS and e["cause"] for e in evs)
+
+
+def test_cold_blob_lifecycle_bounded_and_replay_safe(tmp_path):
+    """Blob GC: demote/promote churn must not leak one level-copy per
+    churn (refcounted blobs, swept at snapshot boundaries), and the sweep
+    must never delete content an overflow replay can still fault — the
+    stale-meta identity guard reconstructs verified metas from the
+    content-addressed filenames."""
+    cold = str(tmp_path / "cold")
+    cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                              cold_dir=cold, lru_intervals=1)
+    handle, ((ha, hb), out) = Runtime.init_circuit(1, _build)
+    ch = compile_circuit(handle)
+    ch.set_residency(cfg)
+    counts = []
+    for t in range(20):
+        _step_once(ch, t, _feeds(t, ha, hb))
+        ch._sweep_cold()  # what run_ticks/driver do at snapshot points
+        counts.append(len([f for f in os.listdir(cold)
+                           if f.endswith(".npy")]))
+    # live disk state is bounded, so the store must be too: the file
+    # count settles instead of growing by one level-copy per interval
+    assert counts[-1] <= counts[len(counts) // 2] + 4, counts
+    # every live meta's blobs exist (the sweep never ate live content)
+    for m in ch._cold_meta.values():
+        for ent in m.values():
+            for col in (*ent["blob"]["keys"], *ent["blob"]["vals"],
+                        ent["blob"]["weights"]):
+                assert os.path.exists(
+                    ch._store().blob_path(col["sha256"]))
+    # stale-meta replay: rewind to a snapshot whose disk level the
+    # bookkeeping no longer describes, then force the promotion — the
+    # identity guard must fault the SNAPSHOT's content, not the meta's
+    snap = ch.snapshot()
+    key, k, ent = next((key, k, ent)
+                       for key, m in ch._cold_meta.items()
+                       for k, ent in m.items())
+    old_level = snap[key][0][k]
+    assert isinstance(old_level.weights, np.memmap)
+    # advance: drains/demotions replace the level and its meta
+    for t in range(20, 26):
+        _step_once(ch, t, _feeds(t, ha, hb))
+    ch.restore(snap)
+    st = ch.states[key]
+    levels, tiers = list(st[0]), list(ch._tiers[key])
+    if tiers[k] != res.TIER_DEVICE:
+        want = np.array(levels[k].weights)
+        ch._promote_level(ch.by_index[int(key)], key, levels, tiers, k,
+                          "maintain")
+        assert np.array_equal(np.asarray(levels[k].weights), want)
+
+
+# ---------------------------------------------------------------------------
+# committed A/B evidence gate
+# ---------------------------------------------------------------------------
+
+
+def test_committed_growth_ab_pair():
+    """The committed BENCH_GROWTH=1 A/B pair (tiny-budget vs unbounded,
+    same host, interleaved, median-of-3-round-ratios pair): outputs
+    bit-identical (matching final-output digests), device residency
+    bounded by the per-trace budget for the whole run, transitions
+    attributed in both demotion directions plus a promotion, disk tier
+    non-empty, and steady-state decay <= 2x vs the unbounded control."""
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_local_residency.json")) as f:
+        tiny = json.load(f)["detail"]["queries"]["q4"]
+    with open(os.path.join(root, "BENCH_local_residency_off.json")) as f:
+        off = json.load(f)["detail"]["queries"]["q4"]
+    # bit-identity across the pair (and the same protocol/seed)
+    assert tiny["final_output_sha256"] == off["final_output_sha256"]
+    assert tiny["events"] == off["events"]
+    r = tiny["residency"]
+    assert r["device_rows_budget"] and r["device_bound_ok"]
+    trans = r["transitions"]
+    assert any(k.startswith("device>host") for k in trans), trans
+    assert any(k.startswith("host>disk") for k in trans), trans
+    assert any(">device:" in k for k in trans), trans
+    assert r["final_tier_rows"]["disk"] > 0
+    assert "residency" not in off  # the control never tiered
+    decay = off["steady_state_events_per_s"] / \
+        tiny["steady_state_events_per_s"]
+    assert decay <= 2.0, decay
+
+
+# ---------------------------------------------------------------------------
+# q4 growth matrix over BOTH engines (slow: three compiled q4 programs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_q4_growth_matrix_host_and_compiled(tmp_path, monkeypatch):
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, device_gen, queries)
+    from dbsp_tpu.trace import spine as spine_mod
+
+    CFG = GeneratorConfig(seed=1)
+    EPT = 8
+    TICKS = 4
+
+    def q4_build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    def host_run(device_rows, host_rows, cold_dir):
+        monkeypatch.setattr(spine_mod, "DEVICE_BUDGET_ROWS", device_rows)
+        monkeypatch.setattr(spine_mod, "HOST_BUDGET_ROWS", host_rows)
+        gen = NexmarkGenerator(CFG)
+        handle, (handles, out) = Runtime.init_circuit(1, q4_build)
+        if cold_dir:
+            store = res.ColdStore(cold_dir)
+            for sp in res.circuit_spines(handle.circuit):
+                sp.cold_store = store
+        outs, n = [], 0
+        for _ in range(TICKS):
+            gen.feed(handles, n, n + EPT * 50)
+            handle.step()
+            b = out.take()
+            outs.append(b.to_dict() if b is not None else {})
+            n += EPT * 50
+        spines = res.circuit_spines(handle.circuit)
+        return outs, spines
+
+    def compiled_run(cfg):
+        handle, (handles, out) = Runtime.init_circuit(1, q4_build)
+        hp, ha, hb = handles
+
+        def gen_fn(tick):
+            p, a, b = device_gen.generate_tick(CFG, tick * EPT, EPT)
+            return {hp: p, ha: a, hb: b}
+
+        ch = compile_circuit(handle, gen_fn=gen_fn)
+        if cfg is not None:
+            ch.set_residency(cfg)
+        outs = {}
+
+        def capture(next_tick):
+            b = ch.output(out)
+            outs[next_tick - 1] = b.to_dict() if b is not None else {}
+            if cfg is not None and cfg.device_rows is not None:
+                for cn, key, st in ch._leveled_nodes():
+                    l0 = st[0][0].cap
+                    assert ch.device_resident_rows(key) <= \
+                        max(cfg.device_rows, l0)
+
+        ch.run_ticks(0, TICKS, validate_every=1, on_validated=capture)
+        return [outs.get(t, {}) for t in range(TICKS)], ch
+
+    host_ref, _ = host_run(None, None, None)
+    tiny_h, spines = host_run(512, 512, str(tmp_path / "hc"))
+    assert tiny_h == host_ref
+    assert any(sp.residency_stats for sp in spines)
+
+    comp_ref, ch0 = compiled_run(None)
+    assert comp_ref == host_ref
+    assert not ch0.residency_stats
+    cfg = res.ResidencyConfig(device_rows=2048, host_rows=2048,
+                              cold_dir=str(tmp_path / "cc"),
+                              lru_intervals=1)
+    comp_b, chb = compiled_run(cfg)
+    assert comp_b == host_ref
+    assert chb.residency_stats
+    _states_equal(ch0.states, chb.states)

@@ -70,12 +70,12 @@ import jax.export
 
 t1 = time.time()
 exported = jax.export.export(step)(
-    ch.states, jnp.asarray(1, jnp.int64), {})
+    ch.states, jnp.asarray(1, jnp.int64), {}, {})
 blob = exported.serialize()
 os.makedirs(%(artdir)r, exist_ok=True)
 with open(%(artpath)r, "wb") as f:
     f.write(blob)
-comp = step.lower(ch.states, jnp.asarray(1, jnp.int64), {}).compile()
+comp = step.lower(ch.states, jnp.asarray(1, jnp.int64), {}, {}).compile()
 ca = comp.cost_analysis()
 if isinstance(ca, list):
     ca = ca[0]

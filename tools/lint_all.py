@@ -49,6 +49,13 @@ the tier-1 test in tests/test_analysis.py):
    so every A/B control knob bench.py leans on is proven live, not
    vacuous. The import-based tier-1 consumer is tests/test_fused_ladder
    .py::test_compiled_q4_dispatches_fused_ladder_kernels.
+4c. **Residency front** (CLI only; DBSP_TPU_LINT_RESIDENCY=0 skips) — a
+   q4 compiled growth dryrun in a subprocess under a deliberately tiny
+   DBSP_TPU_DEVICE_ROWS/_HOST_ROWS must observe residency transitions in
+   both demotion directions (device->host, host->disk) with a non-empty
+   disk tier, and the unbounded control run must observe NONE — the
+   tiered-residency budgets and their A/B control are proven live. The
+   import-based tier-1 consumer is tests/test_residency.py.
 5. **Profiler dryrun** (CLI only; DBSP_TPU_LINT_PROFILE=0 skips) —
    ``opprofile.dryrun("q4")`` in a subprocess: one measured segmented
    profile end to end, red on schema drift, segmented/fused divergence,
@@ -470,6 +477,125 @@ def run_kernel_dryrun() -> list:
     return violations
 
 
+def _residency_dryrun_child() -> None:
+    """Subprocess body for the residency front: run a q4 compiled growth
+    dryrun under whatever residency env the parent set and print the
+    transition counts + the max observed device-resident rows as JSON."""
+    import json
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import compile_circuit
+    from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
+                                  queries)
+
+    cfg = GeneratorConfig(seed=3)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * 8, 8)
+        return {hp: p, ha: a, hb: b}
+
+    ch = compile_circuit(handle, gen_fn=gen_fn)
+    max_device = 0
+
+    def watch(next_tick):
+        nonlocal max_device
+        max_device = max(max_device, ch.tier_rows()["device"])
+
+    ch.run_ticks(0, 4, validate_every=1, on_validated=watch)
+    print(json.dumps({
+        "budget": ch.residency_cfg.device_rows,
+        "max_device_rows": int(max_device),
+        "final_tiers": {k: int(v) for k, v in ch.tier_rows().items()},
+        "transitions": {f"{f}>{t}:{c}": int(n) for (f, t, c), n in
+                        sorted(ch.residency_stats.items())}}))
+
+
+def run_residency_dryrun() -> list:
+    """7. **Residency front** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_RESIDENCY=0`` skips — tests/test_residency.py carries
+    the import-based tier-1 coverage): a q4 growth dryrun under a
+    deliberately tiny DBSP_TPU_DEVICE_ROWS/_HOST_ROWS must observe
+    transitions in BOTH demotion directions (device->host, host->disk)
+    with the disk tier non-empty, while the unbounded control run
+    observes none — proving the budget path and its A/B control are both
+    live, not silently wired to a no-op."""
+    import json
+    import subprocess
+    import tempfile
+
+    if os.environ.get("DBSP_TPU_LINT_RESIDENCY", "1") == "0":
+        print("lint_all: residency_dryrun: skipped "
+              "(DBSP_TPU_LINT_RESIDENCY=0)")
+        return []
+
+    def child(extra_env):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+        for k in ("DBSP_TPU_DEVICE_ROWS", "DBSP_TPU_HOST_ROWS",
+                  "DBSP_TPU_COLD_DIR"):
+            env.pop(k, None)
+            if k in extra_env:
+                env[k] = extra_env[k]
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "from tools.lint_all import _residency_dryrun_child; "
+                 "_residency_dryrun_child()"],
+                cwd=_ROOT, env=env, capture_output=True, text=True,
+                timeout=600)
+        except subprocess.TimeoutExpired:
+            return None, "residency dryrun timed out after 600s"
+        if p.returncode != 0:
+            return None, (f"residency dryrun failed:\n{p.stdout[-800:]}\n"
+                          f"{p.stderr[-800:]}")
+        try:
+            return json.loads(p.stdout.strip().splitlines()[-1]), None
+        except (ValueError, IndexError):
+            return None, f"residency dryrun emitted no JSON:\n" \
+                         f"{p.stdout[-400:]}"
+
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="lint-cold-") as cold:
+        tiny, err = child({"DBSP_TPU_DEVICE_ROWS": "512",
+                           "DBSP_TPU_HOST_ROWS": "512",
+                           "DBSP_TPU_COLD_DIR": cold})
+        if err:
+            return [err]
+        trans = tiny.get("transitions", {})
+        if not any(k.startswith("device>host") for k in trans):
+            violations.append(
+                f"tiny-budget q4 dryrun never demoted device->host "
+                f"({json.dumps(tiny)}) — the compiled residency budget "
+                "is silently ignored")
+        if not any(k.startswith("host>disk") for k in trans):
+            violations.append(
+                f"tiny-budget q4 dryrun never demoted host->disk "
+                f"({json.dumps(tiny)}) — the disk tier is dead")
+        if not tiny.get("final_tiers", {}).get("disk"):
+            violations.append(
+                f"tiny-budget q4 dryrun ended with an empty disk tier "
+                f"({json.dumps(tiny)})")
+    control, err = child({})
+    if err:
+        return violations + [err]
+    if control.get("transitions"):
+        violations.append(
+            f"unbounded control run recorded residency transitions "
+            f"({json.dumps(control)}) — the budget engages without being "
+            "configured, every unbudgeted pipeline would pay the tiering")
+    return violations
+
+
 def run_profile_dryrun() -> list:
     """5. **Profiler dryrun** (subprocess; CLI runs it by default,
     ``DBSP_TPU_LINT_PROFILE=0`` skips — tests/test_opprofile.py carries
@@ -533,6 +659,7 @@ def main() -> int:
               ("analyzer_selfcheck", run_analyzer_selfcheck),
               ("multichip", run_multichip),
               ("kernel_dryrun", run_kernel_dryrun),
+              ("residency", run_residency_dryrun),
               ("profile_dryrun", run_profile_dryrun),
               ("lineage_dryrun", run_lineage_dryrun)]
     failed = 0
