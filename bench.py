@@ -632,6 +632,31 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
         spike_ns = 3 * p50_ns * (validate_every if scan else 1)
         detail["spike_causes"] = spike_causes(
             rec.events(kinds=("tick",)), spike_ns)
+        # EXPLAIN SPIKE (obs/timeline.py): the served-pipeline attribution
+        # pass over the same flight stream — outlier ticks against the
+        # robust rolling baseline, each with ranked co-timed evidence.
+        # Embedded per query so BENCH rows carry the serving stack's
+        # answer to "which ticks spiked and why", not only the 3x-p50
+        # histogram above.
+        from dbsp_tpu.obs.timeline import Timeline
+
+        tl = Timeline(capacity=2 * (len(samples) + n_phase) + 256,
+                      enabled=True)
+        tl.ingest_flight(rec)
+        sp = tl.explain_spikes()
+        detail["timeline"] = {
+            "ticks_seen": sp["ticks_seen"],
+            "spikes": [{"tick": s["tick"],
+                        "latency_ms": round(s["latency_ns"] / 1e6, 2),
+                        "baseline_ms": round(s["baseline_ns"] / 1e6, 2),
+                        "cause": s["cause"],
+                        "evidence": [{"cause": e["cause"],
+                                      "score_ms": round(
+                                          e["score_ns"] / 1e6, 2),
+                                      "count": e["count"]}
+                                     for e in s["evidence"][:3]]}
+                       for s in sp["spikes"][-16:]],
+        }
         if os.environ.get("BENCH_SLO"):
             detail["slo"] = _eval_slo(rec)
         detail["host_overhead_ms"] = {

@@ -170,6 +170,7 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "_outputs": "config",
         "_snap": "derived",       # rebuilt from restored state on resume
         "_out_buffer": "derived",  # rebuilt by replaying _retained
+        "_interval_open_ts": "derived",  # wall-clock restamped on resume
         "spans": "runtime",
     },
     "Controller": {
@@ -194,6 +195,7 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "_lifecycle_lock": "runtime",
         "_monitors": "runtime",
         "flight": "runtime",
+        "timeline": "runtime",   # obs wiring; its ring is rebuilt live
         "checkpoints": "derived",
         "checkpoint_error": "derived",
         "last_checkpoint_tick": "persisted",
@@ -206,6 +208,7 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "collection": "config",
         "transport": "config",
         "parser": "config",
+        "notify_arrival": "config",  # freshness stamp hook (controller)
         "lock": "runtime",
         "rows": "derived",    # in-flight rows not yet stepped: upstream
         "eoi": "derived",     # replays them past the checkpoint tick

@@ -90,6 +90,34 @@ class PipelineHandle:
         q = f"?n={n}" if n is not None else ""
         return _req(f"{self.base}/flight{q}")
 
+    def timeline(self, since: int = 0, view: Optional[str] = None,
+                 n: Optional[int] = None) -> dict:
+        """The unified per-tick timeline (README §Observability):
+        {"capacity", "enabled", "last_seq", "dropped", "truncated",
+        "freshness", "records": [...]} — tick latency/rows/queue depth,
+        flight events, freshness samples, and SLO incidents in one
+        time-indexed ring. ``since`` (a record seq) makes polling
+        incremental; ``view`` filters freshness records to one view;
+        ``n`` caps to the most recent records. Quiesce-free server-side:
+        the read never takes the pipeline's step lock."""
+        qs = [f"since={since}"] if since else []
+        if view is not None:
+            qs.append(f"view={quote(view, safe='')}")
+        if n is not None:
+            qs.append(f"n={n}")
+        q = ("?" + "&".join(qs)) if qs else ""
+        return _req(f"{self.base}/timeline{q}")
+
+    def explain_spike(self, n: Optional[int] = None) -> dict:
+        """EXPLAIN SPIKE (``GET /spikes``): outlier ticks selected against
+        a robust rolling baseline (median + MAD), each attributed to a
+        cause from ``obs.timeline.SPIKE_CAUSES`` with ranked co-timed
+        evidence (maintain drain, retrace, overflow replay, checkpoint
+        write, residency fault, transport stall, GC). ``n`` caps to the
+        most recent spikes."""
+        q = f"?n={n}" if n is not None else ""
+        return _req(f"{self.base}/spikes{q}")
+
     def incidents(self, with_window: bool = True) -> dict:
         """SLO status + captured incidents: {"status": {...},
         "incidents": [{slo, cause, observed, threshold, window, trace,
@@ -299,6 +327,25 @@ class Connection:
         (same semantics as :meth:`PipelineHandle.why`)."""
         q = _lineage_qs(view, key) + (f"&n={n}" if n is not None else "")
         return _req(f"{self.base}/pipelines/{name}/lineage{q}")
+
+    def timeline_pipeline(self, name: str, since: int = 0,
+                          view: Optional[str] = None,
+                          n: Optional[int] = None) -> dict:
+        """Manager-side timeline read: GET /pipelines/<name>/timeline
+        (same semantics as :meth:`PipelineHandle.timeline`)."""
+        qs = [f"since={since}"] if since else []
+        if view is not None:
+            qs.append(f"view={quote(view, safe='')}")
+        if n is not None:
+            qs.append(f"n={n}")
+        q = ("?" + "&".join(qs)) if qs else ""
+        return _req(f"{self.base}/pipelines/{name}/timeline{q}")
+
+    def spikes_pipeline(self, name: str, n: Optional[int] = None) -> dict:
+        """Manager-side EXPLAIN SPIKE: GET /pipelines/<name>/spikes (same
+        semantics as :meth:`PipelineHandle.explain_spike`)."""
+        q = f"?n={n}" if n is not None else ""
+        return _req(f"{self.base}/pipelines/{name}/spikes{q}")
 
     def checkpoint_pipeline(self, name: str) -> dict:
         """Manager-side checkpoint trigger: POST

@@ -105,10 +105,27 @@ class CompiledCircuitDriver:
         self._snap = None
         self._retained: List[Tuple[int, Dict]] = []
         self._out_buffer: List[Dict[int, object]] = []
+        # wall-time the current deferred-validation interval opened (first
+        # retained tick) — None when no interval is open. Drives the
+        # /status ``open_interval_age_s`` freshness surface.
+        self._interval_open_ts: Optional[float] = None
 
     @property
     def step_latencies_ns(self):
         return self.ch.step_times_ns
+
+    @property
+    def interval_open(self) -> bool:
+        """True while ticks sit in an unvalidated interval — their outputs
+        are not yet visible to readers (cadence > 1 only)."""
+        return bool(self._retained)
+
+    @property
+    def open_interval_age_s(self) -> Optional[float]:
+        """Seconds since the open deferred-validation interval started, or
+        None when every delivered tick has validated (interval closed)."""
+        ts = self._interval_open_ts
+        return None if ts is None else max(0.0, time.time() - ts)
 
     def step(self) -> None:
         """One serving tick: drain input buffers -> compiled step ->
@@ -130,6 +147,7 @@ class CompiledCircuitDriver:
             # the previous interval's snapshot is gone: zero-reference
             # cold blobs can be swept without endangering any replay
             self.ch._sweep_cold()
+            self._interval_open_ts = time.time()
         self._retained.append((self._tick, feeds))
         with (spans.span("compiled_step", cat="compiled") if spans
               is not None else contextlib.nullcontext()):
@@ -187,6 +205,7 @@ class CompiledCircuitDriver:
         self._out_buffer.clear()
         self._retained.clear()
         self._snap = None
+        self._interval_open_ts = None
 
     def flush(self) -> None:
         """Force validation/delivery of a partially-filled interval (the
@@ -240,12 +259,14 @@ class CompiledCircuitDriver:
         self._snap = None
         self._retained = []
         self._out_buffer = []
+        self._interval_open_ts = None
         self._tick = int(tick)
         for t, feeds_by_idx in retained:
             feeds = {self.ch.by_index[i].op: b
                      for i, b in feeds_by_idx.items()}
             if not self._retained:
                 self._snap = self.ch.snapshot()
+                self._interval_open_ts = time.time()
             self._retained.append((t, feeds))
             self.ch.step(tick=t, feeds=feeds)
             self._out_buffer.append(dict(self.ch.last_outputs))

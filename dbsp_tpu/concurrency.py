@@ -94,6 +94,7 @@ CONCURRENCY_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("dbsp_tpu/obs/flight.py", "CompiledFlightSource"),
     ("dbsp_tpu/obs/flight.py", "ControllerFlightSource"),
     ("dbsp_tpu/obs/flight.py", "HostFlightSource"),
+    ("dbsp_tpu/obs/timeline.py", "Timeline"),
     ("dbsp_tpu/obs/slo.py", "SLOConfig"),
     ("dbsp_tpu/obs/slo.py", "SLOWatchdog"),
     ("dbsp_tpu/obs/registry.py", "MetricsRegistry"),
@@ -154,12 +155,17 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "_last_ckpt_step": "writelock(_step_lock)",
         "flight": "gil-atomic: wired once by PipelineObs.attach_controller "
                   "before start(); read-only afterwards",
+        "timeline": "gil-atomic: wired once by PipelineObs."
+                    "attach_controller before start(); read-only "
+                    "afterwards (note_* calls go through the timeline's "
+                    "own lock)",
     },
     "_InputEndpoint": {
         "name": "immutable",
         "collection": "immutable",
         "transport": "immutable",
         "parser": "immutable",
+        "notify_arrival": "immutable",
         "lock": "immutable",
         "rows": "lock(lock)",
         "skip_rows": "lock(lock)",
@@ -311,6 +317,25 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "_ring": "lock(_lock)",
         "_seq": "lock(_lock)",
         "dropped": "writelock(_lock)",
+        "dropped_by_source": "lock(_lock)",
+    },
+    "Timeline": {
+        "capacity": "immutable",
+        "enabled": "immutable",
+        "pipeline": "immutable",
+        "_lock": "immutable",
+        "_records": "lock(_lock)",
+        "_seq": "lock(_lock)",
+        "dropped": "writelock(_lock)",
+        "_flight_seen": "lock(_lock)",
+        "_pending_rows": "lock(_lock)",
+        "_oldest_pending_ts": "lock(_lock)",
+        "_last_visible_ts": "lock(_lock)",
+        "_freshness": "lock(_lock)",
+        "_spike_metric_seen": "lock(_lock)",
+        "_fresh_hist": "immutable",
+        "_stale_gauge": "immutable",
+        "_spike_counter": "immutable",
     },
     "CompiledFlightSource": {
         "ch": "immutable",

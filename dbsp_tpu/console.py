@@ -11,9 +11,11 @@ collections, and peek output views — all over the existing REST surfaces
 its execution mode (``host`` rows carry the recorded compiled->host
 fallback reason as a tooltip), its SLO health (ok/degraded/unhealthy from
 the flight-recorder watchdog, obs/slo.py), and the latest incident's
-attributed cause; the Incidents/Flight/Profile buttons fetch the
-corresponding pipeline-server routes (Profile = the unified operator-
-attribution report, obs/opprofile.py)."""
+attributed cause; the Incidents/Flight/Timeline/Spikes/Profile buttons
+fetch the corresponding pipeline-server routes (Timeline/Spikes = the
+unified per-tick timeline and its EXPLAIN SPIKE attribution,
+obs/timeline.py; Profile = the unified operator-attribution report,
+obs/opprofile.py)."""
 
 CONSOLE_HTML = r"""<!doctype html>
 <html>
@@ -86,6 +88,8 @@ CONSOLE_HTML = r"""<!doctype html>
     <button onclick="readFleetMetrics()">Fleet metrics</button>
     <button onclick="readIncidents()">Incidents</button>
     <button onclick="readFlight()">Flight</button>
+    <button onclick="readTimeline()">Timeline</button>
+    <button onclick="readSpikes()">Spikes</button>
     <button onclick="readFleetHealth()">Fleet health</button>
     <button onclick="readProfile()">Profile</button>
     <button onclick="readDebug()">Debug</button>
@@ -229,6 +233,15 @@ async function readIncidents() {
 }
 async function readFlight() {
   show(await j(`http://127.0.0.1:${val('ioport')}/flight?n=64`));
+}
+// unified timeline + EXPLAIN SPIKE (dbsp_tpu.obs.timeline): tick
+// latency/rows/queue depth + flight events + freshness in one ring, and
+// the outlier ticks attributed against the robust rolling baseline
+async function readTimeline() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/timeline?n=64`));
+}
+async function readSpikes() {
+  show(await j(`http://127.0.0.1:${val('ioport')}/spikes`));
 }
 async function readFleetHealth() {
   show(await j('/health'));

@@ -59,11 +59,14 @@ class ControllerConfig:
 
 class _InputEndpoint:
     def __init__(self, name: str, collection, transport: InputTransport,
-                 parser):
+                 parser, notify_arrival=None):
         self.name = name
         self.collection = collection
         self.transport = transport
         self.parser = parser
+        # freshness stamp hook (Controller._note_arrival): called with the
+        # row count of each arriving chunk, outside the endpoint lock
+        self.notify_arrival = notify_arrival
         self.lock = threading.Lock()
         self.rows: List = []
         self.eoi = False
@@ -79,18 +82,27 @@ class _InputEndpoint:
         _tsan_hook(self)
 
     def on_chunk(self, chunk: bytes) -> None:
+        n_new = 0
         with self.lock:
             self.total_bytes += len(chunk)
             try:
                 self.parser.feed(chunk)
-                self.rows.extend(self.parser.take())
+                taken = self.parser.take()
+                self.rows.extend(taken)
+                n_new = len(taken)
             except Exception as e:  # bad data must not kill the reader
                 # record, surface via stats, and terminate the endpoint so
                 # eoi_reached() cannot hang on a dead feed
                 self.error = f"{type(e).__name__}: {e}"
-                self.rows.extend(self.parser.take())
+                taken = self.parser.take()
+                self.rows.extend(taken)
+                n_new = len(taken)
                 self.eoi = True
                 self.transport.stop()
+        # arrival wall-time stamp for freshness tracking — OUTSIDE the
+        # endpoint lock (the timeline has its own guard; no nesting)
+        if n_new and self.notify_arrival is not None:
+            self.notify_arrival(n_new)
 
     def on_eoi(self) -> None:
         with self.lock:
@@ -174,6 +186,10 @@ class Controller:
         # optional obs.FlightRecorder (PipelineObs.attach_controller wires
         # it) — checkpoint/restore events become SLO-visible through it
         self.flight = None
+        # optional obs.Timeline (same wiring site): per-tick latency /
+        # rows / queue-depth records plus freshness stamps (arrival at
+        # push sites, visibility at validation publish)
+        self.timeline = None
         # tiered trace residency: route the unified budgets into whichever
         # engine this controller drives (compiled handle or host spines).
         # Applying HERE — not only on the manager deploy path — is what
@@ -204,7 +220,8 @@ class Controller:
                            fmt: str = "csv") -> None:
         col = self.catalog.input(collection)
         parser = INPUT_FORMATS[fmt](col.dtypes)
-        ep = _InputEndpoint(name, col, transport, parser)
+        ep = _InputEndpoint(name, col, transport, parser,
+                            notify_arrival=self._note_arrival)
         self.inputs[name] = ep
         configure = getattr(transport, "configure_retry", None)
         if configure is not None:  # broker-backed transports honor the
@@ -249,6 +266,15 @@ class Controller:
         self.note_pushed(n)
         return n
 
+    def _note_arrival(self, n: int) -> None:
+        """Freshness: stamp the wall-time a batch of rows reached this
+        controller (push sites and transport chunk callbacks both land
+        here). Visibility is stamped when the batch's results publish —
+        the gap is the freshness sample."""
+        tl = self.timeline
+        if n and tl is not None:
+            tl.note_arrival(n)
+
     def note_pushed(self, n: int) -> None:
         """Record host-pushed rows (HTTP endpoints / client API) so the
         circuit loop's batching sees them alongside transport buffers —
@@ -256,6 +282,7 @@ class Controller:
         with self._pushed_lock:
             self._pushed += int(n)
             self.total_pushed += int(n)
+        self._note_arrival(n)
 
     # -- durability (dbsp_tpu.checkpoint) -----------------------------------
     def _controller_state(self) -> dict:
@@ -439,8 +466,14 @@ class Controller:
         a validation cadence > 1 never strands buffered outputs."""
         flush = getattr(self.handle, "flush", None)
         if flush is not None:
+            was_open = getattr(self.handle, "interval_open", False)
             flush()
             self._emit_outputs()
+            tl = self.timeline
+            if was_open and tl is not None:
+                # a deferred-validation interval just closed: its buffered
+                # ticks' results became visible now, not at their steps
+                tl.note_visible(list(self.catalog.outputs))
 
     @contextlib.contextmanager
     def quiesce(self):
@@ -520,21 +553,38 @@ class Controller:
             self._step_locked()
 
     def _step_locked(self) -> None:  # holds: _step_lock
+        t0 = time.perf_counter_ns()
         with self._pushed_lock:
+            rows_in = self._pushed
             self._pushed = 0  # this step consumes all pushed rows
         for ep in self.inputs.values():
             rows = ep.drain()
             if rows:
                 ep.collection.push_rows(rows)
+                rows_in += len(rows)
         self.handle.step()
         self.steps += 1
-        self._emit_outputs()
+        rows_out = self._emit_outputs()
         self._maybe_checkpoint_locked()
         self._run_monitors()
+        # the tick record is stamped LAST so checkpoint writes and in-tick
+        # monitor work (everything inside the step lock) count toward the
+        # tick's wall latency — that is what a serving client waits on
+        tl = self.timeline
+        if tl is not None:
+            tl.note_tick(self.steps, time.perf_counter_ns() - t0,
+                         rows_in=rows_in, rows_out=rows_out,
+                         queue_depth=sum(ep.buffered()
+                                         for ep in self.inputs.values()))
+            if not getattr(self.handle, "interval_open", False):
+                # this step's results validated and published (host engine:
+                # every step; compiled: when no deferred interval remains)
+                tl.note_visible(list(self.catalog.outputs))
 
-    def _emit_outputs(self) -> None:
+    def _emit_outputs(self) -> int:
         from dbsp_tpu.zset.batch import concat_batches
 
+        emitted = 0
         for out in self.outputs.values():
             # per-consumer queue: the HTTP server's /read peeks the same
             # handle, so a destructive take() here would race it
@@ -560,7 +610,10 @@ class Controller:
                     continue
                 out.error = None
                 out.total_bytes += len(data)
-                out.total_records += len(batch.to_dict())
+                n = len(batch.to_dict())
+                out.total_records += n
+                emitted += n
+        return emitted
 
     def _backpressure(self) -> None:
         for ep in self.inputs.values():
@@ -571,6 +624,12 @@ class Controller:
             elif ep.paused and n < self.config.max_buffered_records // 2:
                 ep.paused = False
                 ep.transport.resume()
+
+    def input_queue_depths(self) -> Dict[str, int]:
+        """Rows buffered per input endpoint, awaiting the next drain —
+        the /status queue-depth section. Each read takes only the
+        endpoint's own lock; never the step lock."""
+        return {name: ep.buffered() for name, ep in self.inputs.items()}
 
     # -- stats (reference: ControllerStatus, controller/stats.rs) -----------
     def stats(self) -> dict:

@@ -92,6 +92,35 @@ class CircuitServer:
                         qs = parse_qs(url.query)
                         limit = int(qs["n"][0]) if "n" in qs else None
                         self._json(server.obs.flight.to_dict(limit=limit))
+                elif route == "/timeline":
+                    # the unified per-tick timeline (obs/timeline.py):
+                    # tick latency + flight events + freshness + incidents
+                    # in one time-indexed ring. Quiesce-free: one watch()
+                    # pass folds fresh flight events in, then the read is
+                    # a ring snapshot under the timeline's own lock — the
+                    # step lock is never taken on this path.
+                    if server.obs is None:
+                        self._json({"error": "timeline not enabled"}, 400)
+                    else:
+                        server.obs.watch()
+                        qs = parse_qs(url.query)
+                        since = int(qs["since"][0]) if "since" in qs else 0
+                        view = qs["view"][0] if "view" in qs else None
+                        limit = int(qs["n"][0]) if "n" in qs else None
+                        self._json(server.obs.timeline.to_dict(
+                            since=since, view=view, limit=limit))
+                elif route == "/spikes":
+                    # EXPLAIN SPIKE: outlier ticks vs the robust rolling
+                    # baseline, each with ranked co-timed evidence. Same
+                    # quiesce-free read discipline as /timeline.
+                    if server.obs is None:
+                        self._json({"error": "timeline not enabled"}, 400)
+                    else:
+                        server.obs.watch()
+                        qs = parse_qs(url.query)
+                        limit = int(qs["n"][0]) if "n" in qs else None
+                        self._json(server.obs.timeline.explain_spikes(
+                            limit=limit))
                 elif route == "/incidents":
                     if server.obs is None:
                         self._json({"error": "SLO watchdog not enabled"},
@@ -275,7 +304,15 @@ class CircuitServer:
                # (None = no checkpoint yet/configured)
                "last_checkpoint_tick": getattr(
                    c, "last_checkpoint_tick", None),
-               "checkpoints": getattr(c, "checkpoints", 0)}
+               "checkpoints": getattr(c, "checkpoints", 0),
+               # freshness: seconds the open deferred-validation interval
+               # has been accumulating unpublished ticks (None = closed /
+               # host engine, which publishes every step)
+               "open_interval_age_s": getattr(
+                   c.handle, "open_interval_age_s", None),
+               # rows buffered per input endpoint awaiting the next drain
+               # (endpoint locks only — never the step lock)
+               "input_queue_depths": c.input_queue_depths()}
         ck_err = getattr(c, "checkpoint_error", None)
         if ck_err:
             out["checkpoint_error"] = ck_err

@@ -400,6 +400,30 @@ class PipelineManager:
                         return
                     return self._json(payload, code)
                 if len(parts) == 4 and parts[1] == "pipelines" and \
+                        parts[3] in ("timeline", "spikes"):
+                    # per-pipeline timeline / EXPLAIN SPIKE — proxied to
+                    # the embedded server's quiesce-free readers (the
+                    # timeline has its own lock; no step lock on this path)
+                    with mgr.lock:
+                        p = mgr.pipelines.get(parts[2])
+                    if p is None or p.obs is None:
+                        return self._json({"error": "not found"}, 404)
+                    qs = parse_qs(url.query)
+                    limit = int(qs["n"][0]) if "n" in qs else None
+                    try:
+                        p.obs.watch()
+                        if parts[3] == "timeline":
+                            since = int(qs["since"][0]) \
+                                if "since" in qs else 0
+                            view = qs["view"][0] if "view" in qs else None
+                            return self._json(p.obs.timeline.to_dict(
+                                since=since, view=view, limit=limit))
+                        return self._json(
+                            p.obs.timeline.explain_spikes(limit=limit))
+                    except Exception as e:  # noqa: BLE001 — API error
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 500)
+                if len(parts) == 4 and parts[1] == "pipelines" and \
                         parts[3] == "profile":
                     # operator attribution for one deployed pipeline —
                     # proxied to its embedded server's quiesced report

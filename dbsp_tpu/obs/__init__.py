@@ -21,7 +21,11 @@ the pipeline-manager's per-pipeline stats, ``dbsp_handle.rs:256-268``):
   phases, drains, replays, fallbacks) served at ``/flight``;
 * :mod:`dbsp_tpu.obs.slo` — the SLO watchdog: configurable objectives
   evaluated in the controller loop; breaches freeze ring windows into
-  cause-attributed incidents served at ``/incidents``.
+  cause-attributed incidents served at ``/incidents``;
+* :mod:`dbsp_tpu.obs.timeline` — the unified per-tick timeline: tick
+  records + flight events + freshness samples + incidents in one bounded
+  time-indexed ring, with EXPLAIN SPIKE attribution (``/timeline``,
+  ``/spikes``) and the ``dbsp_tpu_freshness_seconds{view}`` export.
 
 Metric names follow ``dbsp_tpu_<subsystem>_<name>_<unit>`` (see
 ``registry.validate_metric_name``); the catalog lives in README.md
@@ -38,6 +42,7 @@ from dbsp_tpu.obs.registry import (Counter, Gauge, Histogram,
                                    MetricNameError, MetricsRegistry, Summary,
                                    validate_metric_name)
 from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
+from dbsp_tpu.obs.timeline import SPIKE_CAUSES, Timeline
 from dbsp_tpu.obs.tracing import SpanRecorder
 
 __all__ = [
@@ -45,6 +50,7 @@ __all__ = [
     "MetricNameError", "validate_metric_name",
     "prometheus_text", "prometheus_text_many", "legacy_controller_lines",
     "SpanRecorder", "FlightRecorder", "SLOConfig", "SLOWatchdog",
+    "Timeline", "SPIKE_CAUSES",
     "CircuitInstrumentation", "CompiledInstrumentation",
     "ControllerInstrumentation", "PipelineObs",
 ]

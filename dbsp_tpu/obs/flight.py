@@ -85,6 +85,9 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self.dropped = 0  # events aged out of the ring
+        # drops by the EVICTED event's kind — a gap in the ring names
+        # which source lost history instead of presenting as quiet time
+        self.dropped_by_source: Dict[str, int] = {}
         _tsan_hook(self)
 
     def record(self, kind: str, t_ns: Optional[int] = None, **fields) -> int:
@@ -98,6 +101,9 @@ class FlightRecorder:
             ev["seq"] = self._seq
             if len(self._ring) == self.capacity:
                 self.dropped += 1
+                src = self._ring[0].get("kind", "unknown")
+                self.dropped_by_source[src] = \
+                    self.dropped_by_source.get(src, 0) + 1
             self._ring.append(ev)
             return self._seq
 
@@ -127,8 +133,18 @@ class FlightRecorder:
         """The most recent ``n`` events — what an incident freezes."""
         return self.events(limit=n)
 
+    def drop_stats(self) -> Dict[str, int]:
+        """Snapshot of per-source drop counts (evicted-event kinds)."""
+        with self._lock:
+            return dict(self.dropped_by_source)
+
     def to_dict(self, limit: Optional[int] = None) -> dict:
-        return {"capacity": self.capacity, "dropped": self.dropped,
+        with self._lock:
+            dropped = self.dropped
+            by_source = dict(self.dropped_by_source)
+        return {"capacity": self.capacity, "dropped": dropped,
+                "dropped_by_source": by_source,
+                "truncated": dropped > 0,
                 "last_seq": self.last_seq,
                 "events": self.events(limit=limit)}
 

@@ -553,6 +553,7 @@ class PipelineObs:
                  flight_capacity: int = 2048, slo=None):
         from dbsp_tpu.obs.flight import FlightRecorder
         from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
+        from dbsp_tpu.obs.timeline import Timeline
 
         self.name = name
         self.registry = MetricsRegistry()
@@ -560,15 +561,24 @@ class PipelineObs:
         self.flight = FlightRecorder(capacity=flight_capacity)
         self.slo = SLOWatchdog(self.flight, SLOConfig.from_dict(slo),
                                registry=self.registry, pipeline=name)
+        # unified per-tick timeline: flight events + SLO incidents + tick
+        # records + freshness stamps in one time-indexed ring (the spike
+        # attribution and staleness surfaces read it)
+        self.timeline = Timeline(registry=self.registry, pipeline=name)
         self._flight_sources = []
         self.registry.register_collector(self.watch)
 
     def watch(self):
-        """One watchdog pass: poll flight sources, evaluate SLOs. Returns
-        the incidents opened by this pass."""
+        """One watchdog pass: poll flight sources, evaluate SLOs, and fold
+        the fresh flight events + any newly opened incidents into the
+        timeline. Returns the incidents opened by this pass."""
         for src in self._flight_sources:
             src.poll()
-        return self.slo.evaluate()
+        incidents = self.slo.evaluate()
+        self.timeline.ingest_flight(self.flight)
+        for inc in incidents or ():
+            self.timeline.note_incident(inc)
+        return incidents
 
     def attach_circuit(self, circuit) -> CircuitInstrumentation:
         from dbsp_tpu.obs.flight import HostFlightSource
@@ -597,6 +607,10 @@ class PipelineObs:
         # announce synchronously
         if hasattr(controller, "flight"):
             controller.flight = self.flight
+        # tick latency + freshness stamps: the controller writes tick and
+        # arrival/visibility records straight onto this pipeline's timeline
+        if hasattr(controller, "timeline"):
+            controller.timeline = self.timeline
         self._flight_sources.append(
             ControllerFlightSource(controller, self.flight))
         return ControllerInstrumentation(controller, self.registry)

@@ -64,6 +64,14 @@ ALLOWED_LABEL_NAMES = frozenset((
     # tiered trace residency (dbsp_tpu/residency.py): "tier" and the
     # transition endpoints draw from the closed {device, host, disk} set
     "tier", "tier_from", "tier_to",
+    # freshness tracking (obs/timeline.py): "view" names a registered
+    # output view of the pipeline's catalog — the value set is the
+    # pipeline's declared views, fixed at program deploy time
+    "view",
+    # flight-recorder drop accounting (obs/flight.py): "source" is the
+    # event kind group that was evicted from the bounded ring — drawn
+    # from the closed FlightRecorder event-kind vocabulary
+    "source",
 ))
 
 

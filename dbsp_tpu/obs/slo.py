@@ -166,13 +166,16 @@ class SLOWatchdog:
                 "Objectives currently in breach (0 = meeting all SLOs)")
             dropped_c = registry.counter(
                 "dbsp_tpu_obs_flight_dropped_total",
-                "Flight-recorder events aged out of the bounded ring")
+                "Flight-recorder events aged out of the bounded ring, by "
+                "the evicted event's kind (source of the lost history)",
+                labels=("source",))
 
             def export():  # scrape-time collector, runs on HTTP threads
                 with self._lock:
                     n_active = len(self._active)
                 active_g.set(n_active)
-                dropped_c.set_total(self.flight.dropped)
+                for src, n in self.flight.drop_stats().items():
+                    dropped_c.labels(source=src).set_total(n)
 
             registry.register_collector(export)
         _tsan_hook(self)

@@ -1,0 +1,424 @@
+"""Timeline: always-on per-tick history, EXPLAIN SPIKE, freshness tracking.
+
+The sensors grown over PRs 5-15 are rich but disjoint: the flight
+recorder names causes, the per-node profiler attributes shares, the SLO
+watchdog latches incidents, and the residency layer exports tier
+transitions — yet answering "why was p99 high five minutes ago?" still
+required a human to join four surfaces by hand, and nothing measured the
+quantity readers actually feel: ingest-to-visible freshness. This module
+is the join. It follows the Dapper / "Tail at Scale" pattern — always-on,
+low-overhead, cause-attributed telemetry — applied to incremental view
+maintenance:
+
+* **One time-indexed ring.** Both engines feed it: the flight stream
+  (tick latency + causes, host phase overheads, maintain drains,
+  overflow replays, exchange deltas, residency transitions, checkpoint
+  saves, transport errors) is ingested incrementally by ``seq`` cursor,
+  the controller stamps wall-clock tick records (``note_tick``) that
+  include everything inside the step lock (validate, maintain, snapshot,
+  checkpoint write), and SLO incidents land as records too. Bounded
+  (configurable retention via ``DBSP_TPU_TIMELINE_CAPACITY``), append-
+  only under its own lock — readers never touch the step lock.
+
+* **EXPLAIN SPIKE** (:meth:`Timeline.explain_spikes`): outlier ticks are
+  selected against a robust rolling baseline (trailing median + MAD —
+  means would let the spike poison its own threshold) and each is
+  explained with ranked evidence drawn from the co-timed records:
+  maintain drain, retrace, overflow replay, checkpoint write, residency
+  demotion/promotion fault, transport stall, GC. Co-timing is by wall
+  clock against the tick's span, so flight events ingested late (at the
+  next scrape) still attach to the tick they happened inside.
+
+* **Freshness tracking.** The controller stamps arrival wall-time per
+  pushed batch (``note_arrival``) and records visibility at validation
+  publish (``note_visible``); the delta is exported as the
+  ``dbsp_tpu_freshness_seconds{view}`` histogram plus a per-view
+  staleness gauge — snapshot staleness becomes a measured, gateable
+  quantity (tests/test_timeline.py gates it at validation interval + one
+  tick budget on both engines).
+
+Overhead discipline mirrors the flight recorder: every note_* call is
+one dict build + deque append under a short lock; the always-on cost is
+gated by the ``timeline`` front in ``tools/lint_all.py`` and the
+interleaved A/B in ``BENCH_local_timeline[_off].json``. ``DBSP_TPU_
+TIMELINE=0`` disables the feed entirely (the A/B control).
+
+This is deliberately the sensor substrate for the ROADMAP item 2
+governor: every future adaptation decision should land as a timeline
+record, so oscillation is attributable by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
+__all__ = ["Timeline", "SPIKE_CAUSES", "timeline_enabled"]
+
+#: Closed vocabulary of spike-attribution causes (ranked-evidence keys;
+#: METRICS.md documents this as the `cause` label set of
+#: dbsp_tpu_timeline_spikes_total).
+SPIKE_CAUSES = ("maintain", "retrace", "overflow_replay", "checkpoint",
+                "residency", "transport", "gc", "unattributed")
+
+#: flight/record kind -> spike cause bucket
+_KIND_CAUSE = {
+    "maintain": "maintain",
+    "compile": "retrace",
+    "overflow_replay": "overflow_replay",
+    "checkpoint": "checkpoint",
+    "residency": "residency",
+    "transport": "transport",
+    "gc": "gc",
+}
+
+#: tick-cause annotation -> spike cause bucket (engine tick records carry
+#: `causes` lists with their own vocabulary)
+_ANNOTATION_CAUSE = {"maintain": "maintain", "retrace": "retrace",
+                     "snapshot": "checkpoint", "gc": "gc"}
+
+# spike selection: a tick is an outlier when its latency exceeds BOTH the
+# multiplicative bound (MULT x rolling median) and the additive robust
+# bound (median + max(MAD_K x MAD, FLOOR)). The floor keeps sub-ms jitter
+# on fast ticks from ever flagging; both knobs are env-tunable so the
+# artifact generator and the lint front share one detector.
+_SPIKE_MULT = float(os.environ.get("DBSP_TPU_SPIKE_MULT", "3.0"))
+_SPIKE_MAD_K = 8.0
+_SPIKE_FLOOR_NS = float(os.environ.get("DBSP_TPU_SPIKE_FLOOR_MS", "10")) * 1e6
+_MIN_BASELINE = 8      # never flag before the baseline has this many ticks
+_BASELINE_WINDOW = 64  # trailing window the median/MAD roll over
+
+#: freshness histogram bounds: 1ms .. ~2000s, x2 per bucket — staleness
+#: spans sub-tick (host engine, validate_every=1) to long deferred
+#: intervals and seeded stalls
+_FRESHNESS_BUCKETS = tuple(1e-3 * 2 ** i for i in range(22))
+
+
+def timeline_enabled(env=None) -> bool:
+    """The always-on default; ``DBSP_TPU_TIMELINE=0`` is the A/B control
+    (BENCH_local_timeline_off.json) and the kill switch."""
+    return (env if env is not None else os.environ).get(
+        "DBSP_TPU_TIMELINE", "1") != "0"
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    m = n // 2
+    return s[m] if n % 2 else (s[m - 1] + s[m]) / 2.0
+
+
+class Timeline:
+    """Bounded, time-indexed ring joining tick history, flight events,
+    freshness samples, and SLO incidents; thread-safe, append-only under
+    its own lock (never the step lock)."""
+
+    def __init__(self, capacity: Optional[int] = None, registry=None,
+                 pipeline: str = "", enabled: Optional[bool] = None):
+        self.capacity = int(capacity if capacity is not None else
+                            os.environ.get("DBSP_TPU_TIMELINE_CAPACITY",
+                                           "4096"))
+        self.enabled = timeline_enabled() if enabled is None else \
+            bool(enabled)
+        self.pipeline = pipeline
+        self._lock = threading.Lock()
+        self._records: Deque[dict] = deque(maxlen=self.capacity)
+        self._seq = 0
+        self.dropped = 0  # records aged out of the ring
+        self._flight_seen = 0  # seq cursor into the flight ring
+        # freshness state: one pending pool (arrivals not yet visible) —
+        # visibility publishes every registered view at once, so the
+        # oldest unpublished arrival bounds staleness for all of them
+        self._pending_rows = 0
+        self._oldest_pending_ts: Optional[float] = None
+        self._last_visible_ts: Optional[float] = None
+        self._freshness: Dict[str, dict] = {}  # view -> last sample state
+        self._spike_metric_seen = 0  # tick-record seq already counted
+        self._fresh_hist = None
+        self._stale_gauge = None
+        self._spike_counter = None
+        if registry is not None:
+            self._fresh_hist = registry.histogram(
+                "dbsp_tpu_freshness_seconds",
+                "Ingest-to-visible latency per view: arrival wall-time of "
+                "the oldest unpublished batch to its validation publish",
+                labels=("view",), buckets=_FRESHNESS_BUCKETS)
+            self._stale_gauge = registry.gauge(
+                "dbsp_tpu_freshness_staleness_seconds",
+                "Current staleness per view: age of the oldest arrived-"
+                "but-not-yet-visible batch (0 when fully published)",
+                labels=("view",))
+            self._spike_counter = registry.counter(
+                "dbsp_tpu_timeline_spikes_total",
+                "Outlier ticks flagged by EXPLAIN SPIKE, by attributed "
+                "cause (closed set: obs.timeline.SPIKE_CAUSES)",
+                labels=("cause",))
+            registry.register_collector(self._export)
+        _tsan_hook(self)
+
+    # -- feed (writers) -----------------------------------------------------
+
+    def _append_locked(self, rec: dict) -> int:  # holds: _lock
+        self._seq += 1
+        rec["seq"] = self._seq
+        if len(self._records) == self.capacity:
+            self.dropped += 1
+        self._records.append(rec)
+        return self._seq
+
+    def note_tick(self, tick: int, latency_ns: int, rows_in: int = 0,
+                  rows_out: int = 0, causes: Sequence[str] = (),
+                  queue_depth: int = 0) -> None:
+        """One controller-level tick: wall latency of everything inside
+        the step lock (engine step + validate/maintain/snapshot +
+        checkpoint write + monitors)."""
+        if not self.enabled:
+            return
+        rec = {"kind": "tick", "src": "ctl", "ts": time.time(),
+               "t_ns": time.perf_counter_ns(), "tick": int(tick),
+               "latency_ns": int(latency_ns), "rows_in": int(rows_in),
+               "rows_out": int(rows_out), "causes": list(causes),
+               "queue_depth": int(queue_depth)}
+        with self._lock:
+            self._append_locked(rec)
+
+    def note_arrival(self, rows: int, ts: Optional[float] = None) -> None:
+        """Stamp arrival wall-time of one pushed batch (controller push
+        path and input-endpoint chunks)."""
+        if not self.enabled:
+            return
+        now = time.time() if ts is None else ts
+        with self._lock:
+            self._pending_rows += int(rows)
+            if self._oldest_pending_ts is None:
+                self._oldest_pending_ts = now
+            self._append_locked({"kind": "arrival", "ts": now,
+                                 "t_ns": time.perf_counter_ns(),
+                                 "rows": int(rows)})
+
+    def note_visible(self, views: Sequence[str],
+                     ts: Optional[float] = None) -> None:
+        """Record visibility at validation publish: every pending arrival
+        is now readable through each view; the oldest pending arrival's
+        age is the freshness sample."""
+        if not self.enabled:
+            return
+        now = time.time() if ts is None else ts
+        with self._lock:
+            oldest = self._oldest_pending_ts
+            sample = max(0.0, now - oldest) if oldest is not None else None
+            self._pending_rows = 0
+            self._oldest_pending_ts = None
+            self._last_visible_ts = now
+            if sample is None:
+                return  # nothing new became visible — no sample
+            for view in views:
+                st = self._freshness.setdefault(
+                    view, {"samples": 0, "last_s": 0.0, "max_s": 0.0})
+                st["samples"] += 1
+                st["last_s"] = sample
+                st["max_s"] = max(st["max_s"], sample)
+            self._append_locked({"kind": "freshness", "ts": now,
+                                 "t_ns": time.perf_counter_ns(),
+                                 "views": list(views),
+                                 "seconds": sample})
+        if self._fresh_hist is not None:
+            for view in views:
+                self._fresh_hist.labels(view=view).observe(sample)
+
+    def note_incident(self, incident: dict) -> None:
+        """One opened SLO incident (PipelineObs.watch feeds these)."""
+        if not self.enabled:
+            return
+        rec = {"kind": "incident", "ts": time.time(),
+               "t_ns": time.perf_counter_ns(),
+               "slo": incident.get("slo"),
+               "cause": incident.get("cause")}
+        with self._lock:
+            self._append_locked(rec)
+
+    def ingest_flight(self, flight) -> int:
+        """Incrementally join the flight ring in by ``seq`` cursor: every
+        new flight event becomes a timeline record (src="flight"), the
+        engine-level tick/phase/maintain/residency/checkpoint/transport
+        stream time-indexed next to the controller's own records. Returns
+        the number of records ingested. Lock order: Timeline._lock ->
+        FlightRecorder._lock (never the reverse)."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            events = flight.events(since_seq=self._flight_seen)
+            n = 0
+            for ev in events:
+                rec = dict(ev)
+                rec["src"] = "flight"
+                rec["flight_seq"] = rec.pop("seq")
+                self._append_locked(rec)
+                self._flight_seen = max(self._flight_seen,
+                                        rec["flight_seq"])
+                n += 1
+            return n
+
+    # -- read surface (never takes the step lock) ---------------------------
+
+    def records(self, since: int = 0, view: Optional[str] = None,
+                kinds: Optional[Sequence[str]] = None,
+                limit: Optional[int] = None) -> List[dict]:
+        """Snapshot of records (oldest first), filtered by ``seq >
+        since`` (incremental pollers), by view binding, and by kind."""
+        with self._lock:
+            out = list(self._records)
+        if since:
+            out = [r for r in out if r["seq"] > since]
+        if kinds is not None:
+            ks = set(kinds)
+            out = [r for r in out if r["kind"] in ks]
+        if view is not None:
+            out = [r for r in out
+                   if r.get("view") == view or view in r.get("views", ())]
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def staleness(self) -> Dict[str, float]:
+        """Current per-view staleness: age of the oldest arrived-but-not-
+        visible batch, 0.0 when fully published."""
+        now = time.time()
+        with self._lock:
+            pending = self._oldest_pending_ts
+            views = list(self._freshness)
+        age = max(0.0, now - pending) if pending is not None else 0.0
+        return {v: age for v in views} if views else \
+            ({"_pipeline": age} if pending is not None else {})
+
+    def freshness_summary(self) -> Dict[str, dict]:
+        now = time.time()
+        with self._lock:
+            pending = self._oldest_pending_ts
+            out = {v: dict(st) for v, st in self._freshness.items()}
+        age = max(0.0, now - pending) if pending is not None else 0.0
+        for st in out.values():
+            st["staleness_s"] = age
+        return out
+
+    def to_dict(self, since: int = 0, view: Optional[str] = None,
+                limit: Optional[int] = None) -> dict:
+        with self._lock:
+            last_seq = self._seq
+            dropped = self.dropped
+        return {"capacity": self.capacity, "enabled": self.enabled,
+                "last_seq": last_seq, "dropped": dropped,
+                "truncated": dropped > 0,
+                "freshness": self.freshness_summary(),
+                "records": self.records(since=since, view=view,
+                                        limit=limit)}
+
+    # -- EXPLAIN SPIKE ------------------------------------------------------
+
+    def _tick_stream(self, recs: List[dict]) -> List[dict]:
+        """The tick records to baseline over: controller-level wall ticks
+        when a controller feeds us (they include checkpoint/maintain time
+        inside the step lock), engine-level flight ticks otherwise."""
+        ticks = [r for r in recs if r["kind"] == "tick"]
+        ctl = [r for r in ticks if r.get("src") == "ctl"]
+        return ctl or ticks
+
+    def _evidence(self, recs: List[dict], tick: dict) -> List[dict]:
+        """Ranked co-timed evidence for one spike tick: records whose
+        wall-clock stamp falls inside the tick's span, bucketed into the
+        closed cause set and ranked by contributed time (ns fields),
+        then by count."""
+        t1 = tick["ts"]
+        t0 = t1 - tick.get("latency_ns", 0) / 1e9 - 0.005
+        scores: Dict[str, dict] = {}
+
+        def add(cause, weight_ns, ev):
+            st = scores.setdefault(cause, {"cause": cause, "score_ns": 0,
+                                           "count": 0, "events": []})
+            st["score_ns"] += int(weight_ns)
+            st["count"] += 1
+            if len(st["events"]) < 8:
+                st["events"].append(ev)
+
+        for r in recs:
+            if r["kind"] == "tick" or not (t0 <= r["ts"] <= t1 + 0.005):
+                continue
+            cause = _KIND_CAUSE.get(r["kind"])
+            if r["kind"] == "phase" and r.get("phase") == "maintain":
+                cause = "maintain"
+            if cause is None:
+                continue
+            weight = r.get("ns") or r.get("duration_ns") or 0
+            ev = {k: v for k, v in r.items()
+                  if k not in ("seq", "t_ns", "src", "flight_seq")}
+            add(cause, weight, ev)
+        for c in tick.get("causes") or ():
+            mapped = _ANNOTATION_CAUSE.get(c)
+            if mapped:
+                add(mapped, 0, {"kind": "tick_annotation", "cause": c})
+        ranked = sorted(scores.values(),
+                        key=lambda s: (s["score_ns"], s["count"]),
+                        reverse=True)
+        return ranked
+
+    def explain_spikes(self, limit: Optional[int] = None) -> dict:
+        """Attribution pass: outlier ticks against the robust rolling
+        baseline, each explained with ranked co-timed evidence."""
+        with self._lock:
+            recs = list(self._records)
+        ticks = self._tick_stream(recs)
+        spikes: List[dict] = []
+        history: List[int] = []
+        new_spike_seqs: List[Tuple[int, str]] = []
+        for t in ticks:
+            lat = t.get("latency_ns", 0)
+            if len(history) >= _MIN_BASELINE:
+                base = history[-_BASELINE_WINDOW:]
+                med = _median(base)
+                mad = _median([abs(x - med) for x in base])
+                thr = max(_SPIKE_MULT * med,
+                          med + max(_SPIKE_MAD_K * mad, _SPIKE_FLOOR_NS))
+                if lat > thr:
+                    evidence = self._evidence(recs, t)
+                    cause = evidence[0]["cause"] if evidence else \
+                        "unattributed"
+                    spikes.append({
+                        "tick": t.get("tick"), "ts": t["ts"],
+                        "latency_ns": int(lat), "baseline_ns": int(med),
+                        "mad_ns": int(mad), "threshold_ns": int(thr),
+                        "cause": cause, "evidence": evidence})
+                    new_spike_seqs.append((t["seq"], cause))
+                    continue  # a flagged outlier must not poison history
+            history.append(lat)
+        if self._spike_counter is not None and new_spike_seqs:
+            with self._lock:
+                fresh = [(s, c) for s, c in new_spike_seqs
+                         if s > self._spike_metric_seen]
+                if fresh:
+                    self._spike_metric_seen = max(s for s, _ in fresh)
+            for _, cause in fresh:
+                self._spike_counter.labels(cause=cause).inc()
+        if limit is not None and len(spikes) > limit:
+            spikes = spikes[-limit:]
+        return {"spikes": spikes, "ticks_seen": len(ticks),
+                "baseline": {"min_samples": _MIN_BASELINE,
+                             "window": _BASELINE_WINDOW,
+                             "mult": _SPIKE_MULT,
+                             "floor_ns": int(_SPIKE_FLOOR_NS)}}
+
+    # -- scrape-time collector ----------------------------------------------
+
+    def _export(self) -> None:
+        """Refresh the per-view staleness gauge at scrape time."""
+        if self._stale_gauge is None:
+            return
+        for view, age in self.staleness().items():
+            if view != "_pipeline":
+                self._stale_gauge.labels(view=view).set(age)

@@ -169,6 +169,7 @@ class FlightRecorder:
         self._ring = []
         self._seq = 0
         self.dropped = 0
+        self.dropped_by_source = {}
 
     def record(self, ev):
         with self._lock:
@@ -470,7 +471,7 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
     sched = InterleaveSchedule(
         seed=11, rate=0.04, sleep_s=0.001, max_yields=300,
         only=("Controller.", "SLOWatchdog.", "FlightRecorder.",
-              "PipelineManager.", "_InputEndpoint."))
+              "PipelineManager.", "_InputEndpoint.", "Timeline."))
     cfg = {"min_batch_records": 1, "flush_interval_s": 0.02,
            "lineage_taps": True,
            "checkpoint_dir": str(tmp_path / f"ckpt-{mode}"),
@@ -518,6 +519,12 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
                         pipe.stats()
                         pipe.flight(n=16)
                         pipe.incidents(with_window=False)
+                        # quiesce-free timeline reads: these never take
+                        # the step lock (the C003 front pins server.py),
+                        # so they must stay live under full contention
+                        tl = pipe.timeline(n=16)
+                        assert tl["last_seq"] >= 0
+                        pipe.explain_spike(n=4)
                         done["scrapes"] += 1
                         time.sleep(0.01)
                 except Exception as e:  # noqa: BLE001

@@ -100,12 +100,15 @@ def test_server_endpoints(tmp_path):
         with urllib.request.urlopen(req, timeout=5) as r:
             return r.status, r.read()
 
-    # /status rides the execution mode + durability fields along
-    # (obs-less server: no slo)
+    # /status rides the execution mode + durability + freshness fields
+    # along (obs-less server: no slo; host engine: no open interval;
+    # push-only controller: no transport input endpoints to queue)
     assert json.loads(get("/status")[1]) == {"state": "initializing",
                                              "mode": "host",
                                              "last_checkpoint_tick": None,
-                                             "checkpoints": 0}
+                                             "checkpoints": 0,
+                                             "open_interval_age_s": None,
+                                             "input_queue_depths": {}}
     # push rows over HTTP, step explicitly, read the output endpoint
     st, body = post("/input_endpoint/events?format=json",
                     b'{"insert": [7, 1]}\n{"insert": [7, 2]}\n')
@@ -128,7 +131,9 @@ def test_server_endpoints(tmp_path):
     assert json.loads(get("/status")[1]) == {"state": "paused",
                                              "mode": "host",
                                              "last_checkpoint_tick": None,
-                                             "checkpoints": 0}
+                                             "checkpoints": 0,
+                                             "open_interval_age_s": None,
+                                             "input_queue_depths": {}}
     server.stop()
 
 

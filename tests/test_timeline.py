@@ -1,0 +1,459 @@
+"""Timeline (ISSUE 16): the unified per-tick observability ring —
+EXPLAIN SPIKE attribution, ingest-to-visible freshness, and the serving
+surfaces that read it without quiescing the engine.
+
+Acceptance coverage:
+  * ring bounded with dropped/truncated accounting; DBSP_TPU_TIMELINE=0
+    disables the feed (the A/B control);
+  * spike detection against the robust rolling median+MAD baseline:
+    a seeded outlier with a co-timed flight event is flagged AND
+    attributed, clean runs report zero spikes, and a flagged outlier
+    never poisons its own baseline;
+  * freshness gate on BOTH engines: served q4 per-view staleness stays
+    within validation interval + one tick budget, non-vacuous
+    (samples > 0), and a seeded stall pushes staleness past the bound
+    with the stall flight-attributed on the timeline;
+  * /status rides open_interval_age_s + per-endpoint input queue depth;
+  * the flight ring's per-source drop accounting (tiny ring) and the
+    truncated marker in /debug's flight summary;
+  * /timeline + /spikes served by server and manager proxy, reachable
+    through PipelineHandle.timeline()/explain_spike().
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from dbsp_tpu.circuit import Runtime
+from dbsp_tpu.io import (Catalog, CircuitServer, Controller,
+                         ControllerConfig, FileInputTransport)
+from dbsp_tpu.obs import (FlightRecorder, MetricsRegistry, PipelineObs,
+                          SPIKE_CAUSES, Timeline, prometheus_text)
+from dbsp_tpu.operators import Count, add_input_zset
+
+# quiet controller: explicit step() calls drive exactly N ticks
+QUIET = ControllerConfig(min_batch_records=10**9, flush_interval_s=3600.0)
+
+
+# ---------------------------------------------------------------------------
+# ring + freshness primitives
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_ring_bounded_and_truncated():
+    tl = Timeline(capacity=8, enabled=True)
+    for i in range(12):
+        tl.note_tick(i, 1_000_000, rows_in=4, rows_out=2, queue_depth=1)
+    d = tl.to_dict()
+    assert d["capacity"] == 8 and d["dropped"] == 4 and d["truncated"]
+    assert len(d["records"]) == 8
+    seqs = [r["seq"] for r in d["records"]]
+    assert seqs == sorted(seqs)
+    # incremental pollers: seq-cursor filter + limit
+    assert len(tl.records(since=seqs[-3])) == 2
+    assert len(tl.records(limit=3)) == 3
+    json.dumps(d)  # JSON-serializable end to end
+
+
+def test_timeline_disabled_is_noop():
+    tl = Timeline(capacity=8, enabled=False)
+    tl.note_tick(1, 1_000_000)
+    tl.note_arrival(5)
+    tl.note_visible(["v"])
+    tl.note_incident({"slo": "x", "cause": "maintain"})
+    rec = FlightRecorder(capacity=8)
+    rec.record("maintain", rows_moved=5)
+    assert tl.ingest_flight(rec) == 0
+    d = tl.to_dict()
+    assert d["enabled"] is False and d["records"] == []
+    assert tl.explain_spikes()["ticks_seen"] == 0
+
+
+def test_timeline_env_kill_switch(monkeypatch):
+    from dbsp_tpu.obs.timeline import timeline_enabled
+
+    assert timeline_enabled({}) is True
+    assert timeline_enabled({"DBSP_TPU_TIMELINE": "0"}) is False
+    monkeypatch.setenv("DBSP_TPU_TIMELINE", "0")
+    assert Timeline(capacity=8).enabled is False
+
+
+def test_freshness_arrival_to_visible_and_metrics():
+    reg = MetricsRegistry()
+    tl = Timeline(capacity=64, registry=reg, pipeline="p", enabled=True)
+    tl.note_arrival(5)
+    time.sleep(0.02)
+    # pending arrival: staleness grows until visibility publishes
+    assert tl.staleness()["_pipeline"] >= 0.02
+    tl.note_visible(["counts"])
+    fr = tl.freshness_summary()["counts"]
+    assert fr["samples"] == 1 and 0.02 <= fr["last_s"] < 5.0
+    assert fr["staleness_s"] == 0.0  # fully published
+    # a publish with nothing pending adds no sample
+    tl.note_visible(["counts"])
+    assert tl.freshness_summary()["counts"]["samples"] == 1
+    text = prometheus_text(reg)
+    assert 'dbsp_tpu_freshness_seconds_count{view="counts"} 1' in text
+    assert 'dbsp_tpu_freshness_staleness_seconds{view="counts"' in text
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN SPIKE
+# ---------------------------------------------------------------------------
+
+
+def _baseline(tl, n=12, lat_ns=1_000_000):
+    for i in range(n):
+        tl.note_tick(i, lat_ns)
+
+
+def test_explain_spike_flags_and_attributes():
+    tl = Timeline(capacity=256, enabled=True)
+    _baseline(tl)
+    # co-timed evidence: a checkpoint flight event landing inside the
+    # outlier tick's wall span
+    rec = FlightRecorder(capacity=64)
+    rec.record("checkpoint", tick=12, ns=60_000_000)
+    tl.ingest_flight(rec)
+    tl.note_tick(12, 60_000_000)
+    out = tl.explain_spikes()
+    assert out["ticks_seen"] == 13
+    assert len(out["spikes"]) == 1
+    sp = out["spikes"][0]
+    assert sp["tick"] == 12 and sp["latency_ns"] == 60_000_000
+    assert sp["cause"] == "checkpoint"
+    assert sp["threshold_ns"] > sp["baseline_ns"]
+    assert sp["evidence"][0]["events"][0]["kind"] == "checkpoint"
+    # the flagged outlier must NOT poison its own baseline: trailing
+    # normal ticks stay unflagged
+    for i in range(13, 20):
+        tl.note_tick(i, 1_000_000)
+    again = tl.explain_spikes()
+    assert len(again["spikes"]) == 1
+    assert SPIKE_CAUSES == ("maintain", "retrace", "overflow_replay",
+                            "checkpoint", "residency", "transport", "gc",
+                            "unattributed")
+
+
+def test_explain_spike_clean_run_and_unattributed():
+    tl = Timeline(capacity=256, enabled=True)
+    _baseline(tl, n=20)
+    assert tl.explain_spikes()["spikes"] == []  # no false positives
+    # an outlier with no co-timed evidence is still flagged — honestly
+    tl.note_tick(20, 80_000_000)
+    out = tl.explain_spikes()
+    assert len(out["spikes"]) == 1
+    assert out["spikes"][0]["cause"] == "unattributed"
+
+
+def test_explain_spike_counts_causes_on_registry():
+    reg = MetricsRegistry()
+    tl = Timeline(capacity=256, registry=reg, pipeline="p", enabled=True)
+    _baseline(tl)
+    rec = FlightRecorder(capacity=16)
+    rec.record("maintain", rows_moved=999, ns=50_000_000)
+    tl.ingest_flight(rec)
+    tl.note_tick(12, 60_000_000)
+    tl.explain_spikes()
+    tl.explain_spikes()  # same spike re-observed: counted exactly once
+    text = prometheus_text(reg)
+    assert 'dbsp_tpu_timeline_spikes_total{cause="maintain"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# flight ring: per-source drop accounting (satellite: tiny ring)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_tiny_ring_per_source_drops():
+    rec = FlightRecorder(capacity=4)
+    for i in range(7):
+        rec.record("tick", tick=i, latency_ns=100, causes=[])
+    for _ in range(2):
+        rec.record("maintain", rows_moved=1)
+    assert rec.dropped == 5
+    by_src = rec.drop_stats()
+    assert sum(by_src.values()) == 5
+    assert by_src["tick"] >= 4  # the evicted events are the oldest ticks
+    d = rec.to_dict()
+    assert d["truncated"] is True
+    assert d["dropped_by_source"] == by_src
+    json.dumps(d)
+    # empty ring: no drops, no truncation
+    assert FlightRecorder(capacity=4).to_dict()["truncated"] is False
+
+
+# ---------------------------------------------------------------------------
+# served pipelines: a count view behind the controller + server
+# ---------------------------------------------------------------------------
+
+
+def _build_count_pipeline():
+    def build(c):
+        s, h = add_input_zset(c, [jnp.int64], [jnp.int64])
+        out = s.aggregate(Count()).integrate().output()
+        return h, out
+
+    handle, (h, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    catalog.register_input("events", h, (jnp.int64, jnp.int64))
+    catalog.register_output("counts", out, (jnp.int64, jnp.int64))
+    return handle, catalog
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_server_timeline_and_spikes_routes(tmp_path):
+    handle, catalog = _build_count_pipeline()
+    ctl = Controller(handle, catalog, QUIET)
+    # tiny flight ring: /debug's flight summary must carry the truncated
+    # marker once events age out
+    obs = PipelineObs(name="t", flight_capacity=4)
+    obs.attach_circuit(handle.circuit)
+    obs.attach_controller(ctl)
+    server = CircuitServer(ctl, obs=obs)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        for i in range(4):
+            ctl.push("events", [((i, i), 1)])
+            ctl.step()
+        st, tl = _get(base, "/timeline")
+        assert st == 200
+        kinds = {r["kind"] for r in tl["records"]}
+        assert "tick" in kinds and "arrival" in kinds
+        assert tl["freshness"]["counts"]["samples"] == 4
+        assert tl["freshness"]["counts"]["staleness_s"] < 5.0
+        # incremental + filtered reads
+        _, tl2 = _get(base, f"/timeline?since={tl['last_seq']}")
+        assert [r for r in tl2["records"] if r["seq"] <= tl["last_seq"]] \
+            == []
+        _, tlv = _get(base, "/timeline?view=counts&n=2")
+        assert 0 < len(tlv["records"]) <= 2
+        assert all("counts" in r["views"] for r in tlv["records"])
+        st, sp = _get(base, "/spikes")
+        assert st == 200
+        assert sp["ticks_seen"] >= 4 and "baseline" in sp
+        # /status rides the freshness/queue surfaces
+        _, status = _get(base, "/status")
+        assert status["open_interval_age_s"] is None  # host engine
+        assert status["input_queue_depths"] == {}
+        # /debug's flight summary carries the truncated marker
+        _, dbg = _get(base, "/debug")
+        assert dbg["flight"]["truncated"] is True
+        assert sum(dbg["flight"]["dropped_by_source"].values()) == \
+            dbg["flight"]["dropped"]
+    finally:
+        server.stop()
+        ctl.stop()
+
+
+def test_server_timeline_requires_obs():
+    handle, catalog = _build_count_pipeline()
+    ctl = Controller(handle, catalog, QUIET)
+    server = CircuitServer(ctl)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        for path in ("/timeline", "/spikes"):
+            try:
+                urllib.request.urlopen(base + path, timeout=10)
+                raise AssertionError(f"{path} served without obs")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+    finally:
+        server.stop()
+        ctl.stop()
+
+
+def test_status_rides_input_queue_depths(tmp_path):
+    src = tmp_path / "in.csv"
+    src.write_text("".join(f"{i},{i}\n" for i in range(32)))
+    handle, catalog = _build_count_pipeline()
+    ctl = Controller(handle, catalog, QUIET)
+    # the transport feeds the endpoint buffer immediately; the quiet
+    # config never steps, so the rows sit visibly in the queue
+    ctl.add_input_endpoint("file_in", "events",
+                           FileInputTransport(str(src)), fmt="csv")
+    server = CircuitServer(ctl)
+    deadline = time.time() + 10
+    while ctl.inputs["file_in"].buffered() < 32 and time.time() < deadline:
+        time.sleep(0.01)
+    st = server.status_dict()
+    assert st["input_queue_depths"] == {"file_in": 32}
+    assert ctl.input_queue_depths() == {"file_in": 32}
+    ctl.step()
+    assert server.status_dict()["input_queue_depths"] == {"file_in": 0}
+    ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# freshness gate: served q4, host AND compiled engines
+# ---------------------------------------------------------------------------
+
+
+def _q4_served(validate_every=None):
+    from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    for name, h, key, vals in (
+            ("persons", handles[0], M.PERSON_KEY, M.PERSON_VALS),
+            ("auctions", handles[1], M.AUCTION_KEY, M.AUCTION_VALS),
+            ("bids", handles[2], M.BID_KEY, M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    obs = PipelineObs(name="fg")
+    if validate_every is None:
+        driver = handle
+        obs.attach_circuit(handle.circuit)
+    else:
+        from dbsp_tpu.compiled.driver import CompiledCircuitDriver
+
+        driver = CompiledCircuitDriver(handle,
+                                       validate_every=validate_every)
+        obs.attach_compiled(driver)
+    ctl = Controller(driver, catalog, QUIET)
+    obs.attach_controller(ctl)
+    gen = NexmarkGenerator(GeneratorConfig(seed=11))
+    return ctl, obs, handles, gen
+
+
+def _drive(ctl, gen, handles, t0, t1, ept=64):
+    for t in range(t0, t1):
+        gen.feed(handles, t * ept, (t + 1) * ept)
+        ctl.note_pushed(ept)
+        ctl.step()
+
+
+def _assert_freshness_gate(ctl, obs, handles, gen, interval_ticks):
+    tl = obs.timeline
+    t_start = time.time()
+    _drive(ctl, gen, handles, 0, 8)
+    wall = time.time() - t_start
+    tick_budget = max(1.0, wall / 8 * 4)  # one tick, with 4x host noise
+    ctl.pause()  # quiesce: close any open deferred-validation interval
+    fr = tl.freshness_summary()
+    # non-vacuous: visibility actually published samples for the view
+    assert fr["q4"]["samples"] > 0, fr
+    # the gate: staleness within validation interval + one tick budget
+    bound = interval_ticks * (wall / 8) + tick_budget
+    assert fr["q4"]["staleness_s"] <= bound, (fr, bound)
+    assert max(tl.staleness().values(), default=0.0) <= bound
+    # seeded stall: rows arrive, no step serves them — staleness must
+    # cross the bound, and the stall is flight-attributed on the timeline
+    stall_t0 = time.time()
+    ctl.flight.record("transport", endpoint="persons", state="stalled",
+                      error="seeded stall")
+    gen.feed(handles, 9 * 64, 10 * 64)
+    ctl.note_pushed(64)
+    time.sleep(min(1.5, bound) + 0.25)
+    stalled = tl.freshness_summary()["q4"]["staleness_s"]
+    assert stalled >= min(1.5, bound), stalled
+    obs.watch()  # fold the stall's flight event into the timeline
+    ev = [r for r in tl.records(kinds=("transport",))
+          if r.get("error") == "seeded stall"]
+    assert ev and stall_t0 - 1.0 <= ev[0]["ts"] <= time.time()
+    # recovery: serving the pending rows publishes and staleness resets
+    ctl.start()
+    ctl.step()
+    ctl.pause()
+    assert tl.freshness_summary()["q4"]["staleness_s"] < tick_budget
+    ctl.stop()
+
+
+def test_freshness_gate_host_engine():
+    ctl, obs, handles, gen = _q4_served(validate_every=None)
+    # host engine validates every step: interval term is zero
+    _assert_freshness_gate(ctl, obs, handles, gen, interval_ticks=0)
+
+
+def test_freshness_gate_compiled_engine():
+    ctl, obs, handles, gen = _q4_served(validate_every=4)
+    drv = ctl.handle
+    assert drv.mode == "compiled"
+    assert drv.open_interval_age_s is None
+    _assert_freshness_gate(ctl, obs, handles, gen, interval_ticks=4)
+
+
+def test_compiled_open_interval_age_surfaces():
+    ctl, obs, handles, gen = _q4_served(validate_every=4)
+    drv = ctl.handle
+    _drive(ctl, gen, handles, 0, 2)  # mid-interval: 2 retained ticks
+    assert drv.interval_open
+    age = drv.open_interval_age_s
+    assert age is not None and 0.0 <= age < 60.0
+    server = CircuitServer(ctl)
+    st = server.status_dict()
+    assert st["open_interval_age_s"] == pytest.approx(age, abs=5.0)
+    ctl.pause()  # flush closes the interval
+    assert not drv.interval_open
+    assert drv.open_interval_age_s is None
+    assert server.status_dict()["open_interval_age_s"] is None
+    ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# manager proxy + client surface
+# ---------------------------------------------------------------------------
+
+TABLES = {
+    "bids": {"columns": ["auction", "bidder", "price"],
+             "dtypes": ["int64", "int64", "int64"], "key_columns": 1},
+    "auctions": {"columns": ["id", "category"],
+                 "dtypes": ["int64", "int64"], "key_columns": 1},
+}
+SQL = {"cat_stats":
+       "SELECT auctions.category, COUNT(*) AS n, MAX(bids.price) AS hi "
+       "FROM bids JOIN auctions ON bids.auction = auctions.id "
+       "GROUP BY auctions.category"}
+QUIET_CFG = {"min_batch_records": 10**9, "flush_interval_s": 3600.0}
+
+
+def test_manager_timeline_proxy_and_client(monkeypatch):
+    from dbsp_tpu.client import Connection
+    from dbsp_tpu.manager import PipelineManager
+
+    monkeypatch.setenv("DBSP_TPU_MANAGER_COMPILED", "0")
+    m = PipelineManager()
+    m.start()
+    try:
+        conn = Connection(port=m.port)
+        conn.create_program("prog", TABLES, SQL)
+        pipe = conn.start_pipeline("pt", "prog", config=QUIET_CFG)
+        n = 0
+        for _ in range(5):
+            pipe.push("auctions", [[n + i, (n + i) % 7] for i in range(16)])
+            pipe.push("bids", [[n + i, (n + i) % 5, 100 + i]
+                               for i in range(16)])
+            pipe.step()
+            n += 16
+        tl = pipe.timeline()
+        assert {r["kind"] for r in tl["records"]} >= {"tick", "arrival"}
+        assert tl["freshness"]["cat_stats"]["samples"] == 5
+        sp = pipe.explain_spike()
+        assert sp["ticks_seen"] >= 5 and isinstance(sp["spikes"], list)
+        # filtered proxy read + the Connection-level aliases
+        tlv = pipe.timeline(view="cat_stats", n=3)
+        assert 0 < len(tlv["records"]) <= 3
+        assert conn.timeline_pipeline("pt")["last_seq"] >= \
+            tl["last_seq"]
+        assert conn.spikes_pipeline("pt")["ticks_seen"] >= 5
+        # unknown pipeline: proxy 404s (client surfaces the error body)
+        with pytest.raises(RuntimeError, match="not found"):
+            conn.timeline_pipeline("nope")
+    finally:
+        m.stop()

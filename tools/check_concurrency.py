@@ -522,6 +522,7 @@ class FlightRecorder:  # reuses the real schema entry: _ring is lock(_lock)
         self._ring = []
         self._seq = 0
         self.dropped = 0
+        self.dropped_by_source = {}
 '''
 
 _TWO_LOCKS_SCHEMA = {

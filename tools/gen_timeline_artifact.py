@@ -1,0 +1,249 @@
+"""Generate the committed EXPLAIN SPIKE artifact (``TIMELINE_q4.json``).
+
+Runs the full host-engine q4 serving protocol (Runtime + Catalog +
+Controller + PipelineObs — the same wiring a deployed pipeline gets)
+twice in one process:
+
+1. **Perturbed run** — three seeded perturbations land on three distinct
+   ticks, each a REAL subsystem action plus a deterministic in-step stall
+   sized past the spike threshold (4 x warmup median, never below 50ms):
+
+   - *forced checkpoint*: ``checkpoint_every_ticks`` fires the real
+     periodic in-step checkpoint (blob store write + ``checkpoint``
+     flight event with byte counts) on the target tick;
+   - *forced residency demotion*: tiny device/host budgets are applied
+     through the public ``residency.resolve``/``apply_to_driver`` path
+     one tick early, so the target tick's trace maintenance genuinely
+     demotes rows (spine ``residency_log`` -> ``residency`` flight
+     events with tier_from/tier_to); budgets are restored right after;
+   - *transport blip*: a ``transport`` flight event with an error and a
+     stall, the shape a wedged sink/source produces.
+
+   Every target tick MUST be flagged by ``Timeline.explain_spikes`` and
+   attributed to its cause with co-timed evidence, or this script exits
+   non-zero (the artifact is self-asserting — a stale or vacuous JSON
+   cannot be committed by accident).
+
+2. **Control run** — the identical protocol with no perturbations MUST
+   report zero spikes (no false positives on clean q4 ticks).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/gen_timeline_artifact.py \
+        --out TIMELINE_q4.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# explicit detector floor shared with the lint front: seeded stalls
+# (>= 50ms) sit above it, host scheduling noise sits below it
+os.environ.setdefault("DBSP_TPU_SPIKE_FLOOR_MS", "40")
+
+EVENTS_PER_TICK = 100
+WARM_TICKS = 10       # baseline ticks before any perturbation (> _MIN_BASELINE)
+TOTAL_TICKS = 24
+TARGETS = {"checkpoint": 12, "residency": 16, "transport": 20}
+
+
+def _run_protocol(seed: int, perturb: bool, workdir: str) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from dbsp_tpu import residency
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+    from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.obs import PipelineObs
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    for name, h, key, vals in (("persons", handles[0], M.PERSON_KEY,
+                                M.PERSON_VALS),
+                               ("auctions", handles[1], M.AUCTION_KEY,
+                                M.AUCTION_VALS),
+                               ("bids", handles[2], M.BID_KEY, M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    cfg = ControllerConfig(min_batch_records=10**9, flush_interval_s=3600.0)
+    if perturb:
+        # the real periodic in-step checkpoint fires on the target tick
+        cfg = ControllerConfig(
+            min_batch_records=10**9, flush_interval_s=3600.0,
+            checkpoint_dir=os.path.join(workdir, "ckpt"),
+            checkpoint_every_ticks=TARGETS["checkpoint"])
+    ctl = Controller(handle, catalog, cfg)
+    obs = PipelineObs(name="timeline-artifact")
+    obs.attach_circuit(handle.circuit)
+    obs.attach_controller(ctl)
+    tl = obs.timeline
+
+    stall = {"s": 0.0}
+
+    def _seeded_stall(kind: str, **fields) -> None:
+        """The deterministic half of a perturbation: an ns-weighted flight
+        event of the real cause's kind, plus the in-step sleep that pushes
+        the tick past the spike threshold. Runs inside the step lock
+        (monitors do), so the stall counts toward the tick's latency."""
+        ctl.flight.record(kind, tick=ctl.steps,
+                          ns=int(stall["s"] * 1e9), seeded=True, **fields)
+        time.sleep(stall["s"])
+
+    def perturb_monitor():
+        step = ctl.steps
+        if step == TARGETS["checkpoint"]:
+            # _maybe_checkpoint_locked already ran this tick (it precedes
+            # monitors in _step_locked) and recorded the real event
+            _seeded_stall("checkpoint")
+        elif step == TARGETS["residency"] - 1:
+            # tiny budgets through the public path: NEXT tick's trace
+            # maintenance demotes for real (residency_log -> flight)
+            residency.apply_to_driver(handle, residency.resolve(
+                device_rows=64, host_rows=64,
+                cold_dir=os.path.join(workdir, "cold")))
+        elif step == TARGETS["residency"]:
+            _seeded_stall("residency")
+            # restore: explicit <= 0 disables the budgets again so the
+            # trailing ticks stay clean
+            residency.apply_to_driver(handle, residency.resolve(
+                device_rows=-1, host_rows=-1))
+        elif step == TARGETS["transport"]:
+            _seeded_stall("transport", endpoint="bids", state="stalled",
+                          error="seeded transport blip")
+
+    if perturb:
+        ctl.add_monitor(perturb_monitor)
+
+    gen = NexmarkGenerator(GeneratorConfig(seed=seed))
+    for t in range(TOTAL_TICKS):
+        if perturb and t == WARM_TICKS:
+            # size the stall against BOTH branches of the detector's
+            # threshold (max(mult*med, med + 8*MAD)): early host-q4
+            # ticks carry JIT-compile noise, so the MAD term can
+            # dominate the multiplicative one
+            lats = sorted(r["latency_ns"] for r in tl.records()
+                          if r["kind"] == "tick" and r.get("src") == "ctl")
+            med = lats[len(lats) // 2]
+            mad = sorted(abs(x - med) for x in lats)[len(lats) // 2]
+            stall["s"] = max(0.05, 3.0 * med / 1e9,
+                             9.0 * mad / 1e9) + 0.15
+        gen.feed(handles, t * EVENTS_PER_TICK, (t + 1) * EVENTS_PER_TICK)
+        ctl.note_pushed(EVENTS_PER_TICK)
+        ctl.step()
+    obs.watch()  # fold the last tick's flight events into the timeline
+
+    sp = tl.explain_spikes()
+    return {"spikes": sp["spikes"], "ticks_seen": sp["ticks_seen"],
+            "baseline": sp["baseline"], "stall_s": stall["s"],
+            "freshness": tl.freshness_summary(),
+            "staleness": tl.staleness()}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="TIMELINE_q4.json")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="dbsp_tl_artifact_") as wd:
+        perturbed = _run_protocol(args.seed, perturb=True, workdir=wd)
+        control = _run_protocol(args.seed, perturb=False, workdir=wd)
+
+    by_tick = {s["tick"]: s for s in perturbed["spikes"]}
+    pert_records = []
+    for cause, tick in sorted(TARGETS.items(), key=lambda kv: kv[1]):
+        hit = by_tick.get(tick)
+        if hit is None:
+            failures.append(
+                f"seeded {cause} perturbation on tick {tick} was NOT "
+                f"flagged as a spike (spikes: "
+                f"{sorted(by_tick)})")
+        elif hit["cause"] != cause:
+            failures.append(
+                f"tick {tick} flagged but misattributed: expected "
+                f"{cause!r}, got {hit['cause']!r} "
+                f"({json.dumps(hit['evidence'])[:400]})")
+        elif not hit["evidence"]:
+            failures.append(f"tick {tick} attributed to {cause} with no "
+                            "evidence")
+        pert_records.append({
+            "cause": cause, "tick": tick,
+            "detected": hit is not None,
+            "attributed": bool(hit) and hit["cause"] == cause,
+            "spike": hit})
+    # the residency spike must carry the REAL demotion in its evidence,
+    # not only the seeded marker event
+    res_hit = by_tick.get(TARGETS["residency"])
+    if res_hit and res_hit["cause"] == "residency":
+        evs = [e for st in res_hit["evidence"] if st["cause"] == "residency"
+               for e in st["events"]]
+        if not any("tier_from" in e for e in evs):
+            failures.append(
+                "residency spike evidence has no real tier transition "
+                f"(spine demotion did not fire): {json.dumps(evs)[:400]}")
+    stray = [s for s in perturbed["spikes"]
+             if s["tick"] not in TARGETS.values()]
+    if control["spikes"]:
+        failures.append(
+            f"unperturbed control run reported spikes: "
+            f"{json.dumps(control['spikes'])[:600]}")
+    if not perturbed["freshness"].get("q4", {}).get("samples"):
+        failures.append("perturbed run produced no q4 freshness samples")
+
+    artifact = {
+        "artifact": "TIMELINE_q4",
+        "generated_by": "tools/gen_timeline_artifact.py",
+        "protocol": {
+            "query": "q4", "engine": "host", "seed": args.seed,
+            "events_per_tick": EVENTS_PER_TICK, "ticks": TOTAL_TICKS,
+            "warmup_ticks": WARM_TICKS, "stall_s": perturbed["stall_s"],
+            "spike_floor_ms": float(
+                os.environ["DBSP_TPU_SPIKE_FLOOR_MS"]),
+        },
+        "detector": perturbed["baseline"],
+        "perturbations": pert_records,
+        "stray_spikes": stray,
+        "control": {"ticks_seen": control["ticks_seen"],
+                    "spikes": control["spikes"]},
+        "freshness": perturbed["freshness"],
+        "staleness_at_end": perturbed["staleness"],
+        "ok": not failures,
+        "failures": failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=False)
+        f.write("\n")
+    print(f"wrote {args.out}: "
+          f"{sum(1 for p in pert_records if p['attributed'])}/3 "
+          f"perturbations attributed, "
+          f"{len(control['spikes'])} control spikes, "
+          f"{len(stray)} stray spikes")
+    if failures:
+        print("FAILURES:", file=sys.stderr)
+        for msg in failures:
+            print(f"  - {msg}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,14 @@ the tier-1 test in tests/test_analysis.py):
    output row and verify it against the provenance-semiring recompute
    oracle, red on divergence — EXPLAIN WHY cannot silently rot. The
    import-based tier-1 consumer is tests/test_lineage.py.
+7. **Timeline front** (CLI only; DBSP_TPU_LINT_TIMELINE=0 skips) — a
+   host q4 dryrun behind the full Controller + PipelineObs wiring, in
+   subprocesses: a seeded >= 50ms in-step stall with a co-timed
+   checkpoint flight event MUST surface as a spike attributed to
+   ``checkpoint`` with evidence, the unperturbed control run MUST report
+   zero spikes, freshness samples must flow arrival->visibility, and the
+   always-on note_* hot path must stay under its per-op overhead bound.
+   The import-based tier-1 consumer is tests/test_timeline.py.
 
 Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
 1 when any front fails.
@@ -190,7 +198,11 @@ def run_check_dashboard() -> list:
                 violations.append(f"{rel}: panel {title!r} target "
                                   f"references no dbsp metric: {expr!r}")
             for n in names:
-                if n not in known:
+                # histogram/summary families register under the base
+                # name but expose _bucket/_sum/_count series — exprs
+                # like histogram_quantile(..., name_bucket) are valid
+                base = _re.sub(r"_(bucket|sum|count)$", "", n)
+                if n not in known and base not in known:
                     violations.append(
                         f"{rel}: panel {title!r} references unknown "
                         f"metric {n!r} (not a registration site under "
@@ -648,6 +660,191 @@ def run_lineage_dryrun() -> list:
     return []
 
 
+def _timeline_dryrun_child() -> None:
+    """Subprocess body for the timeline front: a host-engine q4 growth
+    dryrun behind a Controller + PipelineObs (the full serving wiring:
+    note_tick / note_arrival / note_visible + flight ingest). With
+    DBSP_TPU_LINT_TL_STALL=1 one target tick is stalled inside the step
+    lock (>= 50ms, scaled past the spike threshold) with a co-timed
+    checkpoint flight event; prints spikes + freshness + the note_* hot
+    path's per-op overhead as one JSON line."""
+    import json
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+    from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.obs import PipelineObs
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    for name, h, key, vals in (("persons", handles[0], M.PERSON_KEY,
+                                M.PERSON_VALS),
+                               ("auctions", handles[1], M.AUCTION_KEY,
+                                M.AUCTION_VALS),
+                               ("bids", handles[2], M.BID_KEY, M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    ctl = Controller(handle, catalog, ControllerConfig(
+        min_batch_records=10**9, flush_interval_s=3600.0))
+    obs = PipelineObs(name="lint")
+    obs.attach_circuit(handle.circuit)
+    obs.attach_controller(ctl)
+    tl = obs.timeline
+
+    gen = NexmarkGenerator(GeneratorConfig(seed=7))
+    ept, warm, target, total = 100, 10, 16, 20
+    stall = {"at": None, "s": 0.0}
+
+    def stall_monitor():
+        if ctl.steps == stall["at"]:
+            ctl.flight.record("checkpoint", tick=ctl.steps,
+                              ns=int(stall["s"] * 1e9), seeded=True)
+            time.sleep(stall["s"])
+
+    ctl.add_monitor(stall_monitor)
+
+    def drive(t0, t1):
+        for t in range(t0, t1):
+            gen.feed(handles, t * ept, (t + 1) * ept)
+            ctl.note_pushed(ept)
+            ctl.step()
+
+    drive(0, warm)
+    if os.environ.get("DBSP_TPU_LINT_TL_STALL") == "1":
+        lats = sorted(r["latency_ns"] for r in tl.records()
+                      if r["kind"] == "tick" and r.get("src") == "ctl")
+        med_s = lats[len(lats) // 2] / 1e9
+        # past the detector's max(mult*med, med+floor) threshold with
+        # margin, never below the issue's 50ms floor
+        stall["s"] = max(0.05, 4.0 * med_s + 0.02)
+        stall["at"] = target
+    drive(warm, total)
+    obs.watch()  # fold the last tick's flight events into the timeline
+
+    sp = tl.explain_spikes()
+    print(json.dumps({
+        "ticks": sp["ticks_seen"],
+        "target_tick": stall["at"],
+        "stall_s": stall["s"],
+        "spikes": [{"tick": s["tick"], "cause": s["cause"],
+                    "latency_ns": s["latency_ns"],
+                    "evidence": s["evidence"]} for s in sp["spikes"]],
+        "freshness": tl.freshness_summary(),
+        "note_overhead_ns": _timeline_note_overhead_ns(),
+    }))
+
+
+def _timeline_note_overhead_ns() -> float:
+    """Per-op cost of the always-on note_tick/note_arrival/note_visible
+    hot path (a standalone ring: the measurement must not disturb the
+    dryrun's records)."""
+    import time
+
+    from dbsp_tpu.obs.timeline import Timeline
+
+    tl = Timeline(capacity=256, enabled=True)
+    n = 2000
+    t0 = time.perf_counter_ns()
+    for i in range(n):
+        tl.note_arrival(8)
+        tl.note_tick(i, 1_000_000, rows_in=8, rows_out=8, queue_depth=0)
+        tl.note_visible(["q4"])
+    return (time.perf_counter_ns() - t0) / (3 * n)
+
+
+def run_timeline_dryrun() -> list:
+    """7b. **Timeline front** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_TIMELINE=0`` skips — tests/test_timeline.py carries
+    the import-based tier-1 coverage): a host q4 dryrun with a seeded
+    >= 50ms in-step stall + co-timed checkpoint flight event MUST surface
+    the stalled tick as a spike attributed to ``checkpoint`` with
+    evidence; the unperturbed control run MUST report zero spikes (the
+    detector neither rots nor cries wolf); and the always-on note_* hot
+    path must stay under the per-op overhead bound."""
+    import json
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_TIMELINE", "1") == "0":
+        print("lint_all: timeline_dryrun: skipped "
+              "(DBSP_TPU_LINT_TIMELINE=0)")
+        return []
+
+    def child(stall):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DBSP_TPU_LINT_TL_STALL="1" if stall else "0",
+                   # explicit detector floor: perturbation (>=50ms) sits
+                   # above it, host scheduling noise sits below it
+                   DBSP_TPU_SPIKE_FLOOR_MS="40")
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "from tools.lint_all import _timeline_dryrun_child; "
+                 "_timeline_dryrun_child()"],
+                cwd=_ROOT, env=env, capture_output=True, text=True,
+                timeout=600)
+        except subprocess.TimeoutExpired:
+            return None, "timeline dryrun timed out after 600s"
+        if p.returncode != 0:
+            return None, (f"timeline dryrun failed:\n{p.stdout[-800:]}\n"
+                          f"{p.stderr[-800:]}")
+        try:
+            return json.loads(p.stdout.strip().splitlines()[-1]), None
+        except (ValueError, IndexError):
+            return None, f"timeline dryrun emitted no JSON:\n" \
+                         f"{p.stdout[-400:]}"
+
+    violations = []
+    stalled, err = child(stall=True)
+    if err:
+        return [err]
+    hits = [s for s in stalled.get("spikes", [])
+            if s["tick"] == stalled.get("target_tick")]
+    if not hits:
+        violations.append(
+            f"seeded {stalled.get('stall_s', 0):.3f}s stall on tick "
+            f"{stalled.get('target_tick')} was not flagged as a spike "
+            f"({json.dumps(stalled.get('spikes'))}) — EXPLAIN SPIKE is "
+            "blind to a real latency outlier")
+    elif hits[0]["cause"] != "checkpoint" or not hits[0]["evidence"]:
+        violations.append(
+            f"seeded stall flagged but misattributed "
+            f"({json.dumps(hits[0])}) — expected cause=checkpoint with "
+            "co-timed evidence")
+    if not stalled.get("freshness", {}).get("q4", {}).get("samples"):
+        violations.append(
+            f"q4 dryrun produced no freshness samples "
+            f"({json.dumps(stalled.get('freshness'))}) — the arrival->"
+            "visibility pipeline is dead")
+    if stalled.get("note_overhead_ns", 1e9) > 25_000:
+        violations.append(
+            f"timeline note_* hot path costs "
+            f"{stalled['note_overhead_ns']:.0f}ns/op (bound: 25000) — "
+            "the always-on ring is too expensive for the step lock")
+    control, err = child(stall=False)
+    if err:
+        return violations + [err]
+    if control.get("spikes"):
+        violations.append(
+            f"unperturbed control run reported spikes "
+            f"({json.dumps(control['spikes'])}) — the detector cries "
+            "wolf on clean q4 ticks and every attribution is suspect")
+    return violations
+
+
 def main() -> int:
     fronts = [("check_metrics", run_check_metrics),
               ("check_hotpath", run_check_hotpath),
@@ -661,7 +858,8 @@ def main() -> int:
               ("kernel_dryrun", run_kernel_dryrun),
               ("residency", run_residency_dryrun),
               ("profile_dryrun", run_profile_dryrun),
-              ("lineage_dryrun", run_lineage_dryrun)]
+              ("lineage_dryrun", run_lineage_dryrun),
+              ("timeline_dryrun", run_timeline_dryrun)]
     failed = 0
     for name, fn in fronts:
         violations = fn()
