@@ -573,11 +573,19 @@ def _measure_compiled_query(qname: str, platform: str, detail: dict) -> float:
     # in the next tick's latency — take ~2 per measured run; a (rare,
     # post-presize) overflow replays up to half the run, exactly
     snap_every = max(1, ticks // validate_every // 2)
-    ch.run_ticks(m0, ticks, validate_every=validate_every,
-                 on_validated=progress, block_each=True, scan=scan,
-                 project_ratio=4.0, snapshot_every=snap_every)
-    ch.block()
-    elapsed = _time.perf_counter() - t0
+    # compilation sentinel over the measured run: every recompile must
+    # carry a declared cause and the steady state must stay free of
+    # implicit host<->device transfers (jax.transfer_guard armed) — the
+    # per-query evidence lands in detail["retrace"] below
+    from dbsp_tpu.testing import retrace as _retrace_mod
+
+    with _retrace_mod.session(ch) as retrace_report:
+        ch.run_ticks(m0, ticks, validate_every=validate_every,
+                     on_validated=progress, block_each=True, scan=scan,
+                     project_ratio=4.0, snapshot_every=snap_every)
+        ch.block()
+        elapsed = _time.perf_counter() - t0
+    detail["retrace"] = retrace_report.summary()
     measured = ticks * batch
 
     eps = measured / elapsed

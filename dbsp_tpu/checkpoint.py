@@ -127,6 +127,11 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "_gen_fn": "config",
         "_step_jit": "derived",
         "_scan_jits": "derived",
+        # device-resident tick cursor (program output, re-uploaded on any
+        # discontinuity) + the transfer-guard level testing/retrace.py arms
+        "_tick_dev": "derived",
+        "_tick_host": "derived",
+        "_steady_guard": "runtime",
         "_checks": "derived",
         "_req": "derived",
         "_max_jit": "derived",
@@ -998,6 +1003,10 @@ def _restore_compiled(ch, payload: dict, dec: _Decoder,
     ch._step_jit = None
     ch._scan_jits = {}
     ch._req = None
+    # tick discontinuity: the next dispatch re-uploads the cursor
+    # explicitly (compiler._tick_operand)
+    ch._tick_dev = None
+    ch._tick_host = None
     ch._ckpt_salt = uuid.uuid4().hex[:12]  # new buffers, new link scope
     return states
 

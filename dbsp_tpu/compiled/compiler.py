@@ -54,6 +54,7 @@ around a compiled core).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from functools import partial
@@ -284,6 +285,20 @@ class CompiledHandle:
                     st = jax.device_put(st, worker_sharding(self.mesh))
                 self.states[str(cn.node.index)] = st
         self._step_jit = None
+        # device-resident tick cursor: the step program RETURNS tick+1 (and
+        # the scan program t0+n), so the steady state never uploads the
+        # tick scalar — the old per-tick jnp.asarray(tick) was an implicit
+        # h2d transfer on every dispatch, the exact class
+        # jax.transfer_guard("disallow") convicts (testing/retrace.py).
+        # _tick_host mirrors the device value; a mismatch (restore, replay,
+        # manual tick jump) re-uploads EXPLICITLY via jax.device_put.
+        self._tick_dev = None
+        self._tick_host: Optional[int] = None
+        # armed by testing/retrace.py's sentinel session: a
+        # jax.transfer_guard level ("disallow") wrapped around the jitted
+        # step/scan calls so implicit device<->host transfers in the
+        # steady tick raise with a stack
+        self._steady_guard: Optional[str] = None
         self._checks: List[Tuple[CNode, str]] = []
         self._req = None          # device running-max of requirements
         self._max_jit = jax.jit(jnp.maximum)
@@ -867,7 +882,10 @@ class CompiledHandle:
         # must be real copies (see snapshot()).
         if self.mesh is None:
             def step_fn(states, tick, feeds, cold):
-                return self._run_nodes(states, tick, feeds, cold)
+                ns, outs, req = self._run_nodes(states, tick, feeds, cold)
+                # tick+1 rides the program output so the next dispatch
+                # reuses a device-resident cursor (no per-tick h2d upload)
+                return ns, outs, req, tick + 1
 
             return jax.jit(step_fn, donate_argnums=(0,))
 
@@ -900,7 +918,9 @@ class CompiledHandle:
             ns, outs, reqw = shard_map(
                 body, mesh=self.mesh, in_specs=(W, P(), W),
                 out_specs=(W, W, W))(states, tick, feeds)
-            return ns, outs, jnp.max(reqw, axis=0)
+            # tick+1 computed OUTSIDE the shard_map: tick is replicated, so
+            # the cursor output needs no worker axis
+            return ns, outs, jnp.max(reqw, axis=0), tick + 1
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
@@ -946,7 +966,8 @@ class CompiledHandle:
                 body, (states, init_outs), jnp.arange(n, dtype=jnp.int64))
             req = (jnp.max(reqs, axis=0) if reqs.shape[1]
                    else jnp.zeros((0,), jnp.int64))
-            return ns, outs, req
+            # t0+n: the device-resident tick cursor for the next chunk
+            return ns, outs, req, t0 + n
 
         if self.mesh is None:
             return jax.jit(_scan_body, donate_argnums=(0,))
@@ -964,14 +985,15 @@ class CompiledHandle:
                     lambda a: a[0], t)
                 expand = lambda t: jax.tree_util.tree_map(  # noqa: E731
                     lambda a: a[None], t)
-                ns, outs, req = _scan_body(squeeze(states_l), t0_l,
-                                           varying=True)
+                ns, outs, req, _ = _scan_body(squeeze(states_l), t0_l,
+                                              varying=True)
                 return expand(ns), expand(outs), req[None]
 
             ns, outs, reqw = shard_map(
                 body, mesh=self.mesh, in_specs=(W, P()),
                 out_specs=(W, W, W))(states, t0)
-            return ns, outs, jnp.max(reqw, axis=0)
+            # cursor computed outside the shard_map (t0 is replicated)
+            return ns, outs, jnp.max(reqw, axis=0), t0 + n
 
         return jax.jit(scan_fn, donate_argnums=(0,))
 
@@ -986,7 +1008,10 @@ class CompiledHandle:
             fn = cache[n] = self._make_scan(n)
         t_start = time.perf_counter_ns()
         hot, cold = self._split_states()
-        states, outputs, req = fn(hot, jnp.asarray(t0, jnp.int64), cold)
+        with self._guard():
+            states, outputs, req, tick_next = fn(
+                hot, self._tick_operand(t0), cold)
+        self._tick_dev, self._tick_host = tick_next, t0 + n
         self.states = self._rejoin_states(states, cold)
         self.last_outputs = outputs
         self._req = req if self._req is None else self._max_jit(self._req, req)
@@ -995,6 +1020,25 @@ class CompiledHandle:
         self._append_sample(time.perf_counter_ns() - t_start)
 
     # -- stepping ------------------------------------------------------------
+    def _tick_operand(self, tick: int):
+        """The device-resident tick scalar for ``tick``. Steady state: the
+        previous dispatch already returned it (tick+1 / t0+n is a program
+        output) — zero transfers. Discontinuities (first tick, restore,
+        overflow replay, manual jumps) upload EXPLICITLY via device_put,
+        which jax.transfer_guard("disallow") permits; what the guard
+        convicts is the implicit per-tick jnp.asarray(tick) this replaced."""
+        if self._tick_dev is None or self._tick_host != tick:
+            self._tick_dev = jax.device_put(np.int64(tick))
+            self._tick_host = tick
+        return self._tick_dev
+
+    def _guard(self):
+        """The transfer-guard context for the jitted step/scan call — a
+        no-op unless testing/retrace.py's sentinel armed _steady_guard."""
+        if self._steady_guard is None:
+            return contextlib.nullcontext()
+        return jax.transfer_guard(self._steady_guard)
+
     def _note_cause(self, cause: str) -> None:
         """Annotate the NEXT latency sample with a spike cause (maintain /
         snapshot / retrace) — consumed by :meth:`_append_sample`."""
@@ -1028,8 +1072,10 @@ class CompiledHandle:
             self._step_jit = self._make_step()
         f = self._feed_indices(feeds) if feeds else {}
         hot, cold = self._split_states()
-        states, outputs, req = self._step_jit(
-            hot, jnp.asarray(tick, jnp.int64), f, cold)
+        with self._guard():
+            states, outputs, req, tick_next = self._step_jit(
+                hot, self._tick_operand(tick), f, cold)
+        self._tick_dev, self._tick_host = tick_next, tick + 1
         self.states = self._rejoin_states(states, cold)
         self.last_outputs = outputs
         self._req = req if self._req is None else self._max_jit(self._req, req)
@@ -1852,6 +1898,13 @@ def compile_circuit(handle, gen_fn: Optional[Callable] = None,
                        workers=rt.workers if rt is not None else 1)
     prev = Runtime._swap(rt)
     try:
-        return CompiledHandle(handle.circuit, gen_fn=gen_fn, runtime=rt)
+        ch = CompiledHandle(handle.circuit, gen_fn=gen_fn, runtime=rt)
     finally:
         Runtime._swap(prev)
+    # retrace-sentinel construction hook (one flag check when disabled):
+    # under DBSP_TPU_RETRACE_SENTINEL=1 / retrace.session() the handle's
+    # program builders are ledgered and its transfer guard armed
+    from dbsp_tpu.testing import retrace as _retrace_sentinel
+
+    _retrace_sentinel.maybe_watch(ch)
+    return ch

@@ -595,3 +595,77 @@ def test_metrics_and_hotpath_lints_via_lint_all():
 
     assert run_check_metrics() == []
     assert run_check_hotpath() == []
+
+
+def test_retrace_lint_tree_is_clean_and_gallery_is_pure():
+    """The retrace/donation static pass: the real tree lints clean, and
+    the seeded-defect gallery proves every rule non-vacuous (fires on its
+    own defect) and pure (fires on NO other defect) — so a regression in
+    any one rule is caught even while the tree itself has no findings."""
+    import os
+
+    from tools.check_retrace import _ALL_RULES, check_tree, run_defects
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dbsp_tpu")
+    assert check_tree(pkg) == []
+
+    results = run_defects()
+    assert sorted(r for r, _, _ in results) == sorted(_ALL_RULES)
+    for rule, desc, findings in results:
+        assert any(f"{rule}:" in f for f in findings), \
+            f"{rule} gallery defect never fired ({desc}): {findings}"
+        impure = [f for f in findings
+                  if any(f"{r}:" in f for r in _ALL_RULES if r != rule)]
+        assert impure == [], f"{rule} gallery defect is impure: {impure}"
+
+
+def test_stale_waiver_audit_is_live_on_every_front(tmp_path):
+    """W001 non-vacuity across the waiver-honoring fronts: a waiver
+    comment with no suppressible finding on its line is flagged, a waiver
+    that actually suppresses one is not, and a comment merely MENTIONING
+    a marker mid-prose is neither."""
+    from tools.check_hotpath import check_tree as hotpath_tree
+    from tools.check_retrace import check_source as retrace_source
+    from tools.schema_walk import WAIVER_MARKERS, stale_waivers
+
+    # hotpath front, end to end: one used waiver, one stale, one mention
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def k(x):\n"
+        "    y = x.item()  # hotpath: ok fetched at the sync point\n"
+        "    z = 1 + 1  # hotpath: ok nothing here needs this\n"
+        "    # the idiom is a trailing '# hotpath: ok <why>' comment\n"
+        "    return y + z\n")
+    violations = hotpath_tree(str(pkg))
+    w001 = [v for v in violations if "W001:" in v]
+    assert len(w001) == 1 and ":5:" in w001[0], violations
+    assert not any(":4:" in v or ":6:" in v for v in w001)
+
+    # the shared audit itself honors "used" lines for every marker
+    for marker in WAIVER_MARKERS:
+        src = f"x = 1  {marker} used\ny = 2  {marker} stale\n"
+        out = stale_waivers(src, "m.py", marker, used=[1])
+        assert len(out) == 1 and "m.py:2:" in out[0]
+
+    # retrace front: a stale retrace waiver in an unregistered module
+    src = "a = 1  # retrace: ok left behind\n"
+    findings = retrace_source(src, "pkg/loose.py")
+    assert any("W001:" in f for f in findings)
+
+
+def test_lint_all_static_fronts_cover_every_pure_static_pass():
+    """``lint_all --static`` (CI's lint job) runs the full static family
+    — including both sanitizer halves added since the fronts list was
+    last grown — and each front comes back clean on this tree."""
+    from tools import lint_all
+
+    names = [n for n, _ in lint_all.STATIC_FRONTS]
+    for expected in ("check_metrics", "check_hotpath", "check_state",
+                     "check_concurrency", "check_retrace"):
+        assert expected in names
+    assert lint_all.run_check_concurrency_static() == []
+    assert lint_all.run_check_retrace() == []

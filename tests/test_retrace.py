@@ -1,0 +1,221 @@
+"""Tier-1 gates for the compilation-stability sanitizer.
+
+Three layers, matching the sanitizer's halves (registry: ``dbsp_tpu.
+retrace``; static pass: ``tools/check_retrace.py``; runtime sentinel:
+``dbsp_tpu.testing.retrace``):
+
+* **q1-q8 steady state at zero.** Every Nexmark query's compiled
+  steady-state loop — post-warmup, post-presize, the growth protocol
+  bench.py measures under — runs inside a sentinel session: zero
+  UNDECLARED recompiles (every ``step_fn``/``_scan_body`` compile is
+  ledgered to a declared cause) and zero IMPLICIT host<->device
+  transfers (``jax.transfer_guard("disallow")`` armed over the jitted
+  dispatch — a violation raises at the dispatch site, so mere completion
+  is the proof).
+* **Seeded non-vacuity, runtime.** A jitted step with a python-value
+  branch on its tick (the per-value retrace anti-pattern) must be
+  caught across several seeds; the control (one distinct value) must
+  stay silent — the sentinel neither rots nor cries wolf.
+* **Seeded non-vacuity, static.** The REAL checkpoint decoder's owning
+  ``jnp.array`` copy is load-bearing: flipping it to ``jnp.asarray`` in
+  the real source must raise exactly one D001 (zero-copy view escaping
+  into donated state), and a ``# retrace: ok`` waiver must suppress it.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from dbsp_tpu.testing import retrace as sentinel  # noqa: E402
+
+QUERIES = ("q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8")
+
+
+def _compiled_query(qname, per_tick=60, seed=7):
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.compiled import compile_circuit
+    from dbsp_tpu.nexmark import (GeneratorConfig, build_inputs, device_gen,
+                                  queries)
+
+    cfg = GeneratorConfig(seed=seed)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, getattr(queries, qname)(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    hp, ha, hb = handles
+
+    def gen_fn(tick):
+        p, a, b = device_gen.generate_tick(cfg, tick * per_tick, per_tick)
+        return {hp: p, ha: a, hb: b}
+
+    return compile_circuit(handle, gen_fn=gen_fn), out
+
+
+@pytest.mark.parametrize("qname", QUERIES)
+def test_compiled_steady_state_is_recompile_and_transfer_free(qname):
+    """The acceptance gate: q1-q8's compiled steady state shows zero
+    undeclared recompiles AND zero implicit transfers, under the same
+    warmup -> presize -> measure protocol bench.py runs."""
+    ch, out = _compiled_query(qname)
+    warm = 3
+    ch.run_ticks(0, warm, validate_every=1, project_ratio=4.0)
+    ch.presize(1.0, interval=1)
+    # one post-presize tick so the steady region starts on a compiled
+    # program (any presize-driven rebuild compiles here, outside the gate)
+    ch.run_ticks(warm, 1, validate_every=1, project_ratio=4.0)
+    with sentinel.session(ch) as report:
+        ch.run_ticks(warm + 1, 4, validate_every=2, project_ratio=4.0)
+        ch.block()
+    assert report.undeclared() == [], report.summary()
+    summary = report.summary()
+    assert summary["transfer_guard"] == "disallow"
+    # the gate must not be vacuous: the sentinel set is being tracked
+    assert any(p.endswith((".step_fn", "._scan_body"))
+               for p in summary["programs"])
+
+
+def test_steady_state_scan_path_is_clean():
+    """The lax.scan chunk path (TPU dispatch amortization) under the
+    sentinel: chunked steady ticks stay at zero undeclared."""
+    ch, out = _compiled_query("q4")
+    ch.run_ticks(0, 3, validate_every=1, project_ratio=4.0)
+    ch.presize(1.0, interval=2)
+    ch.run_ticks(3, 1, validate_every=1, project_ratio=4.0)
+    with sentinel.session(ch) as report:
+        ch.run_ticks(4, 4, validate_every=2, scan=True, project_ratio=4.0)
+        ch.block()
+    assert report.undeclared() == [], report.summary()
+
+
+@pytest.mark.parametrize("seed", (0, 1, 2))
+def test_sentinel_catches_seeded_per_value_retrace(seed):
+    """A python-value branch on a static tick recompiles per distinct
+    value; one declared construction cannot cover three compiles — the
+    sentinel must flag it (NOT waivable at runtime)."""
+
+    def step_fn(state, tick):
+        if tick % 2 == 0:  # python branch burned in per static value
+            return state + 1
+        return state - 1
+
+    seeded = jax.jit(step_fn, static_argnums=(1,))
+    with sentinel.session() as report:
+        sentinel.note_construction("step_fn")
+        st = jnp.zeros((), jnp.int64)
+        for t in range(3):
+            st = seeded(st, seed * 10 + t)
+    bad = report.undeclared()
+    assert bad and "step_fn" in bad[0], bad
+    # the ledger persists past session exit (reset happens on the NEXT
+    # enter), so the raising entry point sees the same imbalance
+    from dbsp_tpu.retrace import RetraceError
+
+    with pytest.raises(RetraceError, match="undeclared recompile"):
+        sentinel.check()
+    sentinel.reset()
+
+
+def test_sentinel_control_stays_silent():
+    """The control: one distinct static value, one declared construction
+    — at most one compile, the ledger balances, no false positive."""
+
+    def step_fn(state, tick):
+        if tick % 2 == 0:
+            return state + 1
+        return state - 1
+
+    ctl = jax.jit(step_fn, static_argnums=(1,))
+    with sentinel.session() as report:
+        sentinel.note_construction("step_fn")
+        st = jnp.zeros((), jnp.int64)
+        for _ in range(3):
+            st = ctl(st, 4)  # same static value every call
+    assert report.undeclared() == []
+    assert report.compiles.get("step_fn", 0) <= 1
+
+
+def test_sentinel_session_restores_loggers_and_handle():
+    """session() leaves no residue: logger levels/propagation restored,
+    the handle's builder shadows removed, the guard disarmed."""
+    import logging
+
+    ch, out = _compiled_query("q1", per_tick=20)
+    before = {n: (logging.getLogger(n).level, logging.getLogger(n).propagate)
+              for n in sentinel._COMPILE_LOGGERS}
+    with sentinel.session(ch):
+        assert ch._steady_guard == "disallow"
+        assert "_make_step" in ch.__dict__  # instance shadow installed
+    assert ch._steady_guard is None
+    assert "_make_step" not in ch.__dict__
+    after = {n: (logging.getLogger(n).level, logging.getLogger(n).propagate)
+             for n in sentinel._COMPILE_LOGGERS}
+    assert after == before
+    assert not sentinel.enabled()
+
+
+# ---------------------------------------------------------------------------
+# static half, seeded against REAL sources: the decoder's owning copy
+# ---------------------------------------------------------------------------
+
+_CHECKPOINT = os.path.join(_ROOT, "dbsp_tpu", "checkpoint.py")
+
+
+def _d001(findings):
+    return [f for f in findings if "D001:" in f]
+
+
+def test_decoder_owning_copy_is_load_bearing_for_d001():
+    """The real checkpoint decoder is D001-clean BECAUSE ``_Decoder._arr``
+    copies (``jnp.array``); re-introducing the historical zero-copy bug
+    (``jnp.asarray`` — XLA frees the decoder's buffer after donation)
+    in the real source yields exactly one D001."""
+    from tools.check_retrace import check_source
+
+    with open(_CHECKPOINT) as f:
+        src = f.read()
+    rel = "dbsp_tpu/checkpoint.py"
+    assert check_source(src, rel) == []
+
+    needle = "return jnp.array(self.load(name))"
+    assert needle in src  # the owning copy the registry's invariant names
+    seeded = src.replace(needle, "return jnp.asarray(self.load(name))")
+    findings = check_source(seeded, rel)
+    assert len(_d001(findings)) == 1, findings
+    assert "_Decoder._arr" in _d001(findings)[0]
+    assert "zero-copy view" in _d001(findings)[0]
+
+    waived = src.replace(
+        needle, "return jnp.asarray(self.load(name))  # retrace: ok seeded")
+    findings_w = check_source(waived, rel)
+    assert _d001(findings_w) == []
+    # a USED waiver is not stale — the audit stays quiet too
+    assert not any("W001:" in f for f in findings_w)
+
+
+def test_np_decoder_numpy_view_would_also_be_caught():
+    """The host-tier decoder variant copies too (``np.array``); an
+    ``np.asarray`` view there is the same class of bug only if the
+    qualname is a declared producer — prove the walk keys on the
+    registry, not on luck, by declaring it and seeding the view."""
+    from tools.check_retrace import check_source
+
+    with open(_CHECKPOINT) as f:
+        src = f.read()
+    rel = "dbsp_tpu/checkpoint.py"
+    needle = "return np.array(self.load(name))"
+    assert needle in src
+    seeded = src.replace(needle, "return np.asarray(self.load(name))")
+    # undeclared qualname: the walk does not fire (not a donation feeder)
+    assert _d001(check_source(seeded, rel)) == []
+    extra = {(rel, "_NpDecoder._arr"): "test: host tier feeds donation"}
+    assert len(_d001(check_source(seeded, rel,
+                                  extra_producers=extra))) == 1

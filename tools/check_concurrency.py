@@ -56,7 +56,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
 
-from tools.schema_walk import find_class, self_attrs  # noqa: E402
+from tools.schema_walk import (find_class, self_attrs,  # noqa: E402
+                               stale_waivers)
 
 #: container-method calls that mutate the receiver — a
 #: ``self.X.append(...)`` on a write-guarded field is a write
@@ -274,9 +275,16 @@ class _ClassWalk:
 # ---------------------------------------------------------------------------
 
 
-def _waived(lines: List[str], lineno: int) -> bool:
+def _waived(lines: List[str], lineno: int,
+            used: Optional[Set[int]] = None) -> bool:
+    """True when the line carries the waiver comment; records the line
+    into ``used`` (the lines whose waiver suppressed a finding — the
+    input to the shared W001 stale-waiver audit)."""
     line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
-    return _conc().WAIVER in line
+    hit = _conc().WAIVER in line
+    if hit and used is not None:
+        used.add(lineno)
+    return hit
 
 
 def _ast_bases(tree: ast.AST) -> Dict[str, Tuple[str, ...]]:
@@ -304,7 +312,8 @@ def _private_locks(schema_map: Dict[str, Dict[str, str]]) -> Set[str]:
 
 def check_class(tree: ast.AST, lines: List[str], rel: str, cls_name: str,
                 edges: Optional[Dict] = None,
-                schema_map: Optional[Dict] = None) -> List[str]:
+                schema_map: Optional[Dict] = None,
+                used: Optional[Set[int]] = None) -> List[str]:
     """Guard-claim + discipline checks for one class; appends its lock
     acquisitions into ``edges`` (the global C002 graph) as
     ``(Class.lockA, Class.lockB) -> (rel, lineno)``."""
@@ -339,7 +348,7 @@ def check_class(tree: ast.AST, lines: List[str], rel: str, cls_name: str,
 
     # both directions: unclaimed fields / stale claims
     for attr, lineno in sorted(attrs.items()):
-        if attr not in merged and not _waived(lines, lineno):
+        if attr not in merged and not _waived(lines, lineno, used):
             violations.append(
                 f"{rel}:{lineno}: C004: {cls_name}.{attr} has no guard "
                 "claim in dbsp_tpu.concurrency.CONCURRENCY_SCHEMA — "
@@ -357,7 +366,7 @@ def check_class(tree: ast.AST, lines: List[str], rel: str, cls_name: str,
 
     for attr, kind, lineno, held, in_init in walk.accesses:
         g = guards.get(attr)
-        if g is None or _waived(lines, lineno):
+        if g is None or _waived(lines, lineno, used):
             continue
         if g.kind == "immutable":
             if kind == "bind" and not in_init:
@@ -406,7 +415,8 @@ def check_class(tree: ast.AST, lines: List[str], rel: str, cls_name: str,
 
 
 def check_reach_through(tree: ast.AST, lines: List[str], rel: str,
-                        private_locks: Set[str]) -> List[str]:
+                        private_locks: Set[str],
+                        used: Optional[Set[int]] = None) -> List[str]:
     """C003: an underscore-private lock of a schema'd class touched
     through anything but ``self`` — cross-class lock reach-through."""
     violations = []
@@ -414,7 +424,7 @@ def check_reach_through(tree: ast.AST, lines: List[str], rel: str,
         if isinstance(node, ast.Attribute) and node.attr in private_locks \
                 and not (isinstance(node.value, ast.Name) and
                          node.value.id == "self"):
-            if _waived(lines, node.lineno):
+            if _waived(lines, node.lineno, used):
                 continue
             violations.append(
                 f"{rel}:{node.lineno}: C003: reach-through to private "
@@ -469,13 +479,15 @@ def check_source(src: str, rel: str, class_names: List[str],
     lines = src.splitlines()
     edges: Dict = {}
     violations: List[str] = []
+    used: Set[int] = set()
     for cls_name in class_names:
         violations += check_class(tree, lines, rel, cls_name, edges,
-                                  schema_map)
+                                  schema_map, used)
     violations += check_reach_through(tree, lines, rel,
-                                      _private_locks(schema_map))
+                                      _private_locks(schema_map), used)
     if with_cycles:
         violations += find_cycles(edges)
+    violations += stale_waivers(src, rel, _conc().WAIVER, used)
     return violations
 
 
@@ -495,9 +507,12 @@ def check_tree(root: str) -> List[str]:
             src = f.read()
         tree = ast.parse(src)
         lines = src.splitlines()
+        used: Set[int] = set()
         for cls_name in by_file.get(rel, ()):
-            violations += check_class(tree, lines, rel, cls_name, edges)
-        violations += check_reach_through(tree, lines, rel, private)
+            violations += check_class(tree, lines, rel, cls_name, edges,
+                                      used=used)
+        violations += check_reach_through(tree, lines, rel, private, used)
+        violations += stale_waivers(src, rel, conc.WAIVER, used)
     listed = {c for _, c in conc.CONCURRENCY_CLASSES}
     for cls_name in sorted(set(conc.CONCURRENCY_SCHEMA) - listed):
         violations.append(

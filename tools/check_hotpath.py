@@ -57,6 +57,9 @@ import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from tools.schema_walk import stale_waivers  # noqa: E402
 
 #: method names whose bodies are operator hot paths (circuit/operator.py)
 HOT_METHODS = ("eval", "eval_strict", "get_output", "import_value")
@@ -141,7 +144,7 @@ def _forbidden_sync(node: ast.Call) -> str | None:
 
 
 def _check_sync_body(fn: ast.AST, kind: str, rel: str, lines,
-                     violations) -> None:
+                     violations, used) -> None:
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
             continue
@@ -150,6 +153,7 @@ def _check_sync_body(fn: ast.AST, kind: str, rel: str, lines,
             continue
         line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
         if WAIVER in line:
+            used.add(node.lineno)
             continue
         violations.append(
             f"{rel}:{node.lineno}: host/device sync {label} inside the "
@@ -157,7 +161,8 @@ def _check_sync_body(fn: ast.AST, kind: str, rel: str, lines,
             f"points (validate/block), or waive with '{WAIVER} <reason>'")
 
 
-def _check_body(fn: ast.AST, kind: str, rel: str, lines, violations) -> None:
+def _check_body(fn: ast.AST, kind: str, rel: str, lines, violations,
+                used) -> None:
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
             continue
@@ -166,6 +171,7 @@ def _check_body(fn: ast.AST, kind: str, rel: str, lines, violations) -> None:
             continue
         line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
         if WAIVER in line:
+            used.add(node.lineno)
             continue
         violations.append(
             f"{rel}:{node.lineno}: host round-trip {label} inside {kind} "
@@ -188,6 +194,7 @@ def check_tree(pkg_root: str) -> list:
             continue
         lines = src.splitlines()
         jitted = _jitted_names(tree)
+        used: set = set()  # waiver lines that suppressed a finding (W001)
 
         # rule 1a: operator hot-path methods
         for node in ast.walk(tree):
@@ -198,7 +205,7 @@ def check_tree(pkg_root: str) -> list:
                             item.name in HOT_METHODS:
                         _check_body(
                             item, f"{node.name}.{item.name}", rel, lines,
-                            violations)
+                            violations, used)
         # rule 1b: jitted functions (decorated or wrapped)
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -206,7 +213,7 @@ def check_tree(pkg_root: str) -> list:
                     any(_is_jit_expr(d) for d in node.decorator_list)
                 if is_jit:
                     _check_body(node, f"jitted function {node.name}", rel,
-                                lines, violations)
+                                lines, violations, used)
         # rule 3: no stray syncs in the compiled per-tick step loop
         if rel_pkg.split(os.sep)[0] == STEP_LOOP_DIR:
             for node in ast.walk(tree):
@@ -217,7 +224,7 @@ def check_tree(pkg_root: str) -> list:
                                 item.name in STEP_LOOP_METHODS:
                             _check_sync_body(
                                 item, f"{node.name}.{item.name}", rel,
-                                lines, violations)
+                                lines, violations, used)
         # rule 2: no asserts in circuit/ and io/
         if rel_pkg.split(os.sep)[0] in NO_ASSERT_DIRS:
             for node in ast.walk(tree):
@@ -225,11 +232,14 @@ def check_tree(pkg_root: str) -> list:
                     line = lines[node.lineno - 1] \
                         if node.lineno - 1 < len(lines) else ""
                     if WAIVER in line:
+                        used.add(node.lineno)
                         continue
                     violations.append(
                         f"{rel}:{node.lineno}: assert used for validation "
                         "in circuit/ or io/ — stripped under 'python -O'; "
                         "raise a typed exception (CircuitError/ValueError)")
+        # W001: waivers that no longer suppress anything (shared audit)
+        violations.extend(stale_waivers(src, rel, WAIVER, used))
     return violations
 
 

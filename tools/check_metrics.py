@@ -59,6 +59,7 @@ sys.path.insert(0, _ROOT)
 
 from dbsp_tpu.obs.registry import (ALLOWED_LABEL_NAMES,  # noqa: E402
                                    MetricNameError, validate_metric_name)
+from tools.schema_walk import stale_waivers  # noqa: E402
 
 # string-literal patterns that mean "this file formats Prometheus text"
 # (the label pattern uses a SINGLE brace: ast has already unescaped the
@@ -141,6 +142,7 @@ def check_tree(pkg_root: str) -> list:
         in_obs = _is_obs(path, pkg_root)
         src_lines = src.splitlines()
         rel_in_pkg = os.path.relpath(path, pkg_root)
+        used: set = set()  # waiver lines that suppressed a finding (W001)
         for node in ast.walk(tree):
             # (1) exposition formatting outside obs/
             if not in_obs and isinstance(node, ast.Constant) and \
@@ -179,9 +181,14 @@ def check_tree(pkg_root: str) -> list:
                     for fam, gate, why in _PINNED_FAMILIES:
                         if not fam.match(name) or rel_in_pkg == gate:
                             continue
-                        span = src_lines[node.lineno - 1:
-                                         (node.end_lineno or node.lineno)]
-                        if not any(_WAIVER in ln for ln in span):
+                        span0 = node.lineno
+                        span = src_lines[span0 - 1:
+                                         (node.end_lineno or span0)]
+                        hits = [span0 + i for i, ln in enumerate(span)
+                                if _WAIVER in ln]
+                        if hits:
+                            used.update(hits)
+                        else:
                             violations.append(
                                 f"{rel}:{node.lineno}: pinned family "
                                 f"{name!r} registered outside the "
@@ -196,6 +203,8 @@ def check_tree(pkg_root: str) -> list:
                     validate_metric_name(node.value)
                 except MetricNameError as e:
                     violations.append(f"{rel}:{node.lineno}: {e}")
+        # W001: waivers that no longer suppress anything (shared audit)
+        violations.extend(stale_waivers(src, rel, _WAIVER, used))
     return violations
 
 

@@ -1,0 +1,910 @@
+#!/usr/bin/env python
+"""Compilation-stability lint: retrace discipline + donation escape walk.
+
+The fourth sanitizer front, completing the family: ``tools/
+check_state.py`` claims what PERSISTS (``checkpoint.STATE_SCHEMA``),
+``tools/check_concurrency.py`` claims what GUARDS
+(``concurrency.CONCURRENCY_SCHEMA``), ``tools/check_hotpath.py`` bans
+what SYNCS, and this pass claims what RECOMPILES and what ALIASES
+(``dbsp_tpu.retrace.RETRACE_SCHEMA`` / ``DONATION_SCHEMA``). The three
+schema lints share the walker/waiver machinery in ``tools/
+schema_walk.py`` so site discovery and the stale-waiver audit cannot
+drift between fronts; the runtime half is
+``dbsp_tpu/testing/retrace.py`` (jit-cache compile counting + an armed
+``jax.transfer_guard``), the way ``testing/tsan.py`` is the runtime
+half of the concurrency pass.
+
+Rule catalog (each waivable with a ``# retrace: ok <why>`` comment on
+the flagged line; ``--defects`` renders a seeded gallery proving each
+fires; runtime sentinel violations are NOT waivable):
+
+  R001  python-value branch on a traced operand — an ``if``/``while``/
+        ternary test comparing or truth-testing a non-static,
+        non-defaulted parameter of a jitted def. Under trace this either
+        raises (TracerBoolConversionError) or, via a host round-trip,
+        forces a concretization per call — the retrace-per-value
+        failure mode.
+  R002  non-hashable or array-valued operand in a ``static_argnums``
+        position at a call site (list/dict/set literals, ``list()``/
+        ``sorted()``/``.tolist()`` results, ``np.array``/``jnp.*``
+        arrays): every distinct value is a new cache key (or a
+        TypeError), i.e. a compile per value. Also: a static index out
+        of range of the def's parameters.
+  R003  closure capture of mutable state — a jitted def reads an
+        enclosing-function variable that the enclosing scope rebinds
+        (after the def, or more than once): the trace burns in whichever
+        value tracing saw (silent staleness) or the wrapper is rebuilt
+        per value (cache churn).
+  R004  value-dependent dtype in step-path arithmetic —
+        ``jnp.asarray``/``jnp.array`` on an operand parameter with no
+        explicit ``dtype=``: the result dtype rides the caller's value
+        (int vs float, weak-type flips), and each flip is a recompile.
+  R005  undeclared program — a ``jax.jit`` site in a module registered
+        in ``retrace.RETRACE_MODULES`` with no ``RETRACE_SCHEMA`` entry.
+  R006  stale schema entry — a declared program whose jit site no
+        longer exists in its module.
+  D001  donated-alias escape — a value produced by ``jnp.asarray`` /
+        ``np.asarray`` / ``np.frombuffer`` / ``memoryview`` (zero-copy
+        views) escaping into a donated pytree without an owning copy:
+        from a declared producer's return (``retrace.
+        DONATION_PRODUCERS``) or an operand at a donated call position.
+        XLA aliases donated buffers input->output and frees them — the
+        exact class fixed by hand in the checkpoint decoder and the
+        residency tier movers (garbage int64s, flaky SIGSEGV).
+  D002  read after donation — a name passed at a donated position is
+        read again after the donating call without rebinding; the
+        buffer it names no longer exists.
+  D003  undeclared donation — a ``donate_argnums`` site in a registered
+        module with no ``DONATION_SCHEMA`` entry (or declared argnums
+        that do not match the site).
+  D004  stale donation claim — a ``DONATION_SCHEMA`` entry whose
+        program no longer donates.
+  W001  stale waiver — shared audit (tools/schema_walk.py): a
+        ``# retrace: ok`` comment whose line carries no suppressible
+        finding anymore.
+
+Usage::
+
+    python tools/check_retrace.py [repo_root]   # lint the tree
+    python tools/check_retrace.py --defects     # seeded-defect gallery
+
+Wired tier-1 via tests/test_retrace.py + tests/test_analysis.py and into
+tools/lint_all.py as the ``retrace`` front (static: runs under
+``--static``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from tools.check_hotpath import _dotted, _is_jit_expr, _iter_py  # noqa: E402
+from tools.schema_walk import find_class, stale_waivers  # noqa: E402
+
+
+def _retrace():
+    from dbsp_tpu import retrace
+
+    return retrace
+
+
+#: calls producing zero-copy views (the D001 hazard class)
+VIEW_CALLS = frozenset((
+    "jnp.asarray", "jax.numpy.asarray", "np.asarray", "numpy.asarray",
+    "np.frombuffer", "numpy.frombuffer", "memoryview",
+))
+
+#: calls producing owned buffers — descending past one of these is safe
+OWNING_CALLS = frozenset((
+    "jnp.array", "jax.numpy.array", "np.array", "numpy.array",
+    "jnp.copy", "np.copy", "numpy.copy", "jnp.zeros", "jnp.ones",
+    "jnp.full", "jnp.empty", "np.zeros", "np.ones", "np.full",
+))
+
+#: call-site expressions that cannot be jit cache keys (R002)
+_UNHASHABLE_CTORS = frozenset(("list", "dict", "set", "sorted",
+                               "np.array", "numpy.array", "jnp.array",
+                               "jnp.asarray", "np.asarray",
+                               "numpy.asarray"))
+
+
+class JitSite(NamedTuple):
+    name: str                     # program name as XLA's compile log sees it
+    lineno: int
+    fn: Optional[ast.FunctionDef]  # the def, when resolvable
+    static_names: frozenset       # parameter names bound statically
+    donate: Tuple[int, ...]       # donated argument positions
+
+
+# ---------------------------------------------------------------------------
+# jit-site discovery
+# ---------------------------------------------------------------------------
+
+
+def _jit_kwargs(call: ast.Call) -> Dict[str, ast.expr]:
+    """static_argnums / static_argnames / donate_argnums keyword exprs of
+    a ``jax.jit(...)`` or ``partial(jax.jit, ...)`` call."""
+    out: Dict[str, ast.expr] = {}
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames",
+                      "donate_argnums"):
+            out[kw.arg] = kw.value
+    return out
+
+
+def _int_tuple(node: Optional[ast.expr]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _str_tuple(node: Optional[ast.expr]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _params(fn: ast.FunctionDef) -> List[str]:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+def _defaulted(fn: ast.FunctionDef) -> Set[str]:
+    """Parameters with defaults: trace-time config, never operands."""
+    pos = fn.args.posonlyargs + fn.args.args
+    out = {a.arg for a in pos[len(pos) - len(fn.args.defaults):]}
+    out.update(a.arg for a, d in zip(fn.args.kwonlyargs,
+                                     fn.args.kw_defaults) if d is not None)
+    return out
+
+
+def _static_names(fn: Optional[ast.FunctionDef],
+                  kwargs: Dict[str, ast.expr]) -> frozenset:
+    names: Set[str] = set(_str_tuple(kwargs.get("static_argnames")))
+    if fn is not None:
+        params = _params(fn)
+        for i in _int_tuple(kwargs.get("static_argnums")):
+            if 0 <= i < len(params):
+                names.add(params[i])
+    return frozenset(names)
+
+
+def _jit_sites(tree: ast.AST) -> List[JitSite]:
+    """Every jit program the module builds: decorated defs plus
+    ``jax.jit(f, ...)`` call sites. The site NAME is what the XLA
+    compile log will report — the jitted function's ``__name__`` (last
+    attribute segment for ``jax.jit(jnp.maximum)``), falling back to the
+    enclosing def for non-name operands (``jax.jit(spmd(...))``)."""
+    sites: List[JitSite] = []
+    defs: List[ast.FunctionDef] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def nearest_def(name: str, lineno: int) -> Optional[ast.FunctionDef]:
+        cands = [d for d in defs if d.name == name and d.lineno <= lineno]
+        return max(cands, key=lambda d: d.lineno) if cands else None
+
+    # decorated defs
+    for fn in defs:
+        for dec in fn.decorator_list:
+            if _is_jit_expr(dec):
+                kwargs = _jit_kwargs(dec) if isinstance(dec, ast.Call) \
+                    else {}
+                sites.append(JitSite(
+                    fn.name, fn.lineno, fn, _static_names(fn, kwargs),
+                    _int_tuple(kwargs.get("donate_argnums"))))
+
+    # call wraps, with the enclosing-def stack for the fallback name
+    def walk(node: ast.AST, enclosing: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = enclosing
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            if isinstance(child, ast.Call) and \
+                    _dotted(child.func) in ("jax.jit", "jit") and \
+                    child.args:
+                arg0, kwargs = child.args[0], _jit_kwargs(child)
+                if isinstance(arg0, ast.Name):
+                    fn = nearest_def(arg0.id, child.lineno)
+                    sites.append(JitSite(
+                        arg0.id, child.lineno, fn,
+                        _static_names(fn, kwargs),
+                        _int_tuple(kwargs.get("donate_argnums"))))
+                elif isinstance(arg0, ast.Attribute):
+                    sites.append(JitSite(
+                        arg0.attr, child.lineno, None,
+                        _static_names(None, kwargs),
+                        _int_tuple(kwargs.get("donate_argnums"))))
+                else:
+                    sites.append(JitSite(
+                        name, child.lineno, None,
+                        _static_names(None, kwargs),
+                        _int_tuple(kwargs.get("donate_argnums"))))
+            walk(child, name)
+
+    walk(tree, "<module>")
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# shared finding context (waiver suppression + used-line tracking)
+# ---------------------------------------------------------------------------
+
+
+class _Ctx:
+    def __init__(self, rel: str, lines: List[str]):
+        self.rel = rel
+        self.lines = lines
+        self.findings: List[str] = []
+        self.used_waivers: Set[int] = set()
+
+    def emit(self, lineno: int, rule: str, msg: str) -> None:
+        line = self.lines[lineno - 1] \
+            if 0 < lineno <= len(self.lines) else ""
+        if _retrace().WAIVER in line:
+            self.used_waivers.add(lineno)
+            return
+        self.findings.append(f"{self.rel}:{lineno}: {rule}: {msg}")
+
+
+# ---------------------------------------------------------------------------
+# R001-R004: jitted-def hygiene
+# ---------------------------------------------------------------------------
+
+
+def _bound_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names the def binds locally (params, assigns, loop/with/except
+    targets, comprehension vars, inner defs/imports) — loads of anything
+    else are free variables."""
+    bound: Set[str] = {a.arg for a in
+                       fn.args.posonlyargs + fn.args.args +
+                       fn.args.kwonlyargs}
+    for a in (fn.args.vararg, fn.args.kwarg):
+        if a is not None:
+            bound.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return bound
+
+
+def _operand_root(node: ast.expr, operands: Set[str]) -> Optional[str]:
+    """The operand parameter a bare ``p`` / ``p[...]`` expression roots
+    at — attribute access (``p.shape``, ``p.sorted_runs``, ``p.cap``) is
+    deliberately NOT an operand read: batch/aux metadata is trace-static
+    by construction in this codebase."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name) and node.id in operands:
+        return node.id
+    return None
+
+
+def _check_r001(ctx: _Ctx, site: JitSite) -> None:
+    fn = site.fn
+    operands = (set(_params(fn)) - set(site.static_names)
+                - _defaulted(fn) - {"self", "cls"})
+    # nested defs run at trace time too — their params are traced values
+    # handed in by scan/cond combinators unless defaulted
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node is not fn:
+            operands |= set(_params(node)) - _defaulted(node)
+    tests: List[ast.expr] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            tests.append(node.test)
+        elif isinstance(node, ast.IfExp):
+            tests.append(node.test)
+        elif isinstance(node, ast.Assert):
+            tests.append(node.test)
+    for test in tests:
+        for node in ast.walk(test):
+            hits: List[str] = []
+            if isinstance(node, ast.Compare):
+                exempt = all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                             ast.NotIn))
+                             for op in node.ops)
+                if not exempt:
+                    for side in [node.left] + node.comparators:
+                        root = _operand_root(side, operands)
+                        if root:
+                            hits.append(root)
+            elif isinstance(node, (ast.Name, ast.Subscript)) and \
+                    node in (test, getattr(test, "operand", None)):
+                # bare truth test: `if p:` / `if not p:`
+                root = _operand_root(node, operands)
+                if root:
+                    hits.append(root)
+            for root in hits:
+                ctx.emit(
+                    node.lineno, "R001",
+                    f"python-value branch on traced operand {root!r} "
+                    f"inside jitted {site.name!r} — under trace this "
+                    "concretizes per call (a recompile per value) or "
+                    "raises; branch with lax.cond/jnp.where, or declare "
+                    "the argument static")
+
+
+def _check_r003(ctx: _Ctx, tree: ast.AST, site: JitSite) -> None:
+    fn = site.fn
+    enclosing = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node is not fn:
+            if any(child is fn for child in ast.walk(node)):
+                if enclosing is None or node.lineno > enclosing.lineno:
+                    enclosing = node
+    if enclosing is None:
+        return
+    free = set()
+    bound = _bound_names(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id not in bound:
+            free.add(node.id)
+    if not free:
+        return
+    # assignment census of the enclosing scope, excluding the jitted
+    # def's own subtree
+    inner = set(ast.walk(fn))
+    assigns: Dict[str, List[int]] = {}
+    for node in ast.walk(enclosing):
+        if node in inner:
+            continue
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Store):
+            assigns.setdefault(node.id, []).append(node.lineno)
+    end = getattr(fn, "end_lineno", fn.lineno)
+    for name in sorted(free):
+        lns = assigns.get(name, [])
+        if len(lns) >= 2 or any(ln > end for ln in lns):
+            ctx.emit(
+                fn.lineno, "R003",
+                f"jitted {site.name!r} closes over {name!r}, which the "
+                f"enclosing {enclosing.name!r} rebinds (lines "
+                f"{sorted(lns)}) — the trace burns in whichever value "
+                "tracing saw; pass it as an operand or a static "
+                "argument instead")
+
+
+def _check_r004(ctx: _Ctx, site: JitSite) -> None:
+    fn = site.fn
+    operands = (set(_params(fn)) - set(site.static_names)
+                - _defaulted(fn) - {"self", "cls"})
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted not in ("jnp.asarray", "jnp.array",
+                          "jax.numpy.asarray", "jax.numpy.array"):
+            continue
+        if len(node.args) >= 2 or \
+                any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        refs = {n.id for n in ast.walk(node)
+                if isinstance(n, ast.Name) and n.id in operands}
+        if refs:
+            ctx.emit(
+                node.lineno, "R004",
+                f"{dotted}() on operand {sorted(refs)[0]!r} without an "
+                f"explicit dtype inside jitted {site.name!r} — the "
+                "result dtype rides the caller's value (int/float, "
+                "weak-type flips), and every flip is a recompile; pin "
+                "dtype=")
+
+
+def _check_r002(ctx: _Ctx, tree: ast.AST, sites: List[JitSite]) -> None:
+    static_pos: Dict[str, Tuple[int, ...]] = {}
+    for site in sites:
+        if site.fn is None:
+            continue
+        params = _params(site.fn)
+        nums = tuple(i for i, p in enumerate(params)
+                     if p in site.static_names)
+        if nums:
+            static_pos[site.name] = nums
+        if any(i >= len(params) for i in nums):
+            ctx.emit(site.lineno, "R002",
+                     f"static_argnums index out of range for "
+                     f"{site.name!r} ({len(params)} parameters)")
+    if not static_pos:
+        return
+    jit_linenos = {s.lineno for s in sites}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func.id if isinstance(node.func, ast.Name) else \
+            node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        nums = static_pos.get(callee)
+        if nums is None or node.lineno in jit_linenos:
+            continue
+        for i in nums:
+            if i >= len(node.args):
+                continue
+            arg = node.args[i]
+            bad = None
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                ast.DictComp, ast.SetComp,
+                                ast.GeneratorExp)):
+                bad = "a non-hashable literal"
+            elif isinstance(arg, ast.Call):
+                d = _dotted(arg.func)
+                if d in _UNHASHABLE_CTORS:
+                    bad = f"a {d}() result"
+                elif isinstance(arg.func, ast.Attribute) and \
+                        arg.func.attr == "tolist":
+                    bad = "a .tolist() result"
+            if bad:
+                ctx.emit(
+                    node.lineno, "R002",
+                    f"{bad} in static position {i} of jitted "
+                    f"{callee!r} — every distinct value is a fresh "
+                    "cache key (a compile per value) or a TypeError; "
+                    "pass a hashable, value-stable static (or make the "
+                    "argument an operand)")
+
+
+# ---------------------------------------------------------------------------
+# D001/D002: donation escape + read-after-donation
+# ---------------------------------------------------------------------------
+
+
+def _view_escapes(expr: ast.expr, tainted: Set[str]) -> List[ast.expr]:
+    """Sub-expressions of ``expr`` that are zero-copy views not dominated
+    by an owning copy: view-producing calls, and loads of locally
+    tainted names."""
+    out: List[ast.expr] = []
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in OWNING_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "copy"):
+                return  # an owning copy launders everything beneath it
+            if d in VIEW_CALLS:
+                out.append(node)
+                return
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and node.id in tainted:
+            out.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(expr)
+    return out
+
+
+def _taint_locals(fn: ast.FunctionDef) -> Set[str]:
+    """Names bound to view-producing expressions (single-assignment
+    approximation: a later owning rebind un-taints)."""
+    tainted: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if _view_escapes(node.value, tainted):
+                tainted.add(name)
+            else:
+                tainted.discard(name)
+    return tainted
+
+
+def _check_producer(ctx: _Ctx, tree: ast.AST, qualname: str,
+                    why: str) -> None:
+    """D001 over one declared producer: no return value may be a
+    zero-copy view (``retrace.DONATION_PRODUCERS`` records why)."""
+    fns: List[ast.FunctionDef] = []
+    if "." in qualname:
+        cls_name, meth = qualname.split(".", 1)
+        if cls_name == "*":
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) and \
+                                item.name == meth:
+                            fns.append(item)
+        else:
+            cls = find_class(tree, cls_name)
+            if cls is not None:
+                for item in cls.body:
+                    if isinstance(item, ast.FunctionDef) and \
+                            item.name == meth:
+                        fns.append(item)
+    else:
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if isinstance(node, ast.FunctionDef) and node.name == qualname:
+                fns.append(node)
+    for fn in fns:
+        tainted = _taint_locals(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            for esc in _view_escapes(node.value, tainted):
+                label = _dotted(getattr(esc, "func", esc)) or \
+                    getattr(esc, "id", "?")
+                ctx.emit(
+                    node.lineno, "D001",
+                    f"{qualname} returns a zero-copy view ({label}) "
+                    "into a donated pytree — the donating dispatch "
+                    "frees the memory under it; wrap in an owning copy "
+                    f"(jnp.array/np.array). Declared invariant: {why}")
+
+
+def _check_donation_calls(ctx: _Ctx, tree: ast.AST,
+                          call_donate: Dict[str, Tuple[int, ...]]) -> None:
+    """D001 at donated call positions + D002 read-after-donation, per
+    function scope, in statement order."""
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        tainted = _taint_locals(fn)
+        donated: Dict[str, int] = {}  # name -> donating call lineno
+        events: List[Tuple[int, str, ast.AST]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = node.func.id \
+                    if isinstance(node.func, ast.Name) else \
+                    node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else ""
+                nums = call_donate.get(callee)
+                if nums is not None:
+                    events.append((node.lineno, "donate", node))
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                events.append((node.lineno, "load", node))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                events.append((node.lineno, "store", node))
+        for lineno, kind, node in sorted(events, key=lambda e: e[0]):
+            if kind == "donate":
+                callee = node.func.id \
+                    if isinstance(node.func, ast.Name) else node.func.attr
+                nums = call_donate[callee]
+                for i in nums:
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    for esc in _view_escapes(arg, tainted):
+                        label = _dotted(getattr(esc, "func", esc)) or \
+                            getattr(esc, "id", "?")
+                        ctx.emit(
+                            lineno, "D001",
+                            f"zero-copy view ({label}) passed at donated "
+                            f"position {i} of {callee!r} — the call "
+                            "frees memory its producer still owns; pass "
+                            "an owning copy")
+                    if isinstance(arg, ast.Name):
+                        # the buffer dies at the END of the call — loads
+                        # inside the (possibly multi-line) call itself
+                        # are the donation, not a use-after-free
+                        donated.setdefault(
+                            arg.id, getattr(node, "end_lineno", lineno))
+            elif kind == "load" and node.id in donated and \
+                    lineno > donated[node.id]:
+                ctx.emit(
+                    lineno, "D002",
+                    f"{node.id!r} read after being donated at line "
+                    f"{donated[node.id]} — the buffer was consumed by "
+                    "the donating call; use the call's RESULT, or copy "
+                    "before donating")
+            elif kind == "store" and node.id in donated:
+                del donated[node.id]
+
+
+# ---------------------------------------------------------------------------
+# R005/R006 + D003/D004: schema sync for registered modules
+# ---------------------------------------------------------------------------
+
+
+def _check_module_schema(ctx: _Ctx, rel: str, sites: List[JitSite],
+                         schema: Dict[str, Dict[str, str]],
+                         donation: Dict) -> None:
+    rt = _retrace()
+    base = rt.module_basename(rel)
+    declared = {p for p in schema if rt.program_module(p) == base}
+    seen: Set[str] = set()
+    for site in sites:
+        key = f"{base}.{site.name}"
+        seen.add(key)
+        if key not in declared:
+            ctx.emit(
+                site.lineno, "R005",
+                f"jit program {key!r} is not declared in "
+                "dbsp_tpu.retrace.RETRACE_SCHEMA — declare its legal "
+                "(re)compile causes (closed vocabulary: retrace.CAUSES)")
+        if site.donate:
+            ent = donation.get(key)
+            if ent is None:
+                ctx.emit(
+                    site.lineno, "D003",
+                    f"{key!r} donates argnums {site.donate} with no "
+                    "DONATION_SCHEMA entry — declare the boundary, its "
+                    "call names, and the owning-copy invariant")
+            elif tuple(ent.argnums) != tuple(site.donate):
+                ctx.emit(
+                    site.lineno, "D003",
+                    f"{key!r} donates {site.donate} but DONATION_SCHEMA "
+                    f"declares {tuple(ent.argnums)} — update the claim")
+    for key in sorted(declared - seen):
+        ctx.emit(
+            0, "R006",
+            f"RETRACE_SCHEMA declares {key!r} but {rel} has no such jit "
+            "site anymore — drop the stale entry")
+    for key, ent in sorted(donation.items()):
+        if ent.file == rel and key not in {
+                f"{base}.{s.name}" for s in sites if s.donate}:
+            ctx.emit(
+                0, "D004",
+                f"DONATION_SCHEMA claims {key!r} donates but no "
+                f"donate_argnums site for it exists in {rel} — drop the "
+                "stale claim")
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def check_source(src: str, rel: str,
+                 extra_schema: Optional[Dict] = None,
+                 extra_donation: Optional[Dict] = None,
+                 extra_producers: Optional[Dict] = None,
+                 registered: Optional[bool] = None,
+                 with_w001: bool = True) -> List[str]:
+    """Check one module's source — the in-memory entry the seeded-defect
+    tests and the gallery use. ``extra_*`` layer gallery/test claims over
+    the real registries; ``registered`` forces R005/R006/D003/D004
+    treatment (defaults to ``rel in retrace.RETRACE_MODULES``)."""
+    rt = _retrace()
+    rt.validate_schema()
+    schema = dict(rt.RETRACE_SCHEMA)
+    schema.update(extra_schema or {})
+    donation = dict(rt.DONATION_SCHEMA)
+    donation.update(extra_donation or {})
+    producers = dict(rt.DONATION_PRODUCERS)
+    producers.update(extra_producers or {})
+    tree = ast.parse(src)
+    ctx = _Ctx(rel, src.splitlines())
+    sites = _jit_sites(tree)
+    for site in sites:
+        if site.fn is not None:
+            _check_r001(ctx, site)
+            _check_r003(ctx, tree, site)
+            _check_r004(ctx, site)
+    _check_r002(ctx, tree, sites)
+    if registered if registered is not None \
+            else rel in rt.RETRACE_MODULES:
+        _check_module_schema(ctx, rel, sites, schema, donation)
+        base = rt.module_basename(rel)
+        call_donate = {}
+        for key, ent in donation.items():
+            if ent.file == rel or rt.program_module(key) == base:
+                for cname in ent.call_names:
+                    call_donate[cname] = tuple(ent.argnums)
+        _check_donation_calls(ctx, tree, call_donate)
+    for (file, qualname), why in sorted(producers.items()):
+        if file == rel:
+            _check_producer(ctx, tree, qualname, why)
+    findings = ctx.findings
+    if with_w001:
+        findings = findings + stale_waivers(src, rel, rt.WAIVER,
+                                            ctx.used_waivers)
+    return findings
+
+
+def check_tree(pkg_root: str) -> List[str]:
+    """Lint the whole package: R001-R004 + the retrace waiver audit over
+    every module, schema sync + donation walks over the registered
+    modules and declared producer files."""
+    rt = _retrace()
+    rt.validate_schema()
+    root = os.path.dirname(pkg_root.rstrip(os.sep))
+    findings: List[str] = []
+    for path in _iter_py(pkg_root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path) as f:
+            src = f.read()
+        try:
+            ast.parse(src)
+        except SyntaxError as e:  # pragma: no cover — tree is importable
+            findings.append(f"{rel}:{e.lineno}: unparsable: {e.msg}")
+            continue
+        findings += check_source(src, rel)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# defects gallery — seeded sources demonstrating each rule fires exactly
+# ---------------------------------------------------------------------------
+
+_D_SITE = None  # built lazily: NamedTuple import needs dbsp_tpu on path
+
+
+def _defects() -> List[Tuple[str, str, str, Dict]]:
+    """(rule, description, source, check_source kwargs) per defect."""
+    rt = _retrace()
+    site = rt.DonationSite
+    return [
+        ("R001", "python-value branch on a traced operand", '''\
+import jax
+
+@jax.jit
+def relu_by_hand(x):
+    if x > 0:
+        return x
+    return 0 * x
+''', {}),
+        ("R002", "non-hashable operand in a static position", '''\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def pad_to(x, widths):
+    return x
+
+def caller(x):
+    return pad_to(x, [4, 8])
+''', {}),
+        ("R003", "closure over a rebound enclosing variable", '''\
+import jax
+
+def make_scaler():
+    scale = 2.0
+
+    @jax.jit
+    def f(x):
+        return x * scale
+
+    scale = 3.0
+    return f
+''', {}),
+        ("R004", "value-dependent dtype in jitted arithmetic", '''\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def accum(x):
+    return jnp.asarray(x) + 1
+''', {}),
+        ("R005", "undeclared jit program in a registered module", '''\
+import jax
+
+@jax.jit
+def mystery_program(x):
+    return x
+''', {"registered": True}),
+        ("R006", "stale RETRACE_SCHEMA entry", '''\
+import jax
+''', {"registered": True,
+      "extra_schema": {"<defect:R006>.vanished_program": {
+          "first": "gallery"}}}),
+        ("D001", "zero-copy view returned by a donation producer", '''\
+import jax.numpy as jnp
+
+class Decoder:
+    def _arr(self, name):
+        return jnp.asarray(self.load(name))
+''', {"extra_producers": {("<defect:D001>", "Decoder._arr"):
+      "restore feeds donated state"}}),
+        ("D002", "read of a buffer after donating it", '''\
+import jax
+
+def _make(drain):
+    return jax.jit(drain, donate_argnums=(0, 1))
+
+def maintain(recv, src, drain_step):
+    merged, rest = drain_step(recv, src)
+    return merged, src.live
+''', {"registered": True,
+      "extra_schema": {"<defect:D002>.drain": {"first": "gallery"}},
+      "extra_donation": {"<defect:D002>.drain": None}}),
+        ("D003", "donate_argnums site with no DONATION_SCHEMA entry", '''\
+import jax
+
+def build(step):
+    return jax.jit(step, donate_argnums=(0,))
+
+def step(state):
+    return state
+''', {"registered": True,
+      "extra_schema": {"<defect:D003>.step": {"first": "gallery"}}}),
+        ("D004", "stale DONATION_SCHEMA claim", '''\
+import jax
+
+@jax.jit
+def gentle_step(state):
+    return state
+''', {"registered": True,
+      "extra_schema": {"<defect:D004>.gentle_step": {"first": "gallery"}},
+      "extra_donation": {"<defect:D004>.gentle_step": None}}),
+        ("W001", "stale waiver suppressing nothing", '''\
+def tidy():
+    return 1  # retrace: ok this line never had a finding
+''', {}),
+    ]
+
+
+_ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006",
+              "D001", "D002", "D003", "D004", "W001")
+
+
+def run_defects() -> List[Tuple[str, str, List[str]]]:
+    """(rule, description, findings) per seeded defect. Contract
+    (asserted in tests/test_analysis.py): each defect's findings name
+    its rule and no other — seeded-defect EXACTNESS."""
+    rt = _retrace()
+    out = []
+    for rule, desc, src, kwargs in _defects():
+        rel = f"<defect:{rule}>"
+        kwargs = dict(kwargs)
+        for k in ("extra_donation",):
+            if kwargs.get(k):
+                # fill in DonationSite values that need the rel name
+                kwargs[k] = {
+                    key: rt.DonationSite(rel, (0, 1), ("drain_step",),
+                                         "gallery")
+                    if rule == "D002" else
+                    rt.DonationSite(rel, (0,), ("gentle_step",),
+                                    "gallery")
+                    for key in kwargs[k]}
+        findings = check_source(src, rel, **kwargs)
+        out.append((rule, desc, findings))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--defects":
+        ok = True
+        for rule, desc, findings in run_defects():
+            hit = any(f"{rule}:" in v for v in findings)
+            pure = not any(f"{r}:" in v for v in findings
+                           for r in _ALL_RULES if r != rule)
+            status = "fires" if hit and pure else \
+                "MISSED" if not hit else "IMPURE"
+            ok &= hit and pure
+            print(f"[{rule}] {desc}: {status}")
+            for v in findings:
+                print(f"    {v}")
+        return 0 if ok else 1
+    root = (argv or [os.path.join(_ROOT, "dbsp_tpu")])[0]
+    findings = check_tree(os.path.abspath(root))
+    for v in findings:
+        print(v)
+    if findings:
+        print(f"check_retrace: {len(findings)} violation(s)")
+        return 1
+    print("check_retrace: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,17 @@ the tier-1 test in tests/test_analysis.py):
    instrumented pipeline must be race-clean AND a seeded unlocked write
    must be caught). ``DBSP_TPU_LINT_CONCURRENCY=0`` skips the smoke; the
    import-based tier-1 consumer is tests/test_concurrency.py.
+2g. **Retrace front** — ``tools/check_retrace.py`` (every jitted
+   step-path program's recompile causes declared in ``dbsp_tpu.retrace.
+   RETRACE_SCHEMA``; no python-value branches on traced operands; every
+   donation boundary declared and alias-escape-free) plus its seeded
+   defect gallery (each R/D/W rule must fire exactly once, pure) plus,
+   on the CLI, the runtime compilation sentinel dryrun
+   (``dbsp_tpu.testing.retrace.dryrun`` in a subprocess: a compiled
+   steady-state run must show zero undeclared recompiles and zero
+   implicit transfers AND a seeded per-value retrace must be caught).
+   ``DBSP_TPU_LINT_RETRACE=0`` skips the dryrun; the import-based tier-1
+   consumer is tests/test_retrace.py.
 2c. ``tools/build_native.py``  — cached native binaries carry the
    SHA-256 of their checked-out sources (a drifted ``.so`` is a red lint).
 2d. ``tools/gen_metrics_doc.py --check`` — the committed METRICS.md
@@ -76,7 +87,9 @@ the tier-1 test in tests/test_analysis.py):
    The import-based tier-1 consumer is tests/test_timeline.py.
 
 Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
-1 when any front fails.
+1 when any front fails. ``--static`` runs only the pure-static fronts
+(no subprocess dryruns, no circuit builds): seconds instead of minutes,
+the mode CI's lint job and pre-commit hooks use.
 """
 
 from __future__ import annotations
@@ -109,13 +122,18 @@ def run_check_state() -> list:
     return check_tree(_ROOT)
 
 
+def run_check_concurrency_static() -> list:
+    """2f's static half alone: the lock-discipline AST pass."""
+    from tools.check_concurrency import check_tree
+
+    return check_tree(_ROOT)
+
+
 def run_concurrency() -> list:
     """2f. Static lock-discipline pass + (CLI-only) TSAN smoke dryrun."""
     import subprocess
 
-    from tools.check_concurrency import check_tree
-
-    violations = check_tree(_ROOT)
+    violations = run_check_concurrency_static()
     if os.environ.get("DBSP_TPU_LINT_CONCURRENCY", "1") == "0":
         print("lint_all: concurrency: tsan smoke skipped "
               "(DBSP_TPU_LINT_CONCURRENCY=0)")
@@ -131,6 +149,55 @@ def run_concurrency() -> list:
         violations.append(
             f"tsan dryrun failed (runtime sanitizer rotted?):\n"
             f"{p.stdout[-800:]}\n{p.stderr[-800:]}")
+    return violations
+
+
+def run_check_retrace() -> list:
+    """2g's static half: the retrace/donation AST pass over the tree plus
+    the seeded-defect gallery — each rule must fire on its own defect
+    (non-vacuous) and on NO other defect (pure), so a regression in any
+    single rule turns this front red even on a violation-free tree."""
+    from tools.check_retrace import _ALL_RULES, check_tree, run_defects
+
+    violations = check_tree(PKG)
+    for rule, desc, findings in run_defects():
+        if not any(f"{rule}:" in f for f in findings):
+            violations.append(
+                f"retrace gallery: seeded defect for {rule} ({desc}) "
+                "produced no finding — the rule is vacuous")
+        violations.extend(
+            f"retrace gallery impurity on the {rule} defect: {f}"
+            for f in findings
+            if any(f"{r}:" in f for r in _ALL_RULES if r != rule))
+    return violations
+
+
+def run_retrace() -> list:
+    """2g. Static retrace/donation pass + gallery + (CLI-only) the
+    runtime compilation sentinel dryrun (``dbsp_tpu.testing.retrace``):
+    a compiled steady-state run must be free of undeclared recompiles
+    and implicit transfers, AND a seeded per-value retrace must be
+    caught — proving the sentinel's ledger and its teeth in one shot.
+    ``DBSP_TPU_LINT_RETRACE=0`` skips the dryrun (tests/test_retrace.py
+    is the import-based tier-1 consumer)."""
+    import subprocess
+
+    violations = run_check_retrace()
+    if os.environ.get("DBSP_TPU_LINT_RETRACE", "1") == "0":
+        print("lint_all: retrace: sentinel dryrun skipped "
+              "(DBSP_TPU_LINT_RETRACE=0)")
+        return violations
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "dbsp_tpu.testing.retrace"],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return violations + ["retrace sentinel dryrun timed out after 600s"]
+    if p.returncode != 0:
+        violations.append(
+            f"retrace sentinel dryrun failed (compilation sanitizer "
+            f"rotted?):\n{p.stdout[-800:]}\n{p.stderr[-800:]}")
     return violations
 
 
@@ -845,21 +912,38 @@ def run_timeline_dryrun() -> list:
     return violations
 
 
-def main() -> int:
-    fronts = [("check_metrics", run_check_metrics),
-              ("check_hotpath", run_check_hotpath),
-              ("check_state", run_check_state),
-              ("concurrency", run_concurrency),
-              ("check_native", run_check_native),
-              ("gen_metrics_doc", run_gen_metrics_doc),
-              ("check_dashboard", run_check_dashboard),
-              ("analyzer_selfcheck", run_analyzer_selfcheck),
-              ("multichip", run_multichip),
-              ("kernel_dryrun", run_kernel_dryrun),
-              ("residency", run_residency_dryrun),
-              ("profile_dryrun", run_profile_dryrun),
-              ("lineage_dryrun", run_lineage_dryrun),
-              ("timeline_dryrun", run_timeline_dryrun)]
+#: the pure-static fronts (``--static``): AST/file passes only — no
+#: subprocess dryruns, no circuit builds, no jax compilation
+STATIC_FRONTS = (("check_metrics", run_check_metrics),
+                 ("check_hotpath", run_check_hotpath),
+                 ("check_state", run_check_state),
+                 ("check_concurrency", run_check_concurrency_static),
+                 ("check_retrace", run_check_retrace),
+                 ("check_native", run_check_native),
+                 ("gen_metrics_doc", run_gen_metrics_doc),
+                 ("check_dashboard", run_check_dashboard))
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if "--static" in args:
+        fronts = list(STATIC_FRONTS)
+    else:
+        fronts = [("check_metrics", run_check_metrics),
+                  ("check_hotpath", run_check_hotpath),
+                  ("check_state", run_check_state),
+                  ("concurrency", run_concurrency),
+                  ("retrace", run_retrace),
+                  ("check_native", run_check_native),
+                  ("gen_metrics_doc", run_gen_metrics_doc),
+                  ("check_dashboard", run_check_dashboard),
+                  ("analyzer_selfcheck", run_analyzer_selfcheck),
+                  ("multichip", run_multichip),
+                  ("kernel_dryrun", run_kernel_dryrun),
+                  ("residency", run_residency_dryrun),
+                  ("profile_dryrun", run_profile_dryrun),
+                  ("lineage_dryrun", run_lineage_dryrun),
+                  ("timeline_dryrun", run_timeline_dryrun)]
     failed = 0
     for name, fn in fronts:
         violations = fn()

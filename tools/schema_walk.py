@@ -1,13 +1,16 @@
 #!/usr/bin/env python
-"""Shared AST walker for the field-claim lints.
+"""Shared AST walker for the field-claim lints, plus the waiver audit.
 
-Two lints claim every instance attribute of a registered class against a
-schema registry and check BOTH directions (unclaimed attribute, stale
-claim): ``tools/check_state.py`` (persistence claims against
-``dbsp_tpu.checkpoint.STATE_SCHEMA``) and ``tools/check_concurrency.py``
-(guard claims against ``dbsp_tpu.concurrency.CONCURRENCY_SCHEMA``). The
-attribute walk lives HERE, once, so the two lints cannot drift in what
-they consider "a field of the class".
+The schema lints claim every instance attribute of a registered class
+against a schema registry and check BOTH directions (unclaimed
+attribute, stale claim): ``tools/check_state.py`` (persistence claims
+against ``dbsp_tpu.checkpoint.STATE_SCHEMA``), ``tools/
+check_concurrency.py`` (guard claims against
+``dbsp_tpu.concurrency.CONCURRENCY_SCHEMA``), and ``tools/
+check_retrace.py`` (compile/donation claims against
+``dbsp_tpu.retrace.RETRACE_SCHEMA``/``DONATION_SCHEMA``). The attribute
+walk lives HERE, once, so the lints cannot drift in what they consider
+"a field of the class".
 
 Semantics of :func:`self_attrs`:
 
@@ -19,12 +22,24 @@ Semantics of :func:`self_attrs`:
   ``self``);
 * nested CLASS definitions are skipped — their ``self`` is a different
   object (the per-request ``Handler`` classes inside the HTTP servers).
+
+The WAIVER AUDIT (:func:`stale_waivers`, rule ``W001``) is shared by
+every lint front that honors a waiver comment (``# hotpath: ok``,
+``# concurrency: ok``, ``# metrics: ok``, ``# retrace: ok``): a waiver
+whose line no longer carries any suppressible finding is itself flagged
+— the code under a waiver changes, the waiver outlives the violation it
+excused, and nothing noticed until now. Each front reports the line
+numbers where a waiver actually suppressed something ("used" lines);
+the audit tokenizes the source (COMMENT tokens only, so a docstring or
+string literal MENTIONING a marker never counts) and flags the rest.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List
+import io
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Set
 
 
 def iter_class_nodes(cls: ast.ClassDef) -> Iterator[ast.AST]:
@@ -74,3 +89,51 @@ def find_class(tree: ast.AST, name: str) -> ast.ClassDef | None:
         if isinstance(node, ast.ClassDef) and node.name == name:
             return node
     return None
+
+
+# ---------------------------------------------------------------------------
+# W001: stale-waiver audit (shared by every waiver-honoring lint front)
+# ---------------------------------------------------------------------------
+
+#: every waiver marker any lint front honors — grown here when a new
+#: front introduces one, so the audit can never miss a vocabulary
+WAIVER_MARKERS = ("# hotpath: ok", "# concurrency: ok", "# metrics: ok",
+                  "# retrace: ok")
+
+
+def waiver_comment_lines(src: str, marker: str) -> Dict[int, str]:
+    """1-based line -> comment text for every COMMENT token that BEGINS
+    with ``marker`` (the canonical waiver form: ``# front: ok <why>``).
+    Tokenized, not substring-matched, and anchored at the comment start:
+    a docstring, string literal, or prose comment that merely MENTIONS a
+    marker (this repo documents its waiver idiom in several places) is
+    not a waiver."""
+    out: Dict[int, str] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT and \
+                    tok.string.startswith(marker):
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenizeError, IndentationError,
+            SyntaxError):  # pragma: no cover — tree already parsed
+        pass
+    return out
+
+
+def stale_waivers(src: str, rel: str, marker: str,
+                  used: Iterable[int]) -> List[str]:
+    """W001 findings for one file: every ``marker`` comment whose line is
+    not in ``used`` (the line numbers where the owning lint actually
+    suppressed a finding) no longer excuses anything — the code under it
+    changed out from under the waiver. Fix: delete the waiver (or the
+    regression it was masking came back differently — look)."""
+    used_set: Set[int] = set(used)
+    out: List[str] = []
+    for lineno in sorted(waiver_comment_lines(src, marker)):
+        if lineno not in used_set:
+            out.append(
+                f"{rel}:{lineno}: W001: stale waiver {marker!r} — no "
+                "finding on this line needs suppressing anymore; delete "
+                "the waiver so it cannot hide a future regression")
+    return out
