@@ -89,7 +89,11 @@ default probe), BENCH_PROBE_TIMEOUT_S (default 75), BENCH_MODE
 (compiled|host), BENCH_VALIDATE_EVERY (default 8), BENCH_WORKERS,
 BENCH_SCAN, BENCH_GROWTH, BENCH_PROFILE / --profile, BENCH_SLO / --slo
 (SLO gate; thresholds from DBSP_TPU_SLO_P99_TICK_MS /
-_TICK_P50_MULTIPLE / _WATERMARK_LAG / _OVERFLOW_REPLAYS).
+_TICK_P50_MULTIPLE / _WATERMARK_LAG / _OVERFLOW_REPLAYS),
+BENCH_READ_LOAD / --read-load (served read-storm protocol: reader
+threads hammer the snapshot routes while ingest runs; read QPS /
+latency / staleness / epoch swaps land in detail.readpath),
+BENCH_READERS (read-load reader thread count, default 2).
 """
 
 import json
@@ -876,6 +880,145 @@ def run_compiled(platform: str, detail: dict) -> float:
     return eps
 
 
+def _run_read_load(platform: str, detail: dict) -> float:
+    """``--read-load`` / ``BENCH_READ_LOAD=1``: the SERVED read-path
+    protocol — the headline query behind Runtime + Catalog + Controller +
+    CircuitServer (host engine) with reader threads storming the
+    snapshot routes (``/view`` point/range/scan + ``/output_endpoint``)
+    WHILE ingest ticks run. Fills ``detail["readpath"]`` with read QPS,
+    read p50/p99 latency, a staleness histogram (published-snapshot step
+    lag observed by readers, in validation intervals) and the plane's
+    epoch swap count; the returned metric value stays ingest events/s so
+    the headline is comparable to the plain runs."""
+    import threading
+    import urllib.request
+
+    import jax
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.io.server import CircuitServer
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+    from dbsp_tpu.nexmark import model as M
+
+    _, batch, qname, warm_ticks = _knobs(platform)
+    query = getattr(queries, qname)
+    platform = jax.devices()[0].platform
+    # the served loop pays HTTP + publication per tick; default to a
+    # shorter run than the raw engine protocol (env still wins)
+    total = int(os.environ.get("BENCH_EVENTS",
+                               75_000 if platform == "cpu" else 750_000))
+    detail.update(platform=platform, query=qname, batch_per_tick=batch,
+                  mode="host-served-readload", events=0)
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, query(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    for name, h, key, vals in (("persons", handles[0], M.PERSON_KEY,
+                                M.PERSON_VALS),
+                               ("auctions", handles[1], M.AUCTION_KEY,
+                                M.AUCTION_VALS),
+                               ("bids", handles[2], M.BID_KEY, M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+    catalog.register_output(qname, out, ())
+    ctl = Controller(handle, catalog, ControllerConfig(
+        min_batch_records=10**9, flush_interval_s=3600.0))
+    plane = ctl.read_plane
+    if not plane.enabled:
+        raise RuntimeError("--read-load needs the read plane "
+                           "(DBSP_TPU_READPLANE=0 is set)")
+    srv = CircuitServer(ctl)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    gen = NexmarkGenerator(GeneratorConfig(seed=1))
+    stop = threading.Event()
+    lat_ns: list = []
+    lag_hist: dict = {}
+    lock = threading.Lock()
+
+    def storm():
+        paths = (f"/view/{qname}?key=1", f"/view/{qname}?lo=0&hi=50",
+                 f"/view/{qname}", f"/output_endpoint/{qname}?format=json")
+        i, local_lat, local_lag = 0, [], {}
+        while not stop.is_set():
+            path = paths[i % len(paths)]
+            pre = ctl.steps
+            t0 = time.perf_counter_ns()
+            try:
+                with urllib.request.urlopen(base + path, timeout=30) as r:
+                    r.read()
+                    ep_step = r.headers.get("X-Dbsp-Step")
+            except OSError:
+                break  # server shutting down
+            local_lat.append(time.perf_counter_ns() - t0)
+            if path.startswith("/output_endpoint/") and ep_step:
+                # snapshot step lag vs the steps counter sampled BEFORE
+                # the request: an upper bound on observed staleness
+                lag = max(0, pre - int(ep_step))
+                local_lag[lag] = local_lag.get(lag, 0) + 1
+            i += 1
+        with lock:
+            lat_ns.extend(local_lat)
+            for k, v in local_lag.items():
+                lag_hist[k] = lag_hist.get(k, 0) + v
+
+    n = 0
+    try:
+        for _ in range(warm_ticks):
+            gen.feed(handles, n, n + batch)
+            ctl.note_pushed(batch)
+            ctl.step()
+            n += batch
+        readers = [threading.Thread(target=storm, name=f"bench-reader-{i}",
+                                    daemon=True)
+                   for i in range(int(os.environ.get("BENCH_READERS", 2)))]
+        for r in readers:
+            r.start()
+        t0 = time.perf_counter()
+        measured = 0
+        while measured < total:
+            gen.feed(handles, n, n + batch)
+            ctl.note_pushed(batch)
+            ctl.step()
+            n += batch
+            measured += batch
+            detail.update(events=measured,
+                          elapsed_s=round(time.perf_counter() - t0, 3))
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for r in readers:
+            r.join(timeout=60)
+    finally:
+        stop.set()
+        srv.stop()
+
+    eps = measured / elapsed
+    lat = sorted(lat_ns)
+    stats = plane.stats()
+    detail.update(elapsed_s=round(elapsed, 3), ticks=measured // batch)
+    detail["readpath"] = {
+        "readers": len(readers),
+        "reads": len(lat),
+        "read_qps": round(len(lat) / elapsed, 1),
+        "read_p50_ms": round(lat[len(lat) // 2] / 1e6, 3) if lat else None,
+        "read_p99_ms": round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))] / 1e6, 3)
+        if lat else None,
+        # staleness in validation intervals: 0 = read the current epoch,
+        # 1 = one publish behind (the contract's bound on the host engine)
+        "staleness_intervals": {str(k): lag_hist[k]
+                                for k in sorted(lag_hist)},
+        "epoch_swaps": stats["publishes"],
+        "epoch": stats["epoch"],
+    }
+    return eps
+
+
 def run(platform: str, detail: dict) -> float:
     """Measure; fills ``detail`` as it goes so a mid-run crash still reports
     platform + progress in the JSON line."""
@@ -885,6 +1028,8 @@ def run(platform: str, detail: dict) -> float:
     from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
                                   build_inputs, queries)
 
+    if os.environ.get("BENCH_READ_LOAD"):
+        return _run_read_load(platform, detail)
     if os.environ.get("BENCH_MODE", "compiled") == "compiled":
         try:
             return run_compiled(platform, detail)
@@ -1084,6 +1229,8 @@ def main() -> int:
         os.environ["BENCH_SLO"] = "1"
     if "--profile" in sys.argv:  # env form so child processes inherit it
         os.environ["BENCH_PROFILE"] = "1"
+    if "--read-load" in sys.argv:  # env form so child processes inherit it
+        os.environ["BENCH_READ_LOAD"] = "1"
     if "--workers-sweep" in sys.argv:
         ws = sorted({int(x)
                      for x in _flag_operand("--workers-sweep").split(",")

@@ -205,6 +205,9 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "checkpoint_error": "derived",
         "last_checkpoint_tick": "persisted",
         "_last_ckpt_step": "derived",
+        "read_plane": "persisted",  # per-view merged state rides the
+                                    # "read_plane" payload; epoch in the
+                                    # manifest ("read_epoch")
     },
     "_InputEndpoint": {
         "total_records": "persisted",   # consumed high-water mark: the
@@ -233,6 +236,58 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "pending": "persisted",  # failed-write retry batch rides the
     },                           # manifest (output_pending) so a crash
                                  # cannot drop an undelivered delta
+    "ReadPlane": {
+        "enabled": "config",
+        "capacity": "config",
+        "compact_after": "config",
+        "_lock": "runtime",
+        "_wakeup": "runtime",
+        "_views": "persisted",   # each view's merged snapshot state is a
+                                 # consolidated Batch in the "read_plane"
+                                 # payload (state_batches()/restore())
+        "epoch": "persisted",    # manifest "read_epoch" via
+                                 # Controller._controller_state()
+        "publishes": "derived",
+        "last_publish_ts": "derived",
+        "flight": "runtime",
+        "_read_qps": "runtime",
+        "_read_seconds": "runtime",
+        "_publish_total": "runtime",
+    },
+    "_ViewState": {
+        "name": "config",
+        "handle": "config",
+        "mode": "config",
+        "nkeys": "derived",      # recomputed from the restored batch
+        "cid": "runtime",        # consumer re-registered on restore
+        "snap": "persisted",     # the merged rows ARE the read_plane blob
+        "prev_rows": "derived",  # rebuilt from the restored snapshot
+        "feed": "derived",       # reset; old cursors resume through a
+        "dropped_epoch": "derived",  # synthesized kind="snapshot" record
+        "seen_step": "derived",
+    },
+    "ReplicaServer": {
+        # stateless by contract: the whole state is the changefeed fold,
+        # reconstructible from epoch 0 (or any snapshot record) — nothing
+        # to checkpoint, which is what makes replicas free to scale
+        "primary": "config",
+        "views_served": "config",
+        "name": "config",
+        "poll_timeout_s": "config",
+        "_lock": "runtime",
+        "_state": "derived",
+        "_cursor": "derived",
+        "_nkeys": "derived",
+        "_applied_ts": "derived",
+        "_sorted": "derived",
+        "applied": "derived",
+        "stalled": "runtime",
+        "_stop": "runtime",
+        "_httpd": "runtime",
+        "port": "runtime",
+        "_serve_thread": "runtime",
+        "_feed_thread": "runtime",
+    },
 }
 
 
@@ -1030,7 +1085,8 @@ def _driver_of(target):
 
 def save(target, path: str, controller: Optional[dict] = None,
          tick: Optional[int] = None,
-         output_pending: Optional[Dict[str, Batch]] = None) -> dict:
+         output_pending: Optional[Dict[str, Batch]] = None,
+         read_plane: Optional[Dict[str, Batch]] = None) -> dict:
     """Write one checkpoint generation of ``target`` under ``path``.
 
     ``target`` is a host ``CircuitHandle``, a ``CompiledHandle``, or a
@@ -1041,7 +1097,10 @@ def save(target, path: str, controller: Optional[dict] = None,
     maps output-endpoint names to delta batches whose sink write failed —
     persisting them keeps the output stream at-least-once across a crash
     (the input high-water marks cover the step that produced them, so a
-    restore would otherwise never re-emit them). Returns
+    restore would otherwise never re-emit them); ``read_plane`` maps
+    served view names to their compacted published state so a restored
+    controller republishes snapshots (and answers changefeed resume
+    cursors) without waiting for new traffic. Returns
     ``{"tick", "generation", "path", ...}``."""
     driver, ch, host = _driver_of(target)
     enc = _Encoder()
@@ -1088,6 +1147,10 @@ def save(target, path: str, controller: Optional[dict] = None,
         payload["output_pending"] = {
             n: enc.encode(b, hint=f"op_{i}")
             for i, (n, b) in enumerate(sorted(output_pending.items()))}
+    if read_plane:
+        payload["read_plane"] = {
+            n: enc.encode(b, hint=f"rp_{i}")
+            for i, (n, b) in enumerate(sorted(read_plane.items()))}
     name, stats = _write_generation(path, payload, enc, linked,
                                     linked_meta, copied)
     return dict(stats, tick=payload["tick"], path=path, name=name)
@@ -1132,4 +1195,7 @@ def restore(target, path: str) -> dict:
             "controller": payload.get("controller"),
             "output_pending": {
                 n: dec.decode(b)
-                for n, b in (payload.get("output_pending") or {}).items()}}
+                for n, b in (payload.get("output_pending") or {}).items()},
+            "read_plane": {
+                n: dec.decode(b)
+                for n, b in (payload.get("read_plane") or {}).items()}}

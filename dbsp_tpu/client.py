@@ -225,6 +225,55 @@ class PipelineHandle:
                     out[row] = out.get(row, 0) - 1
             return {r: w for r, w in out.items() if w != 0}, step
 
+    # -- lock-free read plane (README §Serving read path) -------------------
+
+    def get(self, view: str, key, limit: Optional[int] = None) -> dict:
+        """Point lookup against the last PUBLISHED snapshot of ``view``
+        (``GET /view/<view>?key=``): rows whose key columns start with
+        ``key`` (a scalar, tuple, or csv string), each as ``[*row, w]``.
+        Served lock-free server-side — never blocks or waits on ingest;
+        staleness is bounded by the engine's validation interval. The
+        response carries the snapshot's ``epoch``/``step``/``ts``."""
+        key = key if isinstance(key, str) else (
+            ",".join(map(str, key)) if isinstance(key, (tuple, list))
+            else str(key))
+        q = f"?key={quote(key, safe=',')}"
+        if limit is not None:
+            q += f"&limit={limit}"
+        return _req(f"{self.base}/view/{quote(view, safe='')}{q}")
+
+    def range(self, view: str, lo=None, hi=None,
+              limit: Optional[int] = None) -> dict:
+        """Inclusive range scan ``lo <= first-key-column <= hi`` against
+        the last published snapshot (``GET /view/<view>?lo=&hi=``). Omit
+        a bound for an open end; omit both for a full scan."""
+        qs = []
+        if lo is not None:
+            qs.append(f"lo={lo}")
+        if hi is not None:
+            qs.append(f"hi={hi}")
+        if limit is not None:
+            qs.append(f"limit={limit}")
+        q = ("?" + "&".join(qs)) if qs else ""
+        return _req(f"{self.base}/view/{quote(view, safe='')}{q}")
+
+    def subscribe(self, view: str, after_epoch: int = 0,
+                  timeout: float = 0.0,
+                  limit: Optional[int] = None) -> dict:
+        """Changefeed poll (``GET /changefeed``): every per-interval delta
+        record published after ``after_epoch``, exactly once. Pass the
+        returned ``epoch`` back as the next ``after_epoch`` to resume. A
+        cursor older than the feed's retention gets one synthesized
+        ``kind="snapshot"`` record (full state) before the deltas.
+        ``timeout`` long-polls until a newer epoch publishes."""
+        q = f"?view={quote(view, safe='')}&after={after_epoch}"
+        if timeout:
+            q += f"&timeout={timeout}"
+        if limit is not None:
+            q += f"&limit={limit}"
+        return _req(f"{self.base}/changefeed{q}",
+                    timeout=timeout + default_timeout_s())
+
     def start(self) -> None:
         _req(self.base + "/start", data=b"", method="POST")
 
@@ -353,6 +402,49 @@ class Connection:
         :meth:`PipelineHandle.checkpoint`)."""
         return _req(f"{self.base}/pipelines/{name}/checkpoint", data=b"",
                     method="POST")
+
+    # -- read replicas (README §Serving read path) --------------------------
+
+    def add_replicas(self, name: str, count: int = 1) -> dict:
+        """Scale pipeline ``name``'s read-serving tier: start ``count``
+        changefeed-fed snapshot replicas (POST /pipelines/<name>/replicas).
+        Returns {"replicas": [...status...], "total": N}."""
+        return _req(f"{self.base}/pipelines/{name}/replicas",
+                    data=json.dumps({"count": count}).encode(),
+                    method="POST")
+
+    def replicas(self, name: str) -> List[dict]:
+        """Per-replica freshness for pipeline ``name``: each status dict
+        carries ``staleness_s`` (0.0 when caught up to the primary's
+        published epochs) plus per-view cursor epochs."""
+        return _req(f"{self.base}/pipelines/{name}/replicas")["replicas"]
+
+    def remove_replicas(self, name: str) -> dict:
+        """Stop every read replica of pipeline ``name``."""
+        return _req(f"{self.base}/pipelines/{name}/replicas",
+                    method="DELETE")
+
+    def read_view(self, name: str, view: str, key=None, lo=None, hi=None,
+                  limit: Optional[int] = None) -> dict:
+        """Fan one snapshot read out over pipeline ``name``'s replica set
+        (GET /pipelines/<name>/view/<view>, round-robin; falls back to the
+        primary when no replica is up). Same query surface as
+        :meth:`PipelineHandle.get` / :meth:`PipelineHandle.range`."""
+        qs = []
+        if key is not None:
+            key = key if isinstance(key, str) else (
+                ",".join(map(str, key)) if isinstance(key, (tuple, list))
+                else str(key))
+            qs.append(f"key={quote(key, safe=',')}")
+        if lo is not None:
+            qs.append(f"lo={lo}")
+        if hi is not None:
+            qs.append(f"hi={hi}")
+        if limit is not None:
+            qs.append(f"limit={limit}")
+        q = ("?" + "&".join(qs)) if qs else ""
+        return _req(
+            f"{self.base}/pipelines/{name}/view/{quote(view, safe='')}{q}")
 
     def shutdown_pipeline(self, name: str) -> None:
         _req(f"{self.base}/pipelines/{name}/shutdown", data=b"",

@@ -103,6 +103,9 @@ CONCURRENCY_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("dbsp_tpu/obs/registry.py", "Gauge"),
     ("dbsp_tpu/obs/registry.py", "Histogram"),
     ("dbsp_tpu/obs/registry.py", "Summary"),
+    ("dbsp_tpu/serving.py", "ReadPlane"),
+    ("dbsp_tpu/serving.py", "_ViewState"),
+    ("dbsp_tpu/serving.py", "ReplicaServer"),
 )
 
 #: extra modules swept for C003 (private-lock reach-through) beyond the
@@ -123,6 +126,10 @@ REACH_THROUGH_MODULES: Tuple[str, ...] = (
 #     by the controller step lock by design; its fields are the
 #     *checkpoint* schema's concern, and every serving-path entry point
 #     is covered by the controller/server claims here.
+#   * serving.py ``_Run``/``ViewSnapshot`` — immutable value objects
+#     (``__slots__``, every field bound once in ``__init__``); the
+#     lock-free read contract depends on them never mutating, which the
+#     ``_ViewState.snap`` claim below pins at the pointer swap.
 
 CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
     "Controller": {
@@ -159,6 +166,7 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
                     "attach_controller before start(); read-only "
                     "afterwards (note_* calls go through the timeline's "
                     "own lock)",
+        "read_plane": "immutable",
     },
     "_InputEndpoint": {
         "name": "immutable",
@@ -234,6 +242,19 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "obs": "gil-atomic: see status",
         "fallback_reason": "gil-atomic: see status",
         "restored_tick": "gil-atomic: see status",
+        "replicas": "gil-atomic: scaled by operator actions (replica "
+                    "routes); list append/replace are single GIL-atomic "
+                    "ops and fanout_read snapshots the list reference "
+                    "before indexing",
+        "_fanout_rr": "gil-atomic: racy round-robin counter — concurrent "
+                      "increments may collide, costing distribution "
+                      "fairness, never correctness",
+        "_replica_gauge": "gil-atomic: wired once on the first "
+                          "add_replicas; one reference assignment",
+        "_replica_breached": "gil-atomic: per-replica breach latch keyed "
+                             "by name; writers (scrape collector, "
+                             "replicas route) are last-write-wins on a "
+                             "boolean by design",
     },
     "_CompilerService": {
         "mgr": "immutable",
@@ -415,6 +436,73 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "label_names": "immutable",
         "_lock": "immutable",
         "_children": "lock(_lock)",
+    },
+    "ReadPlane": {
+        "enabled": "immutable",
+        "capacity": "immutable",
+        "compact_after": "immutable",
+        "_lock": "immutable",
+        "_wakeup": "immutable",
+        "_views": "writelock(_lock): registered at controller "
+                  "construction (add_view); reader routes do one "
+                  "GIL-atomic dict lookup",
+        "epoch": "writelock(_lock): monotone int; changefeed/stats reads "
+                 "are single loads",
+        "publishes": "writelock(_lock)",
+        "last_publish_ts": "writelock(_lock)",
+        "flight": "gil-atomic: wired once by bind() before traffic; one "
+                  "reference assignment",
+        "_read_qps": "gil-atomic: wired once by bind() before traffic; "
+                     "the idempotence guard's read tolerates None",
+        "_read_seconds": "gil-atomic: see _read_qps",
+        "_publish_total": "gil-atomic: see _read_qps",
+    },
+    "_ViewState": {
+        "name": "immutable",
+        "handle": "immutable",
+        "mode": "immutable",
+        "nkeys": "lockset: written only under the owning plane's _lock "
+                 "(publish/restore); monotone None->int, lock-free reads "
+                 "are single loads",
+        "cid": "lockset: rebound only under the owning plane's _lock "
+               "(restore re-registration)",
+        "snap": "lockset: the lock-free read contract — publication "
+                "swaps this pointer under the owning plane's _lock; "
+                "readers resolve it with ONE GIL-atomic load and then "
+                "touch only the immutable ViewSnapshot",
+        "prev_rows": "lockset: publisher-only diff base, mutated under "
+                     "the owning plane's _lock",
+        "feed": "lockset: appended/cleared under the owning plane's "
+                "_lock; changefeed reads snapshot it via list(feed) — "
+                "atomic under the GIL on a deque",
+        "dropped_epoch": "lockset: written under the owning plane's "
+                         "_lock; monotone int, lock-free reads are "
+                         "single loads",
+        "seen_step": "lockset: publisher-only cursor, mutated under the "
+                     "owning plane's _lock",
+    },
+    "ReplicaServer": {
+        "primary": "immutable",
+        "views_served": "immutable",
+        "name": "immutable",
+        "poll_timeout_s": "immutable",
+        "_lock": "immutable",
+        "_state": "writelock(_lock)",
+        "_cursor": "writelock(_lock)",
+        "_nkeys": "writelock(_lock)",
+        "_applied_ts": "writelock(_lock)",
+        "_sorted": "writelock(_lock): per-view cache cell — readers do "
+                   "one GIL-atomic load and rebuild under the lock on "
+                   "miss; a racy extra rebuild is benign",
+        "applied": "writelock(_lock)",
+        "stalled": "gil-atomic: boolean latch toggled by the "
+                   "stall()/resume() caller; the feed loop's read is a "
+                   "benign race (one extra poll)",
+        "_stop": "immutable",
+        "_httpd": "immutable",
+        "port": "immutable",
+        "_serve_thread": "immutable",
+        "_feed_thread": "immutable",
     },
     "Counter": {},
     "Gauge": {},

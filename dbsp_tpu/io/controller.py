@@ -212,6 +212,16 @@ class Controller:
         # otherwise silently drop the per-pipeline budgets.
         self._residency_cfg = rcfg
         _res.apply_to_driver(handle, rcfg)
+        # lock-free read serving plane (dbsp_tpu/serving.py): every
+        # catalog output becomes a served view; the step path publishes
+        # immutable snapshots at each validation publish and readers
+        # never touch _step_lock. DBSP_TPU_READPLANE=0 disables
+        # publication (reads fall back to the quiesced control path).
+        from dbsp_tpu.serving import ReadPlane
+
+        self.read_plane = ReadPlane()
+        for vname, vcol in self.catalog.outputs.items():
+            self.read_plane.add_view(vname, vcol.handle)
         _tsan_hook(self)
 
     # -- endpoint wiring ----------------------------------------------------
@@ -293,6 +303,11 @@ class Controller:
         return {
             "steps": self.steps,
             "pushed_records": self.total_pushed,
+            # read-plane epoch at checkpoint time: restore republishes the
+            # checkpointed view state under this epoch, so changefeed
+            # cursors from before the restore resume exactly (older
+            # cursors get a synthesized snapshot record)
+            "read_epoch": self.read_plane.epoch,
             "inputs": {name: {"total_records": ep.total_records,
                               "total_bytes": ep.total_bytes}
                        for name, ep in self.inputs.items()},
@@ -319,7 +334,9 @@ class Controller:
                           output_pending={
                               name: out.pending
                               for name, out in self.outputs.items()
-                              if out.pending is not None})
+                              if out.pending is not None},
+                          read_plane=(self.read_plane.state_batches()
+                                      if self.read_plane.enabled else None))
         self.checkpoints += 1
         self.last_checkpoint_tick = info["tick"]
         self.checkpoint_error = None
@@ -393,6 +410,13 @@ class Controller:
                 out = self.outputs.get(name)
                 if out is not None:  # undelivered sink deltas re-send on
                     out.pending = batch  # the first post-restore emission
+            if self.read_plane.enabled:
+                # republish the checkpointed view state under the
+                # checkpointed epoch; pre-restore changefeed cursors
+                # resume via a synthesized snapshot record
+                self.read_plane.restore(
+                    int(c.get("read_epoch", 0)),
+                    info.get("read_plane") or {})
             self.last_checkpoint_tick = info["tick"]
             self._last_ckpt_step = self.steps
         return info
@@ -469,6 +493,9 @@ class Controller:
             was_open = getattr(self.handle, "interval_open", False)
             flush()
             self._emit_outputs()
+            # snapshot publication rides every validation publish (cheap
+            # no-op when no output's step_id advanced)
+            self.read_plane.publish()
             tl = self.timeline
             if was_open and tl is not None:
                 # a deferred-validation interval just closed: its buffered
@@ -565,6 +592,12 @@ class Controller:
         self.handle.step()
         self.steps += 1
         rows_out = self._emit_outputs()
+        if not getattr(self.handle, "interval_open", False):
+            # validation publish: swap in immutable read-plane snapshots
+            # (host engine: every step; compiled: when the deferred-
+            # validation interval closed this tick). BEFORE the periodic
+            # checkpoint so a checkpoint captures this tick's publication.
+            self.read_plane.publish()
         self._maybe_checkpoint_locked()
         self._run_monitors()
         # the tick record is stamped LAST so checkpoint writes and in-tick
@@ -640,6 +673,7 @@ class Controller:
             "checkpoints": self.checkpoints,
             "last_checkpoint_tick": self.last_checkpoint_tick,
             "checkpoint_error": self.checkpoint_error,
+            "read_plane": self.read_plane.stats(),
             "inputs": {
                 name: {
                     "total_records": ep.total_records,

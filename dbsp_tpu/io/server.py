@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
@@ -203,25 +204,111 @@ class CircuitServer:
                     # summary + last profile/lineage + analysis findings,
                     # composed purely from the existing surfaces
                     self._json(server.debug_bundle())
+                elif route.startswith("/view/"):
+                    # point/range/scan read against the PUBLISHED snapshot
+                    # (dbsp_tpu/serving.py): ?key=k1[,k2..] | ?lo=&hi= |
+                    # no params = full scan; &limit=N caps rows. Lock-free
+                    # like /timeline: resolves the current epoch's
+                    # immutable snapshot with one atomic load — the step
+                    # lock and quiesce() are NEVER taken on this path
+                    # (C003). Staleness <= one validation interval. 503
+                    # when the plane is off (DBSP_TPU_READPLANE=0).
+                    t0 = _time.perf_counter()
+                    plane = c.read_plane
+                    if not plane.enabled:
+                        return self._json(
+                            {"error": "read plane disabled "
+                                      "(DBSP_TPU_READPLANE=0)"}, 503)
+                    name = route.rsplit("/", 1)[1]
+                    qs = parse_qs(url.query)
+                    try:
+                        key = tuple(int(x) for x in
+                                    qs["key"][0].split(",")) \
+                            if "key" in qs else None
+                        lo = int(qs["lo"][0]) if "lo" in qs else None
+                        hi = int(qs["hi"][0]) if "hi" in qs else None
+                        limit = int(qs["limit"][0]) if "limit" in qs \
+                            else None
+                        obj = plane.query(name, key=key, lo=lo, hi=hi,
+                                          limit=limit)
+                    except KeyError:
+                        return self._json(
+                            {"error": f"unknown view {name!r}; have "
+                                      f"{sorted(plane.views())}"}, 404)
+                    except ValueError as e:
+                        return self._json({"error": str(e)}, 400)
+                    plane.note_read(
+                        "view_point" if key is not None else
+                        "view_range" if (lo is not None or hi is not None)
+                        else "view_scan", t0)
+                    self._json(obj)
+                elif route == "/changefeed":
+                    # changefeed read with a resume-from-epoch cursor:
+                    # ?view=<name>&after=<epoch>[&timeout=<s>][&limit=N].
+                    # Long-poll waits on the plane's wakeup condition —
+                    # never the step lock (C003); a cursor behind the
+                    # ring's retention gets a synthesized full-state
+                    # snapshot record first.
+                    t0 = _time.perf_counter()
+                    plane = c.read_plane
+                    if not plane.enabled:
+                        return self._json(
+                            {"error": "read plane disabled "
+                                      "(DBSP_TPU_READPLANE=0)"}, 503)
+                    qs = parse_qs(url.query)
+                    if "view" not in qs:
+                        return self._json({"error": "?view= required"}, 400)
+                    name = qs["view"][0]
+                    try:
+                        obj = plane.changefeed(
+                            name,
+                            after_epoch=int(qs.get("after", ["0"])[0]),
+                            timeout_s=float(qs.get("timeout", ["0"])[0]),
+                            limit=int(qs["limit"][0]) if "limit" in qs
+                            else None)
+                    except KeyError:
+                        return self._json(
+                            {"error": f"unknown view {name!r}; have "
+                                      f"{sorted(plane.views())}"}, 404)
+                    except ValueError as e:
+                        return self._json({"error": str(e)}, 400)
+                    plane.note_read("changefeed", t0)
+                    self._json(obj)
                 elif route.startswith("/output_endpoint/"):
+                    # Non-destructive sample of the latest emitted batch.
+                    # Read plane ON (default): served from the last
+                    # PUBLISHED snapshot — one atomic reference load, no
+                    # step lock, no quiesce; the served batch is the very
+                    # object the controller emitted at the last validation
+                    # publish (bit-identical to a quiesced peek) and is at
+                    # most ONE VALIDATION INTERVAL stale (host engine: one
+                    # step). Read plane OFF (DBSP_TPU_READPLANE=0, the A/B
+                    # control): the historical quiesced read — step lock
+                    # held, open interval flushed, then peek.
+                    # The X-Dbsp-Step tick id lets pollers dedup repeats
+                    # (the same batch is re-served until the next publish).
+                    t0 = _time.perf_counter()
                     name = route.rsplit("/", 1)[1]
                     try:
                         col = c.catalog.output(name)
                     except KeyError as e:
                         return self._json({"error": str(e)}, 404)
                     fmt = parse_qs(url.query).get("format", ["json"])[0]
-                    # non-destructive sample of the latest tick's delta; the
-                    # X-Dbsp-Step tick id lets pollers dedup repeats (the
-                    # same delta is re-served until the next tick). Read the
-                    # id BEFORE the batch: if a tick lands between the two
-                    # reads the new batch is served under the old id, which
-                    # errs toward a duplicate delivery (dedup handles it)
-                    # instead of a skipped delta.
-                    step = str(col.handle.step_id)
-                    batch = col.handle.peek()
+                    plane = c.read_plane
+                    epoch = None
+                    if plane.enabled:
+                        snap = plane.snapshot(name)
+                        step, batch = str(snap.last_step), snap.last_batch
+                        epoch = str(snap.epoch)
+                    else:
+                        with c.quiesce():
+                            step = str(col.handle.step_id)
+                            batch = col.handle.peek()
                     if batch is None:
                         self.send_response(200)
                         self.send_header("X-Dbsp-Step", step)
+                        if epoch is not None:
+                            self.send_header("X-Dbsp-Epoch", epoch)
                         self.send_header("Access-Control-Allow-Origin", "*")
                         self.send_header("Content-Length", "0")
                         self.end_headers()
@@ -229,11 +316,14 @@ class CircuitServer:
                         body = OUTPUT_FORMATS[fmt]().encode(batch)
                         self.send_response(200)
                         self.send_header("X-Dbsp-Step", step)
+                        if epoch is not None:
+                            self.send_header("X-Dbsp-Epoch", epoch)
                         self.send_header("Access-Control-Allow-Origin", "*")
                         self.send_header("Content-Type", "text/plain")
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
+                    plane.note_read("output", t0)
                 else:
                     self._json({"error": f"no route {route}"}, 404)
 

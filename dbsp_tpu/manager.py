@@ -33,6 +33,11 @@ Routes:
   POST /pipelines/<name>/shutdown
   POST /pipelines/<name>/checkpoint  write one durable generation now
   DELETE /pipelines/<name>           (409 while running)
+  GET  /pipelines/<name>/view/<view> snapshot read fanned out over the
+                                     replica set (primary fallback)
+  GET  /pipelines/<name>/replicas    replica freshness (staleness_s)
+  POST /pipelines/<name>/replicas    scale the read tier {"count": N}
+  DELETE /pipelines/<name>/replicas  stop every replica
 
 Durability: with ``DBSP_TPU_CHECKPOINT_DIR`` set (or a per-pipeline
 ``checkpoint_dir`` config key), each pipeline checkpoints periodically
@@ -48,8 +53,10 @@ import json
 import os
 import queue
 import threading
+import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 
@@ -113,6 +120,13 @@ class Pipeline:
         self.fallback_reason: Optional[str] = None
         # tick restored from a checkpoint at deploy (None = fresh start)
         self.restored_tick: Optional[int] = None
+        # read replicas (dbsp_tpu/serving.py ReplicaServer): stateless
+        # snapshot servers fed by this pipeline's changefeed; the manager
+        # fans /pipelines/<name>/view/<view> reads out across them
+        self.replicas: List = []
+        self._fanout_rr = 0
+        self._replica_gauge = None
+        self._replica_breached: Dict[str, bool] = {}
         _tsan_hook(self)
 
     def compile_and_start(self, _allow_restore: bool = True) -> None:
@@ -245,7 +259,110 @@ class Pipeline:
                     if info.get("fallback_from") else None))
         return True
 
+    # -- read replicas -------------------------------------------------------
+    def add_replicas(self, n: int) -> List[dict]:
+        """Start ``n`` stateless read replicas fed by this pipeline's
+        changefeed (serving.ReplicaServer). Each replica long-polls the
+        pipeline port's ``/changefeed`` per view and serves ``/view/<name>``
+        from its own folded state — reads never touch the primary's step
+        path. Returns the new replicas' status dicts."""
+        if self.status != "running" or self.port is None:
+            raise RuntimeError(f"pipeline {self.name} is not running")
+        if not self.controller.read_plane.enabled:
+            raise RuntimeError("read plane disabled (DBSP_TPU_READPLANE=0)")
+        from dbsp_tpu.serving import ReplicaServer
+
+        views = list(self.controller.catalog.outputs)
+        started = []
+        base = len(self.replicas)
+        for i in range(int(n)):
+            r = ReplicaServer(f"http://127.0.0.1:{self.port}", views,
+                              name=f"{self.name}-r{base + i}")
+            r.start()
+            self.replicas.append(r)
+            started.append(r.status())
+        if self._replica_gauge is None and self.obs is not None:
+            self._replica_gauge = self.obs.registry.gauge(
+                "dbsp_tpu_read_replica_staleness_seconds",
+                "Per-replica read staleness: 0 when caught up to the "
+                "primary's published epoch, else seconds since the newest "
+                "record the replica has applied.",
+                labels=("replica",))
+            # collector: refresh staleness gauges on every scrape so the
+            # metric is live without a poller thread
+            def _collect() -> None:
+                self.replica_status()
+
+            self.obs.registry.register_collector(_collect)
+        return started
+
+    def replica_status(self) -> List[dict]:
+        """Per-replica freshness: staleness is 0.0 while the replica's
+        changefeed cursor has caught up to every view's published epoch on
+        the primary, else seconds since the newest record it applied. A
+        staleness breach (> ``DBSP_TPU_READ_STALENESS_BOUND_S``, default
+        5.0 s) records one ``readpath`` flight event per transition."""
+        plane = self.controller.read_plane if self.controller else None
+        ps = plane.stats() if plane and plane.enabled else {}
+        primary = ps.get("views", {})
+        bound = float(os.environ.get(
+            "DBSP_TPU_READ_STALENESS_BOUND_S", "5.0"))
+        now = time.time()
+        out = []
+        for r in self.replicas:
+            st = r.status()
+            lag = 0.0
+            for v, cur in st["epochs"].items():
+                pe = (primary.get(v) or {}).get("epoch", 0)
+                if cur < pe:
+                    # behind: staleness since the newest record applied
+                    # (never applied anything -> since the primary's last
+                    # publish — the oldest data it could be missing)
+                    ats = st["applied_ts"].get(v) \
+                        or ps.get("last_publish_ts") or now
+                    lag = max(lag, now - ats)
+            st["staleness_s"] = lag
+            if self._replica_gauge is not None:
+                self._replica_gauge.labels(replica=st["name"]).set(lag)
+            breached = lag > bound
+            if breached and not self._replica_breached.get(st["name"]):
+                if self.obs is not None:
+                    self.obs.flight.record(
+                        "readpath", replica=st["name"], staleness_s=lag,
+                        bound_s=bound, stalled=st["stalled"])
+            self._replica_breached[st["name"]] = breached
+            out.append(st)
+        return out
+
+    def fanout_read(self, view: str, query: str = "") -> dict:
+        """Route one read across the replica set round-robin; with no
+        replicas (or a replica error) fall back to the primary's
+        ``/view/<view>`` route. Reads never block ingest either way."""
+        from urllib.parse import parse_qs
+
+        t0 = time.perf_counter()
+        reps = list(self.replicas)
+        if reps:
+            r = reps[self._fanout_rr % len(reps)]
+            self._fanout_rr += 1
+            try:
+                ans = r.answer(view, parse_qs(query))
+                plane = self.controller.read_plane
+                if plane.enabled:
+                    plane.note_read("replica_fanout", t0)
+                return ans
+            except Exception:  # noqa: BLE001 — replica down: use primary
+                pass
+        url = f"http://127.0.0.1:{self.port}/view/{view}"
+        if query:
+            url += f"?{query}"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return json.loads(resp.read())
+
     def stop(self) -> None:
+        for r in self.replicas:
+            r.stop()
+        self.replicas = []
         if self.controller:
             self.controller.stop()
         if self.server:
@@ -374,6 +491,32 @@ class PipelineManager:
 
                 url = urlparse(self.path)
                 parts = url.path.rstrip("/").split("/")
+                if len(parts) == 5 and parts[1] == "pipelines" and \
+                        parts[3] == "view":
+                    # fan one snapshot read out across the pipeline's
+                    # replica set (round-robin; primary fallback). Lock
+                    # only for the lookup — the read itself never holds
+                    # the manager lock nor any pipeline step lock
+                    with mgr.lock:
+                        p = mgr.pipelines.get(parts[2])
+                    if p is None or p.status != "running":
+                        return self._json({"error": "not found"}, 404)
+                    try:
+                        return self._json(p.fanout_read(parts[4],
+                                                        url.query))
+                    except KeyError as e:
+                        return self._json(
+                            {"error": f"unknown view {e}"}, 404)
+                    except Exception as e:  # noqa: BLE001 — API error
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 400)
+                if len(parts) == 4 and parts[1] == "pipelines" and \
+                        parts[3] == "replicas":
+                    with mgr.lock:
+                        p = mgr.pipelines.get(parts[2])
+                    if p is None:
+                        return self._json({"error": "not found"}, 404)
+                    return self._json({"replicas": p.replica_status()})
                 if len(parts) == 4 and parts[1] == "pipelines" and \
                         parts[3] == "lineage":
                     # row-level lineage for one deployed pipeline —
@@ -551,6 +694,18 @@ class PipelineManager:
                         p.stop()
                         self._json(p.describe())
                     elif len(parts) == 4 and parts[1] == "pipelines" and \
+                            parts[3] == "replicas":
+                        # scale the read-serving tier: {"count": N} starts
+                        # N changefeed-fed snapshot replicas
+                        body = self._body()
+                        with mgr.lock:
+                            p = mgr.pipelines.get(parts[2])
+                        if p is None:
+                            return self._json({"error": "not found"}, 404)
+                        started = p.add_replicas(int(body.get("count", 1)))
+                        self._json({"replicas": started,
+                                    "total": len(p.replicas)})
+                    elif len(parts) == 4 and parts[1] == "pipelines" and \
                             parts[3] == "checkpoint":
                         with mgr.lock:
                             p = mgr.pipelines.get(parts[2])
@@ -568,6 +723,19 @@ class PipelineManager:
                     if len(parts) == 3 and parts[1] == "programs":
                         out, code = mgr.delete_program(parts[2])
                         self._json(out, code)
+                    elif len(parts) == 4 and parts[1] == "pipelines" and \
+                            parts[3] == "replicas":
+                        # tear the replica tier down (lookup under the
+                        # lock; stop() joins feed threads outside it)
+                        with mgr.lock:
+                            p = mgr.pipelines.get(parts[2])
+                        if p is None:
+                            return self._json({"error": "not found"}, 404)
+                        reps, p.replicas = p.replicas, []
+                        p._replica_breached.clear()
+                        for r in reps:
+                            r.stop()
+                        self._json({"stopped": len(reps)})
                     elif len(parts) == 3 and parts[1] == "pipelines":
                         out, code = mgr.delete_pipeline(parts[2])
                         self._json(out, code)

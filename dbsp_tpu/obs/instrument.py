@@ -611,6 +611,11 @@ class PipelineObs:
         # arrival/visibility records straight onto this pipeline's timeline
         if hasattr(controller, "timeline"):
             controller.timeline = self.timeline
+        # read serving plane (dbsp_tpu/serving.py): read QPS/latency
+        # metrics + a flight ring for staleness-breach attribution
+        plane = getattr(controller, "read_plane", None)
+        if plane is not None:
+            plane.bind(registry=self.registry, flight=self.flight)
         self._flight_sources.append(
             ControllerFlightSource(controller, self.flight))
         return ControllerInstrumentation(controller, self.registry)

@@ -72,6 +72,12 @@ ALLOWED_LABEL_NAMES = frozenset((
     # event kind group that was evicted from the bounded ring — drawn
     # from the closed FlightRecorder event-kind vocabulary
     "source",
+    # read serving plane (dbsp_tpu/serving.py): "route" is the read API
+    # surface served (closed set: serving.READ_ROUTES); "replica" names
+    # a manager-orchestrated read replica — the value set is the
+    # deployment's replica topology, fixed at orchestration time like
+    # "pipeline"/"worker"
+    "route", "replica",
 ))
 
 

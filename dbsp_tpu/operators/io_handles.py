@@ -120,6 +120,12 @@ class OutputOperator(SinkOperator):
     # lagging consumers coalesce their backlog past this many queued deltas
     MAX_QUEUED = 256
 
+    # build-time view-mode stamp (set by ``output()``): True when the
+    # stream feeding this sink ends in ``integrate()``, i.e. every emitted
+    # batch is the FULL INTEGRAL of the view (the read plane serves
+    # "last"), not a per-tick delta to fold
+    integral = False
+
     def __init__(self):
         self.current: Optional[Batch] = None
         self.step_id = 0  # monotone tick counter (lets HTTP readers dedup)
@@ -189,6 +195,12 @@ class OutputHandle:
         v = self._op.current
         return {} if v is None else v.to_dict()
 
+    @property
+    def integral(self) -> bool:
+        """True when emissions are full integrals (``integrate()`` tail),
+        False for per-tick deltas — the read plane's mode switch."""
+        return self._op.integral
+
 
 def add_input_zset(circuit: Circuit, key_dtypes: Sequence,
                    val_dtypes: Sequence = ()) -> Tuple[Stream, InputHandle]:
@@ -207,5 +219,9 @@ def add_input_zset(circuit: Circuit, key_dtypes: Sequence,
 @stream_method
 def output(self: Stream) -> OutputHandle:
     op = OutputOperator()
+    # the `integrate()` builder ends in a _PlusNamed("integrate") node, so
+    # the final node's operator name is a reliable build-time marker that
+    # this sink sees full integrals every tick
+    op.integral = getattr(self.node.operator, "name", "") == "integrate"
     self.circuit.add_sink(op, self)
     return OutputHandle(op)

@@ -1,0 +1,745 @@
+"""Lock-free read serving plane: epoch-published snapshots, view index,
+changefeed, read replicas.
+
+Everything through the residency/compilation PRs scales *ingest*;
+production traffic against maintained views is mostly *reads*, and until
+this module every read rode the controller step lock
+(``Controller.quiesce()``). The plane moves reads off that lock entirely:
+
+* **Epoch-published snapshots** — at each validation publish (every host
+  step; every closed interval on the compiled engine) the controller
+  calls :meth:`ReadPlane.publish` *while it already holds the step
+  lock*; the plane builds an immutable :class:`ViewSnapshot` per changed
+  view and swaps it in under the plane's own ``_lock``. Cold sorted runs
+  are shared by reference between consecutive snapshots (only the new
+  interval's delta becomes a fresh run), so publication is O(hot delta),
+  not O(state) — the LSM idiom of the trace spines, replayed host-side.
+* **Lock-free readers** — a read resolves ``view_state.snap`` with ONE
+  GIL-atomic attribute load and then touches only that immutable
+  snapshot: no step lock, no quiesce, not even the plane lock. Point and
+  range lookups run ``np.searchsorted`` prefix narrowing over each run's
+  (keys, vals)-lexicographic column arrays and Z-sum the fragments.
+* **Changefeed** — every publication appends exactly one record per
+  changed view to a bounded per-view ring; long-poll readers resume from
+  an epoch cursor. A cursor that fell behind the ring's retention gets a
+  synthesized ``kind="snapshot"`` record (full state at the current
+  epoch) followed by live deltas — exactly-once per published interval,
+  never a gap.
+* **Read replicas** — :class:`ReplicaServer` is a stateless HTTP
+  snapshot server fed by the primary's changefeed; the manager
+  fans reads out across replicas and surfaces per-replica staleness.
+
+Mode: a view whose output stream ends in ``integrate()`` emits FULL
+INTEGRALS per tick (``mode="last"`` — the manager's SQL views); raw
+pipelines emit per-interval deltas (``mode="delta"``) which the plane
+folds into runs. Changefeed records are ALWAYS deltas (uniform replica
+fold); in "last" mode the delta is the dict-diff of consecutive
+integrals, so publication there is O(view) — documented, and irrelevant
+to the raw ingest A/B which runs delta mode.
+
+Kill switch: ``DBSP_TPU_READPLANE=0`` (:func:`readplane_enabled`)
+disables publication; the HTTP layer then falls back to the quiesced
+read path — the A/B control ``tools/bench_readpath_ab.py`` measures
+against.
+
+Staleness contract: a snapshot read is at most one validation interval
+behind the writer (host engine: one step). ``snap.ts`` is the publish
+wall-time; replica staleness adds one changefeed hop, surfaced per
+replica via ``dbsp_tpu_read_replica_staleness_seconds{replica}``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+from dbsp_tpu.zset.batch import Batch
+
+__all__ = ["readplane_enabled", "READ_ROUTES", "ViewSnapshot", "ReadPlane",
+           "ReplicaServer"]
+
+
+def readplane_enabled(env=None) -> bool:
+    """``DBSP_TPU_READPLANE`` gate (default on). Off = no publication;
+    HTTP reads fall back to the quiesced path (the A/B control)."""
+    e = os.environ if env is None else env
+    return e.get("DBSP_TPU_READPLANE", "1") != "0"
+
+
+#: closed value set for the ``route`` metric label (check_metrics lints
+#: label NAMES; the value set here is fixed by the read API surface)
+READ_ROUTES = ("view_point", "view_range", "view_scan", "output",
+               "changefeed", "replica_fanout")
+
+
+# ---------------------------------------------------------------------------
+# sorted runs + snapshots (immutable after construction)
+# ---------------------------------------------------------------------------
+
+
+class _Run:
+    """One immutable sorted run: live rows only, columns as host numpy
+    arrays in (keys, vals) lexicographic order — the layout
+    ``np.searchsorted`` prefix narrowing needs. Never mutated after
+    construction; snapshots share cold runs by reference."""
+
+    __slots__ = ("cols", "weights", "n")
+
+    def __init__(self, cols: Sequence[np.ndarray], weights: np.ndarray):
+        self.cols = tuple(cols)
+        self.weights = weights
+        self.n = int(weights.shape[0])
+
+
+def _run_from_batch(b: Optional[Batch]) -> Optional[_Run]:
+    """Host-side run from an emitted batch: drop dead rows, materialize
+    numpy columns, and (re)establish lexicographic order. Emitted batches
+    are consolidated by engine contract, but the lexsort is cheap
+    insurance on the publish path — the read path's searchsorted contract
+    must never depend on an upstream invariant silently eroding."""
+    if b is None:
+        return None
+    ws = np.asarray(b.weights).reshape(-1)
+    live = ws != 0
+    if not bool(live.any()):
+        return None
+    cols = [np.asarray(c).reshape(-1)[live] for c in b.cols]
+    ws = ws[live]
+    order = np.lexsort(tuple(reversed(cols)))
+    if not np.array_equal(order, np.arange(ws.size)):
+        cols = [c[order] for c in cols]
+        ws = ws[order]
+    return _Run(cols, ws)
+
+
+def _run_rows(run: Optional[_Run]) -> List[list]:
+    """JSON-ready ``[*row, weight]`` rows of one run."""
+    if run is None:
+        return []
+    lists = [c.tolist() for c in run.cols] + [run.weights.tolist()]
+    return [list(t) for t in zip(*lists)]
+
+
+def _merge_rows(runs: Sequence[Tuple[Sequence[np.ndarray], np.ndarray]]
+                ) -> List[Tuple[tuple, int]]:
+    """Z-sum row fragments from several runs into one sorted
+    ``[(row_tuple, weight)]`` list, dropping zero-weight rows."""
+    acc: Dict[tuple, int] = {}
+    for cols, ws in runs:
+        if len(ws) == 0:
+            continue
+        lists = [c.tolist() for c in cols]
+        for i, w in enumerate(ws.tolist()):
+            t = tuple(col[i] for col in lists)
+            nw = acc.get(t, 0) + w
+            if nw:
+                acc[t] = nw
+            else:
+                acc.pop(t, None)
+    return sorted(acc.items())
+
+
+def _rows_to_run(rows: List[Tuple[tuple, int]],
+                 proto: Optional[_Run]) -> Tuple[_Run, ...]:
+    """Single compacted run from merged rows (dtypes from ``proto``)."""
+    if not rows:
+        return ()
+    ncols = len(rows[0][0])
+    dtypes = [c.dtype for c in proto.cols] if proto is not None \
+        else [np.int64] * ncols
+    cols = [np.array([t[j] for t, _ in rows], dtype=dtypes[j])
+            for j in range(ncols)]
+    ws = np.array([w for _, w in rows], dtype=np.int64)
+    return (_Run(cols, ws),)
+
+
+def _bounds(run: _Run, prefix: Sequence[int]) -> Tuple[int, int]:
+    """Row index window matching a key-prefix via successive
+    searchsorted narrowing over the lexicographic columns."""
+    lo, hi = 0, run.n
+    for c, v in zip(run.cols, prefix):
+        seg = c[lo:hi]
+        lo, hi = (lo + int(np.searchsorted(seg, v, "left")),
+                  lo + int(np.searchsorted(seg, v, "right")))
+        if lo >= hi:
+            break
+    return lo, hi
+
+
+def _range_bounds(run: _Run, lo_v, hi_v) -> Tuple[int, int]:
+    """Inclusive ``[lo, hi]`` window over the FIRST key column (range
+    queries address the leading key; multi-column prefixes are the point
+    lookup's job)."""
+    c0 = run.cols[0]
+    lo = 0 if lo_v is None else int(np.searchsorted(c0, lo_v, "left"))
+    hi = run.n if hi_v is None else int(np.searchsorted(c0, hi_v, "right"))
+    return lo, hi
+
+
+class ViewSnapshot:
+    """Immutable published state of one view at one epoch. Readers hold a
+    reference across their whole query; publication swaps the
+    ``_ViewState.snap`` pointer and never mutates an existing snapshot."""
+
+    __slots__ = ("view", "epoch", "step", "ts", "mode", "nkeys", "runs",
+                 "last_batch", "last_step")
+
+    def __init__(self, view: str, epoch: int, step: int, ts: float,
+                 mode: str, nkeys: Optional[int], runs: Tuple[_Run, ...],
+                 last_batch: Optional[Batch], last_step: int):
+        self.view = view
+        self.epoch = epoch
+        self.step = step
+        self.ts = ts
+        self.mode = mode
+        self.nkeys = nkeys
+        self.runs = runs
+        self.last_batch = last_batch
+        self.last_step = last_step
+
+    def rows(self) -> List[Tuple[tuple, int]]:
+        """Full merged state (sorted ``[(row_tuple, weight)]``)."""
+        return _merge_rows([(r.cols, r.weights) for r in self.runs])
+
+
+class _ViewState:
+    """Per-view mutable publication state. All mutation happens under the
+    plane's ``_lock``; the reader-facing ``snap`` pointer is swapped
+    there and read lock-free. (No ``__slots__``: the tsan class swap
+    needs ``__dict__``/``__weakref__``.)"""
+
+    def __init__(self, name: str, handle, mode: str, capacity: int):
+        self.name = name
+        self.handle = handle
+        self.mode = mode
+        self.nkeys: Optional[int] = None
+        self.cid = handle.register_consumer() if mode == "delta" else None
+        self.snap = ViewSnapshot(name, 0, 0, 0.0, mode, None, (), None, 0)
+        self.prev_rows: Dict[tuple, int] = {}  # "last" mode diff base
+        self.feed: deque = deque(maxlen=capacity)
+        self.dropped_epoch = 0  # max epoch aged out of the feed ring
+        self.seen_step = 0
+        _tsan_hook(self)
+
+
+# ---------------------------------------------------------------------------
+# the plane
+# ---------------------------------------------------------------------------
+
+
+class ReadPlane:
+    """Primary-side read serving plane (one per controller).
+
+    Writers: :meth:`publish` — called by the controller on its step path
+    (step lock already held); takes the plane's OWN ``_lock`` for the
+    epoch swap. Readers: :meth:`query`/:meth:`snapshot` — zero locks;
+    :meth:`changefeed` — lock-free scan of the feed ring plus an
+    OPTIONAL bounded wait on ``_wakeup`` (its own condition, never held
+    while publishing runs and released during every wait)."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 capacity: Optional[int] = None,
+                 compact_after: Optional[int] = None):
+        self.enabled = readplane_enabled() if enabled is None \
+            else bool(enabled)
+        self.capacity = int(capacity if capacity is not None else
+                            os.environ.get("DBSP_TPU_CHANGEFEED_CAPACITY",
+                                           "1024"))
+        self.compact_after = int(
+            compact_after if compact_after is not None else
+            os.environ.get("DBSP_TPU_READPLANE_COMPACT_AFTER", "8"))
+        self._lock = threading.Lock()
+        # long-poll wakeup only — deliberately NOT the plane lock: a
+        # TracedLock-wrapped lock can't back a Condition (wait() bypasses
+        # the wrapper's bookkeeping), and pollers must never contend with
+        # the publish path anyway
+        self._wakeup = threading.Condition()
+        self._views: Dict[str, _ViewState] = {}
+        self.epoch = 0
+        self.publishes = 0
+        self.last_publish_ts: Optional[float] = None
+        self.flight = None
+        self._read_qps = None
+        self._read_seconds = None
+        self._publish_total = None
+        _tsan_hook(self)
+
+    # -- wiring -------------------------------------------------------------
+
+    def add_view(self, name: str, handle) -> None:
+        """Register one served view (controller construction time, before
+        any traffic). Mode comes from the build-time ``integrate()``
+        stamp on the output operator."""
+        mode = "last" if getattr(handle, "integral", False) else "delta"
+        with self._lock:
+            self._views[name] = _ViewState(name, handle, mode,
+                                           self.capacity)
+
+    def bind(self, registry=None, pipeline: str = "", flight=None) -> None:
+        """Optional observability wiring (idempotent): read metrics on a
+        registry + a flight ring for staleness-breach attribution. The
+        plane is fully functional unbound (raw controllers, tests)."""
+        if flight is not None:
+            self.flight = flight
+        if registry is None or self._read_qps is not None:
+            return
+        from dbsp_tpu.obs.registry import default_latency_buckets
+
+        self._read_qps = registry.counter(
+            "dbsp_tpu_read_qps_total",
+            "Read-plane requests served, by route (closed set: "
+            "serving.READ_ROUTES)", labels=("route",))
+        self._read_seconds = registry.histogram(
+            "dbsp_tpu_read_seconds",
+            "Read-plane request latency by route — snapshot resolution "
+            "+ index lookup, never the step lock",
+            labels=("route",), buckets=default_latency_buckets())
+        self._publish_total = registry.counter(
+            "dbsp_tpu_read_publish_total",
+            "Epoch publications (snapshot swaps) performed by the "
+            "controller's validation publish")
+
+    def note_read(self, route: str, t0: float) -> None:
+        """Metric stamp for one served read (``t0`` = perf_counter at
+        request start). No-op when unbound."""
+        if self._read_qps is not None:
+            self._read_qps.labels(route=route).inc()
+            self._read_seconds.labels(route=route).observe(
+                time.perf_counter() - t0)
+
+    # -- publication (controller step path; plane lock only) ---------------
+
+    def publish(self) -> int:
+        """Swap in new snapshots for every view whose output advanced
+        since the last publication; append exactly one changefeed record
+        per changed view. Returns the (possibly unchanged) epoch.
+
+        Called by the controller AFTER outputs were emitted for the
+        closing interval, while it still holds the step lock — so handle
+        reads here are race-free. The epoch swap itself happens under the
+        plane's own ``_lock``; readers never take it."""
+        if not self.enabled:
+            return self.epoch
+        now = time.time()
+        with self._lock:
+            changed = []
+            for vs in self._views.values():
+                sid = vs.handle.step_id
+                if sid == vs.seen_step:
+                    continue
+                vs.seen_step = sid
+                changed.append((vs, sid))
+            if not changed:
+                return self.epoch
+            epoch = self.epoch + 1
+            for vs, sid in changed:
+                self._publish_view_locked(vs, sid, epoch, now)
+            self.epoch = epoch
+            self.publishes += 1
+            self.last_publish_ts = now
+        if self._publish_total is not None:
+            self._publish_total.inc()
+        with self._wakeup:
+            self._wakeup.notify_all()
+        return epoch
+
+    def _publish_view_locked(self, vs: _ViewState, sid: int, epoch: int,
+                             now: float) -> None:  # holds: _lock
+        cur = vs.handle.peek()
+        if vs.nkeys is None and cur is not None:
+            vs.nkeys = len(cur.keys)
+        if vs.mode == "last":
+            run = _run_from_batch(cur)
+            runs: Tuple[_Run, ...] = (run,) if run is not None else ()
+            state = dict(_merge_rows([(r.cols, r.weights) for r in runs]))
+            delta_rows = _diff_rows(vs.prev_rows, state)
+            vs.prev_rows = state
+        else:
+            delta = vs.handle.read_consumer(vs.cid)
+            run = _run_from_batch(delta)
+            runs = vs.snap.runs + ((run,) if run is not None else ())
+            if len(runs) > self.compact_after:
+                proto = runs[0]
+                runs = _rows_to_run(
+                    _merge_rows([(r.cols, r.weights) for r in runs]),
+                    proto)
+            delta_rows = _run_rows(run)
+        vs.snap = ViewSnapshot(vs.name, epoch, sid, now, vs.mode,
+                               vs.nkeys, runs, cur, sid)
+        if vs.feed.maxlen is not None and len(vs.feed) == vs.feed.maxlen \
+                and vs.feed:
+            vs.dropped_epoch = max(vs.dropped_epoch, vs.feed[0]["epoch"])
+        vs.feed.append({"view": vs.name, "epoch": epoch, "step": sid,
+                        "ts": now, "kind": "delta", "nkeys": vs.nkeys,
+                        "rows": delta_rows})
+
+    # -- readers (zero locks on the snapshot path) --------------------------
+
+    def views(self) -> Tuple[str, ...]:
+        return tuple(self._views)
+
+    def snapshot(self, view: str) -> ViewSnapshot:
+        """Current immutable snapshot — ONE atomic attribute load."""
+        vs = self._views.get(view)
+        if vs is None:
+            raise KeyError(view)
+        return vs.snap
+
+    def query(self, view: str, key: Optional[Sequence[int]] = None,
+              lo=None, hi=None, limit: Optional[int] = None) -> dict:
+        """Point (``key`` prefix), range (``[lo, hi]`` inclusive over the
+        leading key column), or full-scan read against the published
+        snapshot. Lock-free: resolves the snapshot once, then touches
+        only immutable runs."""
+        snap = self.snapshot(view)
+        if key is not None:
+            parts = []
+            for r in snap.runs:
+                b, e = _bounds(r, key)
+                if b < e:
+                    parts.append(([c[b:e] for c in r.cols],
+                                  r.weights[b:e]))
+            rows = _merge_rows(parts)
+        elif lo is not None or hi is not None:
+            parts = []
+            for r in snap.runs:
+                b, e = _range_bounds(r, lo, hi)
+                if b < e:
+                    parts.append(([c[b:e] for c in r.cols],
+                                  r.weights[b:e]))
+            rows = _merge_rows(parts)
+        else:
+            rows = snap.rows()
+        if limit is not None:
+            rows = rows[:limit]
+        return {"view": view, "epoch": snap.epoch, "step": snap.step,
+                "ts": snap.ts, "mode": snap.mode, "nkeys": snap.nkeys,
+                "rows": [[*t, w] for t, w in rows]}
+
+    def changefeed(self, view: str, after_epoch: int = 0,
+                   timeout_s: float = 0.0,
+                   limit: Optional[int] = None) -> dict:
+        """Changefeed read with a resume-from-epoch cursor. Returns every
+        retained record with ``epoch > after_epoch`` (at most ``limit``);
+        when the cursor predates the ring's retention the first record is
+        a synthesized full-state ``kind="snapshot"`` at the current
+        epoch. ``timeout_s`` long-polls on the wakeup condition (released
+        for the whole wait; never the plane or step lock)."""
+        vs = self._views.get(view)
+        if vs is None:
+            raise KeyError(view)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            if vs.dropped_epoch > after_epoch:
+                snap = vs.snap
+                rec = {"view": view, "epoch": snap.epoch,
+                       "step": snap.step, "ts": snap.ts,
+                       "kind": "snapshot", "nkeys": snap.nkeys,
+                       "rows": [[*t, w] for t, w in snap.rows()]}
+                recs = [rec] + [r for r in list(vs.feed)
+                                if r["epoch"] > snap.epoch]
+            else:
+                recs = [r for r in list(vs.feed)
+                        if r["epoch"] > after_epoch]
+            if recs or timeout_s <= 0:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            with self._wakeup:
+                if self.epoch <= after_epoch:
+                    self._wakeup.wait(min(0.25, remaining))
+        if limit is not None:
+            recs = recs[:limit]
+        return {"view": view, "epoch": self.epoch, "records": recs}
+
+    # -- checkpoint integration --------------------------------------------
+
+    def state_batches(self) -> Dict[str, Batch]:
+        """Compacted per-view state as consolidated :class:`Batch`es for
+        the checkpoint payload (called under the step lock via the
+        controller's checkpoint path)."""
+        out: Dict[str, Batch] = {}
+        with self._lock:
+            for name, vs in self._views.items():
+                snap = vs.snap
+                if not snap.runs:
+                    continue
+                rows = snap.rows()
+                if not rows:
+                    continue
+                proto = snap.runs[0]
+                nk = snap.nkeys if snap.nkeys is not None else len(
+                    proto.cols)
+                cols = [np.array([t[j] for t, _ in rows],
+                                 dtype=proto.cols[j].dtype)
+                        for j in range(len(proto.cols))]
+                ws = np.array([w for _, w in rows], dtype=np.int64)
+                out[name] = Batch.from_columns(cols[:nk], cols[nk:], ws)
+        return out
+
+    def restore(self, epoch: int, batches: Dict[str, Batch]) -> None:
+        """Adopt checkpointed plane state (controller restore path, step
+        lock held). Feeds reset; any pre-restore cursor resumes via a
+        synthesized snapshot record (``dropped_epoch = epoch``)."""
+        now = time.time()
+        with self._lock:
+            self.epoch = int(epoch)
+            for name, vs in self._views.items():
+                b = batches.get(name)
+                run = _run_from_batch(b)
+                runs = (run,) if run is not None else ()
+                if run is not None:
+                    vs.nkeys = len(b.keys)
+                sid = vs.handle.step_id
+                vs.seen_step = sid
+                vs.snap = ViewSnapshot(name, self.epoch, sid, now,
+                                       vs.mode, vs.nkeys, runs,
+                                       vs.handle.peek(), sid)
+                if vs.mode == "last":
+                    vs.prev_rows = dict(vs.snap.rows())
+                elif vs.cid is not None:
+                    try:  # discard deltas already folded into the state
+                        vs.handle.read_consumer(vs.cid)
+                    except KeyError:
+                        vs.cid = vs.handle.register_consumer()
+                vs.feed.clear()
+                vs.dropped_epoch = self.epoch
+        with self._wakeup:
+            self._wakeup.notify_all()
+
+    def stats(self) -> dict:
+        views = {}
+        for name, vs in self._views.items():
+            snap = vs.snap
+            views[name] = {"mode": snap.mode, "epoch": snap.epoch,
+                           "step": snap.step, "runs": len(snap.runs),
+                           "rows": sum(r.n for r in snap.runs),
+                           "feed_len": len(vs.feed)}
+        return {"enabled": self.enabled, "epoch": self.epoch,
+                "publishes": self.publishes,
+                "last_publish_ts": self.last_publish_ts, "views": views}
+
+
+def _diff_rows(prev: Dict[tuple, int],
+               cur: Dict[tuple, int]) -> List[list]:
+    """Z-set delta between consecutive integrals (``cur - prev``) as
+    JSON-ready sorted ``[*row, weight]`` rows."""
+    out = []
+    for t, w in cur.items():
+        dw = w - prev.get(t, 0)
+        if dw:
+            out.append([*t, dw])
+    for t, w in prev.items():
+        if t not in cur:
+            out.append([*t, -w])
+    out.sort()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# read replicas
+# ---------------------------------------------------------------------------
+
+
+class ReplicaServer:
+    """Stateless snapshot read replica: folds the primary's changefeed
+    into a host-side row map per view and serves ``GET /view/<name>``
+    (point/range/scan) + ``GET /status`` from it. No engine, no step
+    lock anywhere in the process — the whole state is the changefeed
+    fold, reconstructible from epoch 0 (or any snapshot record).
+
+    ``stall()``/``resume()`` freeze the feed thread — the seeded
+    freshness-breach hook the replica tests and the manager's staleness
+    surfacing are proven against."""
+
+    def __init__(self, primary: str, views: Sequence[str],
+                 name: str = "replica", host: str = "127.0.0.1",
+                 port: int = 0, poll_timeout_s: float = 0.5):
+        self.primary = primary.rstrip("/")
+        self.views_served = tuple(views)
+        self.name = name
+        self.poll_timeout_s = float(poll_timeout_s)
+        self._lock = threading.Lock()  # state/cursor/cache fold guard
+        self._state: Dict[str, Dict[tuple, int]] = {
+            v: {} for v in self.views_served}
+        self._cursor: Dict[str, int] = {v: 0 for v in self.views_served}
+        self._nkeys: Dict[str, Optional[int]] = {
+            v: None for v in self.views_served}
+        self._applied_ts: Dict[str, Optional[float]] = {
+            v: None for v in self.views_served}
+        self._sorted: Dict[str, Optional[tuple]] = {
+            v: None for v in self.views_served}
+        self.applied = 0
+        self.stalled = False
+        self._stop = threading.Event()
+        plane = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                q = urllib.parse.parse_qs(parsed.query)
+                parts = parsed.path.strip("/").split("/")
+                try:
+                    if parts[0] == "status":
+                        self._json(200, plane.status())
+                    elif parts[0] == "view" and len(parts) == 2:
+                        self._json(200, plane.answer(parts[1], q))
+                    else:
+                        self._json(404, {"error": "unknown route"})
+                except KeyError as e:
+                    self._json(404, {"error": f"unknown view {e}"})
+                except (ValueError, IndexError) as e:
+                    self._json(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"{name}-http",
+            daemon=True)
+        self._feed_thread = threading.Thread(
+            target=self._feed_loop, name=f"{name}-feed", daemon=True)
+        _tsan_hook(self)
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "ReplicaServer":
+        self._serve_thread.start()
+        self._feed_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._feed_thread.join(timeout=5)
+
+    def stall(self) -> None:
+        """Freeze the changefeed fold (seeded staleness breach)."""
+        self.stalled = True
+
+    def resume(self) -> None:
+        self.stalled = False
+
+    # -- feed ---------------------------------------------------------------
+
+    def _feed_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.stalled:
+                time.sleep(0.02)
+                continue
+            advanced = False
+            for v in self.views_served:
+                if self._stop.is_set() or self.stalled:
+                    break
+                url = (f"{self.primary}/changefeed?view={v}"
+                       f"&after={self._cursor[v]}"
+                       f"&timeout={self.poll_timeout_s}")
+                try:
+                    with urllib.request.urlopen(url, timeout=
+                                                self.poll_timeout_s + 5
+                                                ) as r:
+                        obj = json.loads(r.read())
+                except (urllib.error.URLError, OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+                recs = obj.get("records") or []
+                # re-check the stall AFTER the long-poll returns: a stall
+                # raised while the request was in flight must drop the
+                # response, or the freeze is porous for one poll interval
+                if recs and not self.stalled:
+                    self._apply(v, recs)
+                    advanced = True
+            if not advanced:
+                time.sleep(0.02)
+
+    def _apply(self, view: str, recs: List[dict]) -> None:
+        with self._lock:
+            st = self._state[view]
+            for rec in recs:
+                if rec.get("kind") == "snapshot":
+                    st = self._state[view] = {}
+                nk = rec.get("nkeys")
+                if nk is not None:
+                    self._nkeys[view] = nk
+                for row in rec.get("rows", ()):
+                    t, w = tuple(row[:-1]), row[-1]
+                    nw = st.get(t, 0) + w
+                    if nw:
+                        st[t] = nw
+                    else:
+                        st.pop(t, None)
+                self._cursor[view] = rec["epoch"]
+                self._applied_ts[view] = rec["ts"]
+                self.applied += 1
+            self._sorted[view] = None
+
+    # -- reads --------------------------------------------------------------
+
+    def _table(self, view: str) -> tuple:
+        """(rows, weights) sorted parallel lists — lazily rebuilt after a
+        fold, served to many readers by reference."""
+        cached = self._sorted[view]
+        if cached is not None:
+            return cached
+        with self._lock:
+            items = sorted(self._state[view].items())
+            cached = ([t for t, _ in items], [w for _, w in items])
+            self._sorted[view] = cached
+        return cached
+
+    def answer(self, view: str, q: Dict[str, list]) -> dict:
+        if view not in self._state:
+            raise KeyError(view)
+        rows_t, ws = self._table(view)
+        if "key" in q:
+            prefix = tuple(int(x) for x in q["key"][0].split(","))
+            b = bisect.bisect_left(rows_t, prefix)
+            out = []
+            while b < len(rows_t) and rows_t[b][:len(prefix)] == prefix:
+                out.append([*rows_t[b], ws[b]])
+                b += 1
+        elif "lo" in q or "hi" in q:
+            lo = int(q["lo"][0]) if "lo" in q else None
+            hi = int(q["hi"][0]) if "hi" in q else None
+            b = 0 if lo is None else bisect.bisect_left(rows_t, (lo,))
+            e = len(rows_t) if hi is None else \
+                bisect.bisect_left(rows_t, (hi + 1,))
+            out = [[*rows_t[i], ws[i]] for i in range(b, e)]
+        else:
+            out = [[*t, w] for t, w in zip(rows_t, ws)]
+        if "limit" in q:
+            out = out[:int(q["limit"][0])]
+        return {"view": view, "epoch": self._cursor[view],
+                "ts": self._applied_ts[view], "replica": self.name,
+                "nkeys": self._nkeys[view], "rows": out}
+
+    def status(self) -> dict:
+        return {"name": self.name, "stalled": self.stalled,
+                "applied": self.applied, "epochs": dict(self._cursor),
+                "applied_ts": dict(self._applied_ts)}

@@ -471,7 +471,8 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
     sched = InterleaveSchedule(
         seed=11, rate=0.04, sleep_s=0.001, max_yields=300,
         only=("Controller.", "SLOWatchdog.", "FlightRecorder.",
-              "PipelineManager.", "_InputEndpoint.", "Timeline."))
+              "PipelineManager.", "_InputEndpoint.", "Timeline.",
+              "ReadPlane."))
     cfg = {"min_batch_records": 1, "flush_interval_s": 0.02,
            "lineage_taps": True,
            "checkpoint_dir": str(tmp_path / f"ckpt-{mode}"),
@@ -489,7 +490,8 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
             stop_evt = threading.Event()
             errors = queue.Queue()
             done = {"pushes": 0, "lineage": 0, "profile": 0,
-                    "checkpoints": 0, "scrapes": 0, "steps": 0}
+                    "checkpoints": 0, "scrapes": 0, "steps": 0,
+                    "snap_reads": 0}
 
             def pusher():
                 try:
@@ -558,9 +560,35 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
                 except Exception as e:  # noqa: BLE001
                     errors.put(("checkpoint", e))
 
+            def snap_reader():
+                # lock-free read plane under full contention: point +
+                # range + scan against the published snapshot, and a
+                # changefeed cursor that must observe strictly
+                # monotonically increasing epochs (exactly-once)
+                try:
+                    cursor = 0
+                    while not stop_evt.is_set():
+                        pt = pipe.get("cat_stats", "3")
+                        assert pt["epoch"] >= 0
+                        rg = pipe.range("cat_stats", lo=0, hi=6)
+                        scan = pipe.range("cat_stats")
+                        assert len(scan["rows"]) >= len(rg["rows"])
+                        sub = pipe.subscribe("cat_stats",
+                                             after_epoch=cursor)
+                        epochs = [r["epoch"] for r in sub["records"]]
+                        assert epochs == sorted(set(epochs)), \
+                            f"changefeed replayed/reordered: {epochs}"
+                        assert all(e > cursor for e in epochs)
+                        if epochs:
+                            cursor = epochs[-1]
+                        done["snap_reads"] += 1
+                        time.sleep(0.01)
+                except Exception as e:  # noqa: BLE001
+                    errors.put(("snap_reader", e))
+
             threads = [threading.Thread(target=f, name=f.__name__)
                        for f in (pusher, stepper, scraper, lineage_reader,
-                                 profiler, checkpointer)]
+                                 profiler, checkpointer, snap_reader)]
             for t in threads:
                 t.start()
             time.sleep(2.5)
@@ -573,6 +601,7 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
             assert consumed > 10 and done["steps"] > 0
             assert done["lineage"] > 0 and done["profile"] > 0
             assert done["checkpoints"] > 0 and done["scrapes"] > 0
+            assert done["snap_reads"] > 0
 
             pipe.step()  # consume any remainder, emit the integral
             view = sorted(pipe.read("cat_stats").items())
@@ -589,6 +618,24 @@ def test_hammer_serving_plane(mode, monkeypatch, tmp_path):
             twin.step()
             twin_view = sorted(twin.read("cat_stats").items())
             assert view == twin_view
+
+            # the lock-free snapshot surfaces must agree with the twin
+            # bit-for-bit too: full-scan index read, and a changefeed
+            # replayed from epoch 0 folded into state
+            scan = pipe.range("cat_stats")
+            assert sorted((tuple(r[:-1]), r[-1])
+                          for r in scan["rows"]) == twin_view
+            sub = pipe.subscribe("cat_stats", after_epoch=0)
+            folded = {}
+            for rec in sub["records"]:
+                for row in rec["rows"]:
+                    t, w = tuple(row[:-1]), row[-1]
+                    nw = folded.get(t, 0) + w
+                    if nw:
+                        folded[t] = nw
+                    else:
+                        folded.pop(t, None)
+            assert sorted(folded.items()) == twin_view
 
             # stop: shutdown racing a final scrape volley
             def late_scraper():

@@ -1,0 +1,470 @@
+"""Tier-1 acceptance for the lock-free read serving plane
+(``dbsp_tpu/serving.py`` — README §Serving read path).
+
+Four contracts, each tested non-vacuously:
+
+* **Bit-identity** — snapshot reads (point / range / scan and the
+  ``/output_endpoint`` surface) agree bit-for-bit with a quiesced
+  consumer fold over the same output stream, q1–q8, on BOTH engines.
+* **Changefeed exactness** — resume-from-epoch delivers every published
+  interval exactly once, including ACROSS a checkpoint/restore where a
+  stale cursor must be healed by one synthesized ``kind="snapshot"``
+  record, never a gap or a replay.
+* **Replica freshness** — a caught-up replica reports staleness 0; a
+  SEEDED stall (``ReplicaServer.stall()``) must breach the configured
+  bound, be flight-attributed (kind ``readpath``), and recover on
+  resume. The stall proves the detector is live, not vacuous.
+* **Zero step-lock reads** — a tsan lock probe over a served read storm
+  records every traced lock acquisition by thread; read routes
+  (``/view``, ``/changefeed``, ``/output_endpoint``) must never touch
+  ``Controller._step_lock``/``_pushed_lock`` with the plane ON, and the
+  SAME probe must see the step lock from the quiesced fallback with
+  ``DBSP_TPU_READPLANE=0`` — the kill switch proven live and the
+  sentinel proven sensitive in one test.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from dbsp_tpu.circuit import Runtime
+from dbsp_tpu.io.catalog import Catalog
+from dbsp_tpu.io.controller import Controller, ControllerConfig
+from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                              build_inputs, queries)
+from dbsp_tpu.nexmark import model as M
+from dbsp_tpu.serving import READ_ROUTES, readplane_enabled
+
+QUERY_NAMES = ("q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8")
+EVENTS_PER_TICK = 400
+TICKS = 3
+
+
+def _build_all(c):
+    streams, handles = build_inputs(c)
+    return handles, {qn: getattr(queries, qn)(*streams).output()
+                     for qn in QUERY_NAMES}
+
+
+def _register_inputs(catalog, handles):
+    for name, h, key, vals in (
+            ("persons", handles[0], M.PERSON_KEY, M.PERSON_VALS),
+            ("auctions", handles[1], M.AUCTION_KEY, M.AUCTION_VALS),
+            ("bids", handles[2], M.BID_KEY, M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+
+
+def _fold(acc, batch):
+    """Z-fold one emitted delta batch into a dict state."""
+    if batch is None:
+        return
+    cols = [c.tolist() for c in batch.cols]
+    for i, w in enumerate(batch.weights.tolist()):
+        if w == 0:
+            continue
+        t = tuple(col[i] for col in cols)
+        nw = acc.get(t, 0) + w
+        if nw:
+            acc[t] = nw
+        else:
+            acc.pop(t, None)
+
+
+def _scan_rows(plane, view):
+    res = plane.query(view)
+    return [(tuple(r[:-1]), r[-1]) for r in res["rows"]]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: snapshot reads vs quiesced consumer fold, q1-q8, both engines
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["host", "compiled"])
+def test_snapshot_bit_identity_q1_q8(mode):
+    """One circuit carrying q1..q8; after every tick, every view's
+    published snapshot must equal the quiesced twin fold bit-for-bit —
+    point, range, scan, and the /output_endpoint batch identity."""
+    assert readplane_enabled()
+    handle, (handles, outs) = Runtime.init_circuit(1, _build_all)
+    driver = handle
+    if mode == "compiled":
+        from dbsp_tpu.compiled.driver import try_compiled_driver
+
+        driver = try_compiled_driver(handle)
+        assert driver is not None, "q1-q8 must all run compiled"
+    catalog = Catalog()
+    _register_inputs(catalog, handles)
+    for qn, out in outs.items():
+        catalog.register_output(qn, out, ())
+    ctl = Controller(driver, catalog, ControllerConfig(
+        min_batch_records=10 ** 9, flush_interval_s=3600.0))
+    plane = ctl.read_plane
+    assert set(plane.views()) == set(QUERY_NAMES)
+
+    # the quiesced twin: an independent consumer folding every delta
+    cids = {qn: outs[qn].register_consumer() for qn in QUERY_NAMES}
+    twin = {qn: {} for qn in QUERY_NAMES}
+
+    gen = NexmarkGenerator(GeneratorConfig(seed=13))
+    for t in range(TICKS):
+        gen.feed(handles, t * EVENTS_PER_TICK, (t + 1) * EVENTS_PER_TICK)
+        ctl.note_pushed(EVENTS_PER_TICK)
+        ctl.step()
+        with ctl.quiesce():
+            for qn in QUERY_NAMES:
+                _fold(twin[qn], outs[qn].read_consumer(cids[qn]))
+        for qn in QUERY_NAMES:
+            want = sorted(twin[qn].items())
+            assert _scan_rows(plane, qn) == want, \
+                f"{qn} snapshot scan diverged from quiesced fold at tick {t}"
+            # /output_endpoint surface: the published batch IS the
+            # object a quiesced peek would serve, at the same step
+            snap = plane.snapshot(qn)
+            assert snap.last_batch is outs[qn].peek()
+            assert snap.last_step == outs[qn].step_id
+            if want:
+                # point + range cross-checks against the fold
+                nk = snap.nkeys
+                key = want[0][0][:nk]
+                got = plane.query(qn, key=list(key))
+                exp = [(t_, w) for t_, w in want if t_[:nk] == key]
+                assert [(tuple(r[:-1]), r[-1]) for r in got["rows"]] == exp
+                k0 = want[0][0][0]
+                got = plane.query(qn, lo=k0, hi=k0)
+                exp = [(t_, w) for t_, w in want if t_[0] == k0]
+                assert [(tuple(r[:-1]), r[-1]) for r in got["rows"]] == exp
+
+
+# ---------------------------------------------------------------------------
+# changefeed: exactly-once resume, across checkpoint/restore
+# ---------------------------------------------------------------------------
+
+
+def _q4_controller(ckpt_dir):
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    _register_inputs(catalog, handles)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    ctl = Controller(handle, catalog, ControllerConfig(
+        min_batch_records=10 ** 9, flush_interval_s=3600.0,
+        checkpoint_dir=str(ckpt_dir), checkpoint_every_ticks=10 ** 9))
+    return ctl, handles
+
+
+def _feed_fold(rec, state):
+    if rec["kind"] == "snapshot":
+        state.clear()
+    for row in rec["rows"]:
+        t, w = tuple(row[:-1]), row[-1]
+        nw = state.get(t, 0) + w
+        if nw:
+            state[t] = nw
+        else:
+            state.pop(t, None)
+
+
+def test_changefeed_resume_exact_across_restore(tmp_path):
+    """A subscriber's fold must equal the published state no matter where
+    its cursor is — live, resumed mid-stream, or resumed from a cursor
+    that predates a restore (healed by one synthesized snapshot record).
+    Every epoch arrives exactly once, in order."""
+    gen = NexmarkGenerator(GeneratorConfig(seed=5))
+    ctl, handles = _q4_controller(tmp_path / "ckpt")
+    plane = ctl.read_plane
+
+    seen_epochs = []
+    live = {}
+    cursor = 0
+    for t in range(5):
+        gen.feed(handles, t * 200, (t + 1) * 200)
+        ctl.note_pushed(200)
+        ctl.step()
+        out = plane.changefeed("q4", after_epoch=cursor)
+        for rec in out["records"]:
+            assert rec["kind"] == "delta"
+            assert rec["epoch"] > cursor, "replayed epoch"
+            seen_epochs.append(rec["epoch"])
+            _feed_fold(rec, live)
+            cursor = rec["epoch"]
+    assert seen_epochs == sorted(set(seen_epochs))  # exactly once, ordered
+    assert sorted(live.items()) == _scan_rows(plane, "q4")
+
+    mid_cursor = seen_epochs[1]  # a subscriber that stopped early
+    ctl.checkpoint()
+    ckpt_scan = _scan_rows(plane, "q4")
+    ckpt_epoch = plane.epoch
+
+    # fresh process stand-in: new circuit + controller, restore
+    ctl2, handles2 = _q4_controller(tmp_path / "ckpt")
+    info = ctl2.restore_from()
+    assert info["tick"] > 0
+    plane2 = ctl2.read_plane
+    assert plane2.epoch == ckpt_epoch
+    assert _scan_rows(plane2, "q4") == ckpt_scan
+
+    # the early subscriber resumes against the restored plane: its feed
+    # history is gone, so ONE synthesized snapshot record must heal it
+    out = plane2.changefeed("q4", after_epoch=mid_cursor)
+    assert out["records"][0]["kind"] == "snapshot"
+    assert all(r["kind"] == "delta" for r in out["records"][1:])
+    resumed = {}
+    cursor2 = mid_cursor
+    for rec in out["records"]:
+        _feed_fold(rec, resumed)
+        cursor2 = rec["epoch"]
+    assert sorted(resumed.items()) == ckpt_scan
+
+    # post-restore publications flow to the resumed cursor exactly once
+    for t in range(5, 7):
+        gen.feed(handles2, t * 200, (t + 1) * 200)
+        ctl2.note_pushed(200)
+        ctl2.step()
+    out = plane2.changefeed("q4", after_epoch=cursor2)
+    epochs = [r["epoch"] for r in out["records"]]
+    assert epochs == sorted(set(epochs)) and all(e > cursor2
+                                                 for e in epochs)
+    for rec in out["records"]:
+        _feed_fold(rec, resumed)
+    assert sorted(resumed.items()) == _scan_rows(plane2, "q4")
+
+
+# ---------------------------------------------------------------------------
+# replica freshness: seeded stall must breach, be attributed, and recover
+# ---------------------------------------------------------------------------
+
+
+def test_replica_freshness_seeded_stall(monkeypatch):
+    from dbsp_tpu.client import Connection
+    from dbsp_tpu.manager import PipelineManager
+
+    monkeypatch.setenv("DBSP_TPU_MANAGER_COMPILED", "0")
+    monkeypatch.setenv("DBSP_TPU_READ_STALENESS_BOUND_S", "0.05")
+    mgr = PipelineManager()
+    mgr.start()
+    try:
+        conn = Connection(port=mgr.port)
+        conn.create_program("prog", {
+            "t": {"columns": ["k", "v"], "dtypes": ["int64", "int64"],
+                  "key_columns": 1}},
+            {"view": "SELECT k, v FROM t WHERE v >= 0"})
+        pipe = conn.start_pipeline("fresh", "prog",
+                                   config={"min_batch_records": 10 ** 9,
+                                           "flush_interval_s": 3600.0})
+        pipe.push("t", [[i, i] for i in range(8)])
+        pipe.step()
+        conn.add_replicas("fresh", 1)
+        p = mgr.pipelines["fresh"]
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            sts = conn.replicas("fresh")
+            if sts[0]["applied"] > 0 and sts[0]["staleness_s"] == 0.0:
+                break
+            time.sleep(0.05)
+        # caught up: freshness within the validation interval => 0 lag
+        assert sts[0]["staleness_s"] == 0.0
+
+        # seeded stall: freeze the fold, advance the primary, and the
+        # breach MUST surface — bounded staleness is a detector, and a
+        # detector that never fires is indistinguishable from a broken one
+        p.replicas[0].stall()
+        pipe.push("t", [[100, 100]])
+        pipe.step()
+        deadline = time.time() + 15
+        breached = []
+        while time.time() < deadline:
+            sts = conn.replicas("fresh")
+            breached = p.obs.flight.events(kinds=("readpath",))
+            if sts[0]["staleness_s"] > 0.05 and breached:
+                break
+            time.sleep(0.05)
+        assert sts[0]["staleness_s"] > 0.05, "stall never breached"
+        assert breached, "breach not flight-attributed"
+        assert breached[-1]["replica"] == sts[0]["name"]
+        assert breached[-1]["staleness_s"] > 0.05
+        assert breached[-1]["stalled"] is True
+
+        # recovery: resume -> fold catches up -> staleness back to 0
+        p.replicas[0].resume()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            sts = conn.replicas("fresh")
+            if sts[0]["staleness_s"] == 0.0:
+                break
+            time.sleep(0.05)
+        assert sts[0]["staleness_s"] == 0.0
+        ans = conn.read_view("fresh", "view", key=100)
+        assert ans["rows"] == [[100, 100, 1]]
+        conn.remove_replicas("fresh")
+        conn.shutdown_pipeline("fresh")
+    finally:
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero step-lock acquisitions on read routes (tsan lock probe)
+# ---------------------------------------------------------------------------
+
+
+class _LockProbe:
+    """tsan schedule hook recording (thread name, lock name) for every
+    traced acquisition — the machine check that read routes are
+    lock-free with respect to the serving plane's step path."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.acquires = []
+
+    def yield_point(self, hook: str, lock_name: str) -> None:
+        if hook == "acquire":
+            with self.lock:
+                self.acquires.append(
+                    (threading.current_thread().name, lock_name))
+
+    def by_handler_threads(self):
+        """Acquisitions made by HTTP handler threads (the only threads
+        besides MainThread in this test's server process)."""
+        return {(t, l) for t, l in self.acquires if t != "MainThread"}
+
+
+def _served_pipeline():
+    from dbsp_tpu.io.server import CircuitServer
+    from dbsp_tpu.obs import PipelineObs
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    _register_inputs(catalog, handles)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    ctl = Controller(handle, catalog, ControllerConfig(
+        min_batch_records=10 ** 9, flush_interval_s=3600.0))
+    # obs wiring binds the read metrics: their per-increment Metric lock
+    # is what makes handler threads VISIBLE to the lock probe (the read
+    # path itself acquires no serving-plane lock at all)
+    obs = PipelineObs(name="readpath-probe")
+    obs.attach_circuit(handle.circuit)
+    obs.attach_controller(ctl)
+    srv = CircuitServer(ctl, obs=obs)
+    srv.start()
+    return ctl, handles, srv
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def test_read_routes_never_take_step_lock():
+    """Read storm against /view, /changefeed, /output_endpoint with the
+    plane ON: HTTP handler threads must never acquire the controller's
+    step or push locks (MainThread drives every step, so any handler
+    acquisition is a read-route violation). The probe's sensitivity is
+    proven by the OFF-mode control below."""
+    from dbsp_tpu.testing import tsan
+
+    probe = _LockProbe()
+    with tsan.session(schedule=probe) as report:
+        ctl, handles, srv = _served_pipeline()
+        base = f"http://127.0.0.1:{srv.port}"
+        gen = NexmarkGenerator(GeneratorConfig(seed=3))
+        try:
+            for t in range(2):
+                gen.feed(handles, t * 200, (t + 1) * 200)
+                ctl.note_pushed(200)
+                ctl.step()
+
+            def storm():
+                for _ in range(6):
+                    assert _get(base, "/view/q4?key=1")[0] == 200
+                    assert _get(base, "/view/q4?lo=0&hi=50")[0] == 200
+                    assert _get(base, "/view/q4")[0] == 200
+                    assert _get(base,
+                                "/changefeed?view=q4&after=0")[0] == 200
+                    with urllib.request.urlopen(
+                            base + "/output_endpoint/q4?format=json",
+                            timeout=30) as r:
+                        assert int(r.headers["X-Dbsp-Epoch"]) >= 1
+
+            readers = [threading.Thread(target=storm,
+                                        name=f"reader-{i}")
+                       for i in range(3)]
+            for r in readers:
+                r.start()
+            # interleave more steps while the storm runs
+            for t in range(2, 4):
+                gen.feed(handles, t * 200, (t + 1) * 200)
+                ctl.note_pushed(200)
+                ctl.step()
+            for r in readers:
+                r.join(timeout=60)
+                assert not r.is_alive()
+        finally:
+            srv.stop()
+
+        handler = probe.by_handler_threads()
+        touched = {l for _, l in handler}
+        assert not touched & {"Controller._step_lock",
+                              "Controller._pushed_lock"}, \
+            f"read route took a serving-plane lock: {sorted(handler)}"
+        # non-vacuity, twice over: the probe saw the step path from
+        # MainThread, and it saw the handler threads at all (metric locks)
+        assert ("MainThread", "Controller._step_lock") in probe.acquires
+        assert handler, "probe blind to handler threads"
+    assert report.violations == [], tsan.TsanViolations(report.violations)
+
+
+def test_kill_switch_restores_quiesced_reads(monkeypatch):
+    """DBSP_TPU_READPLANE=0 proven live: the same probe that saw zero
+    step-lock reads above must see /output_endpoint acquire the step
+    lock from a handler thread when the plane is off, /view must 503,
+    and the served payload must still be correct."""
+    from dbsp_tpu.testing import tsan
+
+    monkeypatch.setenv("DBSP_TPU_READPLANE", "0")
+    probe = _LockProbe()
+    with tsan.session(schedule=probe) as report:
+        ctl, handles, srv = _served_pipeline()
+        assert not ctl.read_plane.enabled
+        base = f"http://127.0.0.1:{srv.port}"
+        gen = NexmarkGenerator(GeneratorConfig(seed=3))
+        try:
+            gen.feed(handles, 0, 200)
+            ctl.note_pushed(200)
+            ctl.step()
+            with urllib.request.urlopen(
+                    base + "/output_endpoint/q4?format=json",
+                    timeout=30) as r:
+                assert int(r.headers["X-Dbsp-Step"]) >= 1
+                assert "X-Dbsp-Epoch" not in r.headers
+                assert r.read()  # quiesced read still serves the delta
+            code, body = _get(base, "/view/q4")
+            raise AssertionError(f"expected 503, got {code}: {body}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        finally:
+            srv.stop()
+        handler = probe.by_handler_threads()
+        assert ("Controller._step_lock" in {l for _, l in handler}), \
+            "off-mode /output_endpoint did not quiesce — probe vacuous"
+    assert report.violations == [], tsan.TsanViolations(report.violations)
+
+
+def test_read_routes_value_set():
+    """The metric label's closed value set tracks the API surface."""
+    assert set(READ_ROUTES) == {"view_point", "view_range", "view_scan",
+                                "output", "changefeed", "replica_fanout"}

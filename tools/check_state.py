@@ -49,6 +49,9 @@ CHECKED_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("dbsp_tpu/io/controller.py", "Controller"),
     ("dbsp_tpu/io/controller.py", "_InputEndpoint"),
     ("dbsp_tpu/io/controller.py", "_OutputEndpoint"),
+    ("dbsp_tpu/serving.py", "ReadPlane"),
+    ("dbsp_tpu/serving.py", "_ViewState"),
+    ("dbsp_tpu/serving.py", "ReplicaServer"),
 )
 
 DISPOSITIONS = ("persisted", "derived", "config", "runtime")

@@ -912,6 +912,198 @@ def run_timeline_dryrun() -> list:
     return violations
 
 
+def _readpath_dryrun_child() -> None:
+    """Subprocess body for the readpath front: a served host-engine q4
+    pipeline under a tsan lock probe.  Reader threads storm ``/view``
+    (point, range, scan) and ``/output_endpoint`` while MainThread
+    drives steps AND keeps a changefeed cursor paced over HTTP; prints
+    one JSON line with the handler threads' traced lock set, the
+    MainThread step-lock sighting, the delivered changefeed epochs and
+    the view's final published epoch."""
+    import json
+    import threading
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.io.server import CircuitServer
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+    from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.obs import PipelineObs
+    from dbsp_tpu.testing import tsan
+
+    class Probe:
+        """Records (thread name, lock name) for every traced acquire."""
+
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.acquires = []
+
+        def yield_point(self, hook, lock_name):
+            if hook == "acquire":
+                with self.lock:
+                    self.acquires.append(
+                        (threading.current_thread().name, lock_name))
+
+    probe = Probe()
+    feed_epochs, reads = [], {"n": 0}
+    with tsan.session(schedule=probe) as report:
+        def build(c):
+            streams, handles = build_inputs(c)
+            return handles, queries.q4(*streams).output()
+
+        handle, (handles, out) = Runtime.init_circuit(1, build)
+        catalog = Catalog()
+        for name, h, key, vals in (("persons", handles[0], M.PERSON_KEY,
+                                    M.PERSON_VALS),
+                                   ("auctions", handles[1], M.AUCTION_KEY,
+                                    M.AUCTION_VALS),
+                                   ("bids", handles[2], M.BID_KEY,
+                                    M.BID_VALS)):
+            catalog.register_input(name, h, key + vals)
+        catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+        ctl = Controller(handle, catalog, ControllerConfig(
+            min_batch_records=10**9, flush_interval_s=3600.0))
+        # obs wiring binds the read metrics: their per-increment Metric
+        # lock is what makes handler threads visible to the probe (the
+        # read path itself acquires no serving-plane lock at all)
+        obs = PipelineObs(name="lint-readpath")
+        obs.attach_circuit(handle.circuit)
+        obs.attach_controller(ctl)
+        srv = CircuitServer(ctl, obs=obs)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        gen = NexmarkGenerator(GeneratorConfig(seed=11))
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=30) as r:
+                body = r.read() or b"{}"
+            reads["n"] += 1
+            return json.loads(body)
+
+        def storm():
+            for _ in range(5):
+                get("/view/q4?key=1")
+                get("/view/q4?lo=0&hi=50")
+                get("/view/q4")
+                get("/output_endpoint/q4?format=json")
+
+        try:
+            for t in range(2):
+                gen.feed(handles, t * 150, (t + 1) * 150)
+                ctl.note_pushed(150)
+                ctl.step()
+            readers = [threading.Thread(target=storm, name=f"reader-{i}")
+                       for i in range(2)]
+            for r in readers:
+                r.start()
+            cursor = 0
+            for t in range(2, 5):
+                gen.feed(handles, t * 150, (t + 1) * 150)
+                ctl.note_pushed(150)
+                ctl.step()
+                # the subscriber keeps pace over HTTP: every published
+                # interval must arrive exactly once, cursor-ordered
+                for rec in get(f"/changefeed?view=q4&after={cursor}"
+                               )["records"]:
+                    feed_epochs.append(rec["epoch"])
+                    cursor = rec["epoch"]
+            for r in readers:
+                r.join(timeout=60)
+            final_epoch = ctl.read_plane.snapshot("q4").epoch
+        finally:
+            srv.stop()
+
+    handler = sorted({(t, l) for t, l in probe.acquires
+                      if t != "MainThread"})
+    print(json.dumps({
+        "handler_locks": [list(x) for x in handler],
+        "handler_lock_names": sorted({l for _, l in handler}),
+        "main_step_lock": ("MainThread", "Controller._step_lock")
+                          in probe.acquires,
+        "feed_epochs": feed_epochs,
+        "final_epoch": final_epoch,
+        "reads": reads["n"],
+        "tsan_violations": [str(v) for v in report.violations],
+    }))
+
+
+def run_readpath_dryrun() -> list:
+    """8. **Read-path front** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_READPATH=0`` skips — tests/test_readpath.py carries
+    the import-based tier-1 coverage): a served q4 dryrun under a tsan
+    lock probe MUST show (a) the HTTP read routes (``/view``,
+    ``/changefeed``, ``/output_endpoint``) never acquiring the
+    controller's step or push locks while MainThread demonstrably does
+    (the probe is live, not vacuous), and (b) a paced changefeed
+    subscriber receiving every published interval exactly once, in
+    cursor order, ending at the view's final published epoch."""
+    import json
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_READPATH", "1") == "0":
+        print("lint_all: readpath_dryrun: skipped "
+              "(DBSP_TPU_LINT_READPATH=0)")
+        return []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "from tools.lint_all import _readpath_dryrun_child; "
+             "_readpath_dryrun_child()"],
+            cwd=_ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        return ["readpath dryrun timed out after 600s"]
+    if p.returncode != 0:
+        return [f"readpath dryrun failed:\n{p.stdout[-800:]}\n"
+                f"{p.stderr[-800:]}"]
+    try:
+        out = json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return [f"readpath dryrun emitted no JSON:\n{p.stdout[-400:]}"]
+
+    violations = []
+    taken = set(out.get("handler_lock_names", []))
+    if taken & {"Controller._step_lock", "Controller._pushed_lock"}:
+        violations.append(
+            f"read storm acquired a serving-plane lock from an HTTP "
+            f"handler thread ({json.dumps(out['handler_locks'])}) — the "
+            "read plane is NOT lock-free against the step path")
+    if not out.get("main_step_lock"):
+        violations.append(
+            "probe never saw MainThread take Controller._step_lock — "
+            "the lock probe is blind to the step path and the zero-"
+            "step-lock claim above is vacuous")
+    if not out.get("handler_locks"):
+        violations.append(
+            f"probe recorded no handler-thread lock acquisitions at all "
+            f"(reads={out.get('reads')}) — handler threads are invisible "
+            "to the probe and the zero-step-lock claim is vacuous")
+    eps = out.get("feed_epochs", [])
+    if len(eps) < 3 or eps != sorted(set(eps)):
+        violations.append(
+            f"changefeed delivery is not exactly-once in order "
+            f"({eps}) — a resumed cursor would replay or gap")
+    elif eps[-1] != out.get("final_epoch"):
+        violations.append(
+            f"changefeed cursor ended at epoch {eps[-1]} but the view's "
+            f"final published epoch is {out.get('final_epoch')} — a "
+            "published interval was never delivered")
+    if out.get("tsan_violations"):
+        violations.append(
+            f"tsan flagged the read storm: {out['tsan_violations']}")
+    return violations
+
+
 #: the pure-static fronts (``--static``): AST/file passes only — no
 #: subprocess dryruns, no circuit builds, no jax compilation
 STATIC_FRONTS = (("check_metrics", run_check_metrics),
@@ -943,7 +1135,8 @@ def main(argv=None) -> int:
                   ("residency", run_residency_dryrun),
                   ("profile_dryrun", run_profile_dryrun),
                   ("lineage_dryrun", run_lineage_dryrun),
-                  ("timeline_dryrun", run_timeline_dryrun)]
+                  ("timeline_dryrun", run_timeline_dryrun),
+                  ("readpath_dryrun", run_readpath_dryrun)]
     failed = 0
     for name, fn in fronts:
         violations = fn()
