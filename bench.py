@@ -888,8 +888,11 @@ def _run_read_load(platform: str, detail: dict) -> float:
     WHILE ingest ticks run. Fills ``detail["readpath"]`` with read QPS,
     read p50/p99 latency, a staleness histogram (published-snapshot step
     lag observed by readers, in validation intervals) and the plane's
-    epoch swap count; the returned metric value stays ingest events/s so
-    the headline is comparable to the plain runs."""
+    epoch swap count, plus ``detail["e2e"]`` with per-stage delta-age
+    percentiles from ``dbsp_tpu_e2e_stage_seconds`` (queue_wait / tick /
+    publish / serve here — transport/apply need a replica); the returned
+    metric value stays ingest events/s so the headline is comparable to
+    the plain runs."""
     import threading
     import urllib.request
 
@@ -902,6 +905,7 @@ def _run_read_load(platform: str, detail: dict) -> float:
     from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
                                   build_inputs, queries)
     from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.obs import PipelineObs
 
     _, batch, qname, warm_ticks = _knobs(platform)
     query = getattr(queries, qname)
@@ -932,7 +936,13 @@ def _run_read_load(platform: str, detail: dict) -> float:
     if not plane.enabled:
         raise RuntimeError("--read-load needs the read plane "
                            "(DBSP_TPU_READPLANE=0 is set)")
-    srv = CircuitServer(ctl)
+    # the deployed serving plane carries PipelineObs, so the read-load
+    # protocol does too: this binds the e2e stage histogram the
+    # detail["e2e"] section below reads (tracing itself stays governed
+    # by DBSP_TPU_TRACE_E2E)
+    obs = PipelineObs(name="bench-readload")
+    obs.attach_controller(ctl)
+    srv = CircuitServer(ctl, obs=obs)
     srv.start()
     base = f"http://127.0.0.1:{srv.port}"
     gen = NexmarkGenerator(GeneratorConfig(seed=1))
@@ -1015,6 +1025,26 @@ def _run_read_load(platform: str, detail: dict) -> float:
                                 for k in sorted(lag_hist)},
         "epoch_swaps": stats["publishes"],
         "epoch": stats["epoch"],
+    }
+    # end-to-end delta-age decomposition: per-stage latency percentiles
+    # from dbsp_tpu_e2e_stage_seconds (the replica-side transport/apply
+    # stages stay absent here — this protocol runs no replica)
+    from dbsp_tpu.obs.tracing import E2E_STAGES
+
+    hist = obs.registry.get("dbsp_tpu_e2e_stage_seconds")
+    by_stage = {}
+    for key, child in (hist.samples() if hist is not None else ()):
+        stage = key[0] if key else "?"
+        if child.count:
+            by_stage[stage] = {
+                "count": child.count,
+                "p50_ms": round(hist.quantile_of(child, 0.5) * 1e3, 3),
+                "p99_ms": round(hist.quantile_of(child, 0.99) * 1e3, 3),
+            }
+    detail["e2e"] = {
+        "enabled": bool(ctl.e2e is not None and ctl.e2e.enabled),
+        "stages": {s: by_stage[s] for s in E2E_STAGES if s in by_stage},
+        "tracer": ctl.e2e.stats() if ctl.e2e is not None else None,
     }
     return eps
 

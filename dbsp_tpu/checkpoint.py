@@ -208,6 +208,8 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "read_plane": "persisted",  # per-view merged state rides the
                                     # "read_plane" payload; epoch in the
                                     # manifest ("read_epoch")
+        "e2e": "runtime",   # delta-trace contexts die with the process:
+                            # a restored pipeline mints fresh trace ids
     },
     "_InputEndpoint": {
         "total_records": "persisted",   # consumed high-water mark: the
@@ -287,6 +289,10 @@ STATE_SCHEMA: Dict[str, Dict[str, str]] = {
         "port": "runtime",
         "_serve_thread": "runtime",
         "_feed_thread": "runtime",
+        "e2e": "runtime",     # shared tracer wiring (writer-owned)
+        "spans": "runtime",   # this process's span ring — trace surface
+        "_trace": "derived",  # per-view applied trace annotations: the
+                              # changefeed fold re-derives them
     },
 }
 

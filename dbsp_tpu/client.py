@@ -38,8 +38,11 @@ def _lineage_qs(view: str, key) -> str:
 
 
 def _req(url: str, data: Optional[bytes] = None, method: str = "GET",
-         timeout: Optional[float] = None):
+         timeout: Optional[float] = None,
+         headers: Optional[dict] = None):
     req = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     try:
         with urllib.request.urlopen(
                 req, timeout=timeout or default_timeout_s()) as r:
@@ -58,6 +61,8 @@ class PipelineHandle:
 
     def __init__(self, host: str, port: int):
         self.base = f"http://{host}:{port}"
+        # e2e trace id of the most recent push() (None when tracing is off)
+        self.last_trace: Optional[str] = None
 
     def status(self) -> dict:
         return _req(self.base + "/status")
@@ -180,12 +185,18 @@ class PipelineHandle:
         compiled ones. :meth:`profile` is the unified replacement."""
         return _req(self.base + "/dump_profile")
 
-    def push(self, collection: str, rows: List[list], deletes: bool = False
-             ) -> int:
+    def push(self, collection: str, rows: List[list], deletes: bool = False,
+             trace: Optional[str] = None) -> int:
+        """Push a batch. Pass ``trace`` to adopt a caller-minted e2e trace
+        id (sent as ``X-Dbsp-Trace``); the id the server actually used —
+        minted when none was supplied — lands in :attr:`last_trace` and can
+        later be matched against ``/view`` responses' ``trace.ids``."""
         env = "delete" if deletes else "insert"
         body = "\n".join(json.dumps({env: list(r)}) for r in rows).encode()
         out = _req(f"{self.base}/input_endpoint/{collection}?format=json",
-                   data=body, method="POST")
+                   data=body, method="POST",
+                   headers={"X-Dbsp-Trace": trace} if trace else None)
+        self.last_trace = out.get("trace")
         return out["records"]
 
     def step(self) -> None:
@@ -395,6 +406,13 @@ class Connection:
         semantics as :meth:`PipelineHandle.explain_spike`)."""
         q = f"?n={n}" if n is not None else ""
         return _req(f"{self.base}/pipelines/{name}/spikes{q}")
+
+    def fleet_trace(self) -> dict:
+        """One merged Chrome-trace JSON for the whole fleet (GET
+        /fleet/trace): every pipeline's span ring plus every replica's,
+        each on its own real pid/tid lane — load the result straight into
+        Perfetto to see a cross-process delta journey end to end."""
+        return _req(self.base + "/fleet/trace")
 
     def checkpoint_pipeline(self, name: str) -> dict:
         """Manager-side checkpoint trigger: POST

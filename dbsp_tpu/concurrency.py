@@ -95,6 +95,8 @@ CONCURRENCY_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("dbsp_tpu/obs/flight.py", "ControllerFlightSource"),
     ("dbsp_tpu/obs/flight.py", "HostFlightSource"),
     ("dbsp_tpu/obs/timeline.py", "Timeline"),
+    ("dbsp_tpu/obs/tracing.py", "SpanRecorder"),
+    ("dbsp_tpu/obs/tracing.py", "E2ETracer"),
     ("dbsp_tpu/obs/slo.py", "SLOConfig"),
     ("dbsp_tpu/obs/slo.py", "SLOWatchdog"),
     ("dbsp_tpu/obs/registry.py", "MetricsRegistry"),
@@ -167,6 +169,7 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
                     "afterwards (note_* calls go through the timeline's "
                     "own lock)",
         "read_plane": "immutable",
+        "e2e": "immutable",
     },
     "_InputEndpoint": {
         "name": "immutable",
@@ -358,6 +361,43 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "_stale_gauge": "immutable",
         "_spike_counter": "immutable",
     },
+    "SpanRecorder": {
+        "process": "immutable",
+        "pid": "immutable",
+        "_lock": "immutable",
+        "_steps": "lock(_lock)",
+        "_open": "lock(_lock)",
+        "_depth": "lock(_lock)",
+        "_threads": "lock(_lock)",
+        "dropped_steps": "writelock(_lock)",
+        "_dropped_counter": "gil-atomic: wired once by bind() during obs "
+                            "attach, before any traffic; read-only "
+                            "afterwards",
+        "_pipeline": "gil-atomic: wired once by bind() during obs attach, "
+                     "before any traffic; read-only afterwards",
+    },
+    "E2ETracer": {
+        "enabled": "gil-atomic: boolean kill-switch latch resolved at "
+                   "construction from DBSP_TPU_TRACE_E2E and toggled only "
+                   "by A/B harnesses between blocks; a racy read costs at "
+                   "most one stray sample",
+        "max_pending": "immutable",
+        "max_epochs": "immutable",
+        "_lock": "immutable",
+        "_seq": "lock(_lock)",
+        "_pending": "lock(_lock)",
+        "_in_tick": "lock(_lock)",
+        "_awaiting": "lock(_lock)",
+        "_tick_t0": "lock(_lock)",
+        "_by_epoch": "lock(_lock)",
+        "dropped": "writelock(_lock)",
+        "_hist": "gil-atomic: wired once by bind() during obs attach, "
+                 "before any traffic; read-only afterwards",
+        "_spans": "gil-atomic: wired once by bind() during obs attach, "
+                  "before any traffic; read-only afterwards",
+        "_timeline": "gil-atomic: wired once by bind() during obs attach, "
+                     "before any traffic; read-only afterwards",
+    },
     "CompiledFlightSource": {
         "ch": "immutable",
         "flight": "immutable",
@@ -503,6 +543,9 @@ CONCURRENCY_SCHEMA: Dict[str, Dict[str, str]] = {
         "port": "immutable",
         "_serve_thread": "immutable",
         "_feed_thread": "immutable",
+        "e2e": "immutable",
+        "spans": "immutable",
+        "_trace": "writelock(_lock)",
     },
     "Counter": {},
     "Gauge": {},

@@ -222,6 +222,12 @@ class Controller:
         self.read_plane = ReadPlane()
         for vname, vcol in self.catalog.outputs.items():
             self.read_plane.add_view(vname, vcol.handle)
+        # fleet-wide delta tracing (obs/tracing.py): every ingested batch
+        # gets a trace context that flows push -> tick -> publish ->
+        # changefeed -> replica -> read; DBSP_TPU_TRACE_E2E=0 disables.
+        from dbsp_tpu.obs.tracing import E2ETracer
+
+        self.e2e = E2ETracer()
         _tsan_hook(self)
 
     # -- endpoint wiring ----------------------------------------------------
@@ -276,23 +282,31 @@ class Controller:
         self.note_pushed(n)
         return n
 
-    def _note_arrival(self, n: int) -> None:
+    def _note_arrival(self, n: int,
+                      trace_id: Optional[str] = None) -> Optional[str]:
         """Freshness: stamp the wall-time a batch of rows reached this
         controller (push sites and transport chunk callbacks both land
         here). Visibility is stamped when the batch's results publish —
-        the gap is the freshness sample."""
+        the gap is the freshness sample. Also mints (or adopts, when the
+        pusher sent ``X-Dbsp-Trace``) the batch's e2e trace context;
+        returns its id."""
         tl = self.timeline
         if n and tl is not None:
             tl.note_arrival(n)
+        if n:
+            return self.e2e.note_ingest(n, trace_id=trace_id)
+        return None
 
-    def note_pushed(self, n: int) -> None:
+    def note_pushed(self, n: int,
+                    trace_id: Optional[str] = None) -> Optional[str]:
         """Record host-pushed rows (HTTP endpoints / client API) so the
         circuit loop's batching sees them alongside transport buffers —
-        without this, pushed rows waited for an explicit /step."""
+        without this, pushed rows waited for an explicit /step. Returns
+        the batch's e2e trace id (None when tracing is off)."""
         with self._pushed_lock:
             self._pushed += int(n)
             self.total_pushed += int(n)
-        self._note_arrival(n)
+        return self._note_arrival(n, trace_id=trace_id)
 
     # -- durability (dbsp_tpu.checkpoint) -----------------------------------
     def _controller_state(self) -> dict:
@@ -495,7 +509,7 @@ class Controller:
             self._emit_outputs()
             # snapshot publication rides every validation publish (cheap
             # no-op when no output's step_id advanced)
-            self.read_plane.publish()
+            self.read_plane.publish(tracer=self.e2e)
             tl = self.timeline
             if was_open and tl is not None:
                 # a deferred-validation interval just closed: its buffered
@@ -581,6 +595,10 @@ class Controller:
 
     def _step_locked(self) -> None:  # holds: _step_lock
         t0 = time.perf_counter_ns()
+        # queue_wait ends for every batch stamped so far: contexts noted
+        # BEFORE this point have their rows in the buffers drained below
+        # (push sites append rows before stamping the context)
+        self.e2e.tick_begin()
         with self._pushed_lock:
             rows_in = self._pushed
             self._pushed = 0  # this step consumes all pushed rows
@@ -592,12 +610,13 @@ class Controller:
         self.handle.step()
         self.steps += 1
         rows_out = self._emit_outputs()
+        trace_ids = self.e2e.tick_end()
         if not getattr(self.handle, "interval_open", False):
             # validation publish: swap in immutable read-plane snapshots
             # (host engine: every step; compiled: when the deferred-
             # validation interval closed this tick). BEFORE the periodic
             # checkpoint so a checkpoint captures this tick's publication.
-            self.read_plane.publish()
+            self.read_plane.publish(tracer=self.e2e)
         self._maybe_checkpoint_locked()
         self._run_monitors()
         # the tick record is stamped LAST so checkpoint writes and in-tick
@@ -608,7 +627,8 @@ class Controller:
             tl.note_tick(self.steps, time.perf_counter_ns() - t0,
                          rows_in=rows_in, rows_out=rows_out,
                          queue_depth=sum(ep.buffered()
-                                         for ep in self.inputs.values()))
+                                         for ep in self.inputs.values()),
+                         trace_ids=trace_ids)
             if not getattr(self.handle, "interval_open", False):
                 # this step's results validated and published (host engine:
                 # every step; compiled: when no deferred interval remains)
@@ -674,6 +694,7 @@ class Controller:
             "last_checkpoint_tick": self.last_checkpoint_tick,
             "checkpoint_error": self.checkpoint_error,
             "read_plane": self.read_plane.stats(),
+            "e2e": self.e2e.stats(),
             "inputs": {
                 name: {
                     "total_records": ep.total_records,

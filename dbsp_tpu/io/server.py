@@ -57,12 +57,14 @@ class CircuitServer:
                 pass
 
             def _reply(self, code: int, body: bytes,
-                       ctype="application/json"):
+                       ctype="application/json", headers=None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 # the manager's console (another port) fetches these routes
                 self.send_header("Access-Control-Allow-Origin", "*")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -75,8 +77,9 @@ class CircuitServer:
                                  "Content-Type")
                 self.end_headers()
 
-            def _json(self, obj, code=200):
-                self._reply(code, json.dumps(obj).encode())
+            def _json(self, obj, code=200, headers=None):
+                self._reply(code, json.dumps(obj).encode(),
+                            headers=headers)
 
             def do_GET(self):
                 url = urlparse(self.path)
@@ -241,7 +244,14 @@ class CircuitServer:
                         "view_point" if key is not None else
                         "view_range" if (lo is not None or hi is not None)
                         else "view_scan", t0)
-                    self._json(obj)
+                    # e2e attribution: age_s + per-stage breakdown of the
+                    # served epoch's delta path, and the trace ids echoed
+                    # as a response header for cross-process correlation
+                    c.e2e.annotate_read(obj, t0)
+                    ids = (obj.get("trace") or {}).get("ids") or ()
+                    self._json(obj, headers={"X-Dbsp-Trace":
+                                             ",".join(ids)} if ids
+                               else None)
                 elif route == "/changefeed":
                     # changefeed read with a resume-from-epoch cursor:
                     # ?view=<name>&after=<epoch>[&timeout=<s>][&limit=N].
@@ -372,9 +382,18 @@ class CircuitServer:
                     col.push_rows(rows)
                     # HTTP pushes must wake the circuit loop like transport
                     # rows do — found by the console JS-path test: pushed
-                    # rows sat unstepped until an explicit /step
-                    c.note_pushed(len(rows))
-                    self._json({"records": len(rows)})
+                    # rows sat unstepped until an explicit /step.
+                    # An X-Dbsp-Trace request header is adopted as the
+                    # batch's e2e trace id (cross-process propagation);
+                    # otherwise one is minted — either way it is echoed.
+                    trace_id = c.note_pushed(
+                        len(rows),
+                        trace_id=self.headers.get("X-Dbsp-Trace") or None)
+                    resp = {"records": len(rows)}
+                    if trace_id is not None:
+                        resp["trace"] = trace_id
+                    self._json(resp, headers={"X-Dbsp-Trace": trace_id}
+                               if trace_id else None)
                 else:
                     self._json({"error": f"no route {route}"}, 404)
 
@@ -462,6 +481,11 @@ class CircuitServer:
             out["slo"] = out["status"].get("slo")
             out["incidents"] = self.obs.slo.incidents(with_window=False)
             out["flight"] = self.obs.flight.to_dict(limit=64)
+            # span-ring drop accounting: a truncated /trace window must
+            # announce itself in the bug-report bundle
+            dropped = self.obs.spans.dropped_steps
+            out["trace"] = {"dropped_steps": dropped,
+                            "truncated": dropped > 0}
         return out
 
     def profile_report(self, ticks=None) -> dict:

@@ -277,7 +277,8 @@ class Pipeline:
         base = len(self.replicas)
         for i in range(int(n)):
             r = ReplicaServer(f"http://127.0.0.1:{self.port}", views,
-                              name=f"{self.name}-r{base + i}")
+                              name=f"{self.name}-r{base + i}",
+                              e2e=self.controller.e2e)
             r.start()
             self.replicas.append(r)
             started.append(r.status())
@@ -611,6 +612,22 @@ class PipelineManager:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.rstrip("/") == "/fleet/trace":
+                    # one Perfetto-loadable fleet trace: every deployed
+                    # pipeline's span ring plus every replica's, merged on
+                    # their real pid lanes (per-process M metadata names
+                    # the lanes; e2e spans correlate via trace ids)
+                    from dbsp_tpu.obs.tracing import merge_chrome_traces
+
+                    with mgr.lock:
+                        pipes = list(mgr.pipelines.values())
+                    traces = []
+                    for p in pipes:
+                        if p.obs is not None:
+                            traces.append(p.obs.spans.to_chrome_trace())
+                        for r in list(p.replicas):
+                            traces.append(r.spans.to_chrome_trace())
+                    self._json(merge_chrome_traces(traces))
                 elif self.path.rstrip("/") == "/health":
                     self._json(mgr.fleet_health())
                 elif self.path.rstrip("/") == "/programs":

@@ -11,7 +11,12 @@ the pipeline-manager's per-pipeline stats, ``dbsp_handle.rs:256-268``):
   place in the tree that formats Prometheus text (tools/check_metrics.py
   enforces this);
 * :mod:`dbsp_tpu.obs.tracing` — a bounded-window span recorder emitting
-  Chrome-trace-format JSON (load the export in Perfetto / chrome://tracing);
+  Chrome-trace-format JSON with real pid/tid lanes (load the export in
+  Perfetto / chrome://tracing), plus the fleet-wide end-to-end delta
+  tracer (:class:`E2ETracer`): per-batch trace contexts flowing
+  ingest→tick→publish→changefeed→replica→read, stage-attributed into
+  ``dbsp_tpu_e2e_stage_seconds{stage}`` and merged across processes by
+  ``merge_chrome_traces`` (manager ``GET /fleet/trace``);
 * :mod:`dbsp_tpu.obs.instrument` — hooks subscribing to the circuit's
   ``SchedulerEvent`` stream (host path) or polling a compiled driver
   (compiled path), publishing per-operator eval histograms, step latency,
@@ -43,13 +48,15 @@ from dbsp_tpu.obs.registry import (Counter, Gauge, Histogram,
                                    validate_metric_name)
 from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
 from dbsp_tpu.obs.timeline import SPIKE_CAUSES, Timeline
-from dbsp_tpu.obs.tracing import SpanRecorder
+from dbsp_tpu.obs.tracing import (E2E_STAGES, E2ETracer, SpanRecorder,
+                                  merge_chrome_traces, trace_e2e_enabled)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Summary",
     "MetricNameError", "validate_metric_name",
     "prometheus_text", "prometheus_text_many", "legacy_controller_lines",
     "SpanRecorder", "FlightRecorder", "SLOConfig", "SLOWatchdog",
+    "E2ETracer", "E2E_STAGES", "trace_e2e_enabled", "merge_chrome_traces",
     "Timeline", "SPIKE_CAUSES",
     "CircuitInstrumentation", "CompiledInstrumentation",
     "ControllerInstrumentation", "PipelineObs",

@@ -549,7 +549,8 @@ class PipelineObs:
     collector and as a controller monitor, so SLO state is fresh on both
     paths without a dedicated thread."""
 
-    def __init__(self, name: str = "", max_trace_steps: int = 64,
+    def __init__(self, name: str = "",
+                 max_trace_steps: Optional[int] = None,
                  flight_capacity: int = 2048, slo=None):
         from dbsp_tpu.obs.flight import FlightRecorder
         from dbsp_tpu.obs.slo import SLOConfig, SLOWatchdog
@@ -557,7 +558,15 @@ class PipelineObs:
 
         self.name = name
         self.registry = MetricsRegistry()
-        self.spans = SpanRecorder(max_steps=max_trace_steps)
+        # span-ring window: DBSP_TPU_TRACE_STEPS tunes the retained
+        # top-level span count (the /trace window); evictions export as
+        # dbsp_tpu_obs_trace_dropped_total{pipeline} via bind()
+        if max_trace_steps is None:
+            max_trace_steps = int(os.environ.get("DBSP_TPU_TRACE_STEPS",
+                                                 "64"))
+        self.spans = SpanRecorder(max_steps=max_trace_steps,
+                                  process=name or "dbsp_tpu")
+        self.spans.bind(self.registry, pipeline=name)
         self.flight = FlightRecorder(capacity=flight_capacity)
         self.slo = SLOWatchdog(self.flight, SLOConfig.from_dict(slo),
                                registry=self.registry, pipeline=name)
@@ -616,6 +625,14 @@ class PipelineObs:
         plane = getattr(controller, "read_plane", None)
         if plane is not None:
             plane.bind(registry=self.registry, flight=self.flight)
+        # fleet-wide delta tracing (obs/tracing.py): the controller's
+        # E2ETracer exports dbsp_tpu_e2e_stage_seconds{stage}, records
+        # per-stage spans into this pipeline's ring, and feeds the
+        # timeline's e2e_stage stream (EXPLAIN SPIKE stage attribution)
+        e2e = getattr(controller, "e2e", None)
+        if e2e is not None:
+            e2e.bind(registry=self.registry, spans=self.spans,
+                     timeline=self.timeline)
         self._flight_sources.append(
             ControllerFlightSource(controller, self.flight))
         return ControllerInstrumentation(controller, self.registry)

@@ -78,6 +78,11 @@ ALLOWED_LABEL_NAMES = frozenset((
     # deployment's replica topology, fixed at orchestration time like
     # "pipeline"/"worker"
     "route", "replica",
+    # end-to-end delta tracing (obs/tracing.py): "stage" is one hop of
+    # the ingest→tick→publish→changefeed→replica→read path — the closed
+    # set obs.tracing.E2E_STAGES (queue_wait, tick, publish, transport,
+    # apply, serve)
+    "stage",
 ))
 
 

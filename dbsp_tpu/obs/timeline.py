@@ -93,6 +93,14 @@ _SPIKE_FLOOR_NS = float(os.environ.get("DBSP_TPU_SPIKE_FLOOR_MS", "10")) * 1e6
 _MIN_BASELINE = 8      # never flag before the baseline has this many ticks
 _BASELINE_WINDOW = 64  # trailing window the median/MAD roll over
 
+# e2e stage spikes (obs/tracing.py feeds per-stage `e2e_stage` records)
+# use the same robust detector but a much higher floor: stage timings mix
+# queue dwell and HTTP long-poll scheduling, so sub-100ms wiggle is normal
+# operation — only a genuine stall (seeded transport delay, stuck apply)
+# should ever flag, and the unperturbed control must flag nothing.
+_STAGE_SPIKE_FLOOR_NS = float(os.environ.get(
+    "DBSP_TPU_STAGE_SPIKE_FLOOR_MS", "250")) * 1e6
+
 #: freshness histogram bounds: 1ms .. ~2000s, x2 per bucket — staleness
 #: spans sub-tick (host engine, validate_every=1) to long deferred
 #: intervals and seeded stalls
@@ -175,10 +183,13 @@ class Timeline:
 
     def note_tick(self, tick: int, latency_ns: int, rows_in: int = 0,
                   rows_out: int = 0, causes: Sequence[str] = (),
-                  queue_depth: int = 0) -> None:
+                  queue_depth: int = 0,
+                  trace_ids: Sequence[str] = ()) -> None:
         """One controller-level tick: wall latency of everything inside
         the step lock (engine step + validate/maintain/snapshot +
-        checkpoint write + monitors)."""
+        checkpoint write + monitors). ``trace_ids`` links the tick to the
+        e2e trace contexts it drained, so a flagged spike names the
+        deltas it delayed."""
         if not self.enabled:
             return
         rec = {"kind": "tick", "src": "ctl", "ts": time.time(),
@@ -186,6 +197,23 @@ class Timeline:
                "latency_ns": int(latency_ns), "rows_in": int(rows_in),
                "rows_out": int(rows_out), "causes": list(causes),
                "queue_depth": int(queue_depth)}
+        if trace_ids:
+            rec["trace"] = list(trace_ids)
+        with self._lock:
+            self._append_locked(rec)
+
+    def note_e2e_stage(self, stage: str, seconds: float,
+                       trace_ids: Sequence[str] = ()) -> None:
+        """One measured stage of the end-to-end delta path (fed by
+        :class:`dbsp_tpu.obs.tracing.E2ETracer`): writer stages per
+        published epoch, replica stages per applied changefeed batch.
+        EXPLAIN SPIKE baselines these per stage, so a stalled hop is
+        named — with its trace ids — in ``stage_spikes``."""
+        if not self.enabled:
+            return
+        rec = {"kind": "e2e_stage", "src": "e2e", "ts": time.time(),
+               "t_ns": time.perf_counter_ns(), "stage": str(stage),
+               "seconds": float(seconds), "trace": list(trace_ids)}
         with self._lock:
             self._append_locked(rec)
 
@@ -393,10 +421,12 @@ class Timeline:
                         "tick": t.get("tick"), "ts": t["ts"],
                         "latency_ns": int(lat), "baseline_ns": int(med),
                         "mad_ns": int(mad), "threshold_ns": int(thr),
-                        "cause": cause, "evidence": evidence})
+                        "cause": cause, "trace": list(t.get("trace", ())),
+                        "evidence": evidence})
                     new_spike_seqs.append((t["seq"], cause))
                     continue  # a flagged outlier must not poison history
             history.append(lat)
+        stage_spikes = self._stage_spikes(recs)
         if self._spike_counter is not None and new_spike_seqs:
             with self._lock:
                 fresh = [(s, c) for s, c in new_spike_seqs
@@ -408,10 +438,48 @@ class Timeline:
         if limit is not None and len(spikes) > limit:
             spikes = spikes[-limit:]
         return {"spikes": spikes, "ticks_seen": len(ticks),
+                "stage_spikes": stage_spikes,
                 "baseline": {"min_samples": _MIN_BASELINE,
                              "window": _BASELINE_WINDOW,
                              "mult": _SPIKE_MULT,
-                             "floor_ns": int(_SPIKE_FLOOR_NS)}}
+                             "floor_ns": int(_SPIKE_FLOOR_NS),
+                             "stage_floor_ns": int(_STAGE_SPIKE_FLOOR_NS)}}
+
+    def _stage_spikes(self, recs: List[dict]) -> List[dict]:
+        """The e2e-stage detector: same robust median+MAD selection as
+        ticks, rolled independently per stage over the ``e2e_stage``
+        records, with the higher _STAGE_SPIKE_FLOOR_NS floor. Each spike
+        carries a human-readable evidence line that NAMES the slow stage
+        and the trace ids it delayed."""
+        stage_spikes: List[dict] = []
+        history: Dict[str, List[float]] = {}
+        for r in recs:
+            if r["kind"] != "e2e_stage":
+                continue
+            ns = float(r.get("seconds", 0.0)) * 1e9
+            hist = history.setdefault(r["stage"], [])
+            if len(hist) >= _MIN_BASELINE:
+                base = hist[-_BASELINE_WINDOW:]
+                med = _median(base)
+                mad = _median([abs(x - med) for x in base])
+                thr = max(_SPIKE_MULT * med,
+                          med + max(_SPIKE_MAD_K * mad,
+                                    _STAGE_SPIKE_FLOOR_NS))
+                if ns > thr:
+                    ids = list(r.get("trace", ()))
+                    stage_spikes.append({
+                        "stage": r["stage"], "ts": r["ts"],
+                        "seconds": float(r.get("seconds", 0.0)),
+                        "baseline_s": med / 1e9,
+                        "threshold_s": thr / 1e9,
+                        "trace": ids,
+                        "evidence": "e2e stage '%s' took %.3fs against a "
+                                    "%.3fs baseline (trace %s)" % (
+                                        r["stage"], ns / 1e9, med / 1e9,
+                                        ",".join(ids) or "-")})
+                    continue  # flagged outliers stay out of the baseline
+            hist.append(ns)
+        return stage_spikes
 
     # -- scrape-time collector ----------------------------------------------
 

@@ -1,6 +1,7 @@
-"""Span recorder: Chrome-trace-format JSON for a bounded window of steps.
+"""Span recorder + fleet-wide delta tracing: Chrome-trace JSON rings and
+the end-to-end stage attribution that rides the serving plane.
 
-Load the export in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+Load any export in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
 Spans nest step -> operator eval -> exchange on the host path (driven by
 :class:`~dbsp_tpu.obs.instrument.CircuitInstrumentation` from the
 scheduler-event stream) and tick -> compiled-step/validate/maintain on the
@@ -8,66 +9,172 @@ compiled path (driven by the compiled driver directly).
 
 Format: the JSON-object flavor of the Trace Event Format — ``B``/``E``
 duration events with microsecond timestamps, so nesting is explicit and a
-consumer (or test) can check balance. The window is bounded: only the most
-recent ``max_steps`` completed top-level spans are retained (a serving
-pipeline runs forever; the trace buffer must not).
+consumer (or test) can check balance. Events carry the real ``os.getpid()``
+and ``threading.get_native_id()`` so the serving plane's thread fan-out
+(HTTP handlers, circuit loop, replica feed loops) lands in distinct lanes,
+with ``M`` metadata events naming each process and thread. The window is
+bounded: only the most recent ``max_steps`` completed top-level spans are
+retained (a serving pipeline runs forever; the trace buffer must not);
+evictions are counted in ``dropped_steps`` and exported as
+``dbsp_tpu_obs_trace_dropped_total{pipeline}`` once :meth:`SpanRecorder.bind`
+has run.
+
+The second half of this module is the fleet-wide delta path. Every ingested
+batch gets a trace context (id + stage timestamps) that flows
+
+    push -> Controller._step_locked tick -> ReadPlane.publish
+         -> changefeed record -> ReplicaServer._apply -> read response
+
+so an end-to-end "delta age" decomposes exactly into the closed stage set
+:data:`E2E_STAGES`:
+
+``queue_wait``
+    ingest wall-time to the start of the tick that drained the batch.
+``tick``
+    the draining tick's wall-clock (step + output emission).
+``publish``
+    tick end to the validation publish that made the delta readable —
+    includes the deferred-validation dwell on the compiled path.
+``transport``
+    publish to changefeed receipt at a replica (HTTP long-poll hop).
+``apply``
+    the replica's fold of the changefeed records into its view state.
+``serve``
+    the read handler's own latency (snapshot/index lookup + encode).
+
+The writer-side stages use one wall-clock (``time.time``) timeline, so
+``queue_wait + tick + publish == publish_ts - ingest_ts`` exactly; replica
+stages extend the same timeline across the (same-host) process boundary.
+Stage latencies land in ``dbsp_tpu_e2e_stage_seconds{stage}``, in span
+rings (as ``e2e`` category spans carrying the trace ids), in the timeline
+(``e2e_stage`` records EXPLAIN SPIKE attributes outliers to), and on every
+``/view`` response as ``age_s`` + ``stages``. Kill switch:
+``DBSP_TPU_TRACE_E2E=0`` (default on, like the read plane's).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
-from collections import deque
-from typing import Deque, List, Optional
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+from dbsp_tpu.testing.tsan import maybe_instrument as _tsan_hook
+
+__all__ = [
+    "SpanRecorder", "E2ETracer", "E2E_STAGES", "trace_e2e_enabled",
+    "merge_chrome_traces",
+]
+
+#: closed stage taxonomy of the end-to-end delta path, in path order.
+#: ``dbsp_tpu_e2e_stage_seconds{stage}`` only ever carries these values.
+E2E_STAGES = ("queue_wait", "tick", "publish", "transport", "apply", "serve")
+
+#: trace ids carried per published epoch are capped (a firehose tick can
+#: drain thousands of batches; the annotation rides every feed record)
+_MAX_IDS_PER_EPOCH = 16
+
+
+def trace_e2e_enabled(env: Optional[dict] = None) -> bool:
+    """Kill switch for end-to-end delta tracing: ``DBSP_TPU_TRACE_E2E=0``
+    disables it (default on, mirroring ``readplane_enabled``)."""
+    env = os.environ if env is None else env
+    return str(env.get("DBSP_TPU_TRACE_E2E", "1")).lower() not in (
+        "0", "false", "no", "off")
 
 
 class SpanRecorder:
-    """Accumulates B/E span events; ring-buffered per top-level span."""
+    """Accumulates B/E span events; ring-buffered per top-level span.
 
-    def __init__(self, max_steps: int = 64, pid: str = "dbsp_tpu"):
-        self.pid = pid
+    Events are stamped with the recorder's process id and the *real* native
+    thread id of the caller, with per-thread open-span stacks so concurrent
+    serving-plane threads (circuit loop, HTTP handlers, replica feed loop)
+    nest correctly in their own lanes instead of interleaving into one.
+    """
+
+    def __init__(self, max_steps: int = 64, process: str = "dbsp_tpu"):
+        self.pid = os.getpid()
+        self.process = process
         self._steps: Deque[List[dict]] = deque(maxlen=max_steps)
-        self._open: List[dict] = []      # events of the in-flight step
-        self._depth = 0
+        self._open: Dict[int, List[dict]] = {}   # tid -> in-flight events
+        self._depth: Dict[int, int] = {}         # tid -> open-span depth
+        self._threads: Dict[int, str] = {}       # tid -> thread name
         self._lock = threading.Lock()
         self.dropped_steps = 0
+        self._dropped_counter = None  # wired once by bind()
+        self._pipeline = ""
+        _tsan_hook(self)
 
     # -- recording ----------------------------------------------------------
+    def _push_step_locked(self, events: List[dict]) -> None:  # holds: _lock
+        if len(self._steps) == self._steps.maxlen:
+            self.dropped_steps += 1
+        self._steps.append(events)
+
     def begin(self, name: str, cat: str = "operator",
-              ts_ns: Optional[int] = None) -> None:
+              ts_ns: Optional[int] = None, args: Optional[dict] = None) -> None:
         ts = (ts_ns if ts_ns else time.perf_counter_ns()) / 1e3
+        tid = threading.get_native_id()
+        ev = {"name": name, "cat": cat, "ph": "B",
+              "ts": ts, "pid": self.pid, "tid": tid}
+        if args:
+            ev["args"] = args
         with self._lock:
-            self._open.append({"name": name, "cat": cat, "ph": "B",
-                               "ts": ts, "pid": self.pid, "tid": 0})
-            self._depth += 1
+            if tid not in self._threads:
+                self._threads[tid] = threading.current_thread().name
+            self._open.setdefault(tid, []).append(ev)
+            self._depth[tid] = self._depth.get(tid, 0) + 1
 
     def end(self, name: str, ts_ns: Optional[int] = None) -> None:
         ts = (ts_ns if ts_ns else time.perf_counter_ns()) / 1e3
+        tid = threading.get_native_id()
         with self._lock:
-            if self._depth == 0:
+            depth = self._depth.get(tid, 0)
+            if depth == 0:
                 return  # unbalanced end (attached mid-step): drop
-            self._open.append({"name": name, "ph": "E", "ts": ts,
-                               "pid": self.pid, "tid": 0})
-            self._depth -= 1
-            if self._depth == 0:
-                if len(self._steps) == self._steps.maxlen:
-                    self.dropped_steps += 1
-                self._steps.append(self._open)
-                self._open = []
+            self._open[tid].append({"name": name, "ph": "E", "ts": ts,
+                                    "pid": self.pid, "tid": tid})
+            depth -= 1
+            self._depth[tid] = depth
+            if depth == 0:
+                self._push_step_locked(self._open.pop(tid))
 
     def instant(self, name: str, cat: str = "event",
-                ts_ns: Optional[int] = None) -> None:
+                ts_ns: Optional[int] = None,
+                args: Optional[dict] = None) -> None:
         """A zero-duration marker (overflow replays, re-traces, ...)."""
         ts = (ts_ns if ts_ns else time.perf_counter_ns()) / 1e3
+        tid = threading.get_native_id()
+        ev = {"name": name, "cat": cat, "ph": "i", "ts": ts,
+              "pid": self.pid, "tid": tid, "s": "t"}
+        if args:
+            ev["args"] = args
         with self._lock:
-            target = self._open if self._depth else None
-            ev = {"name": name, "cat": cat, "ph": "i", "ts": ts,
-                  "pid": self.pid, "tid": 0, "s": "t"}
-            if target is not None:
-                target.append(ev)
+            if tid not in self._threads:
+                self._threads[tid] = threading.current_thread().name
+            if self._depth.get(tid, 0):
+                self._open[tid].append(ev)
             else:
-                self._steps.append([ev])
+                self._push_step_locked([ev])
+
+    def span_at(self, name: str, t0_ns: int, t1_ns: int,
+                cat: str = "e2e", args: Optional[dict] = None) -> None:
+        """Append one already-completed span as a self-contained, balanced
+        ``[B, E]`` ring entry — the e2e stage spans use this, so a trace
+        snapshot taken mid-tick can never observe them half-open."""
+        tid = threading.get_native_id()
+        bev = {"name": name, "cat": cat, "ph": "B", "ts": t0_ns / 1e3,
+               "pid": self.pid, "tid": tid}
+        if args:
+            bev["args"] = args
+        eev = {"name": name, "ph": "E", "ts": max(t0_ns, t1_ns) / 1e3,
+               "pid": self.pid, "tid": tid}
+        with self._lock:
+            if tid not in self._threads:
+                self._threads[tid] = threading.current_thread().name
+            self._push_step_locked([bev, eev])
 
     class _Span:
         __slots__ = ("rec", "name", "cat")
@@ -88,13 +195,44 @@ class SpanRecorder:
         return SpanRecorder._Span(self, name, cat)
 
     # -- export -------------------------------------------------------------
+    def bind(self, registry=None, pipeline: str = "") -> None:
+        """Export drop accounting: mirrors ``dropped_steps`` into
+        ``dbsp_tpu_obs_trace_dropped_total{pipeline}`` at scrape time (the
+        flight recorder got exactly this in its PR; the span ring never
+        did). Idempotent; called once at obs attach, before traffic."""
+        if registry is None or self._dropped_counter is not None:
+            return
+        counter = registry.counter(
+            "dbsp_tpu_obs_trace_dropped_total",
+            "Completed top-level spans evicted from the bounded span ring "
+            "(/trace is truncated history once this grows)",
+            labels=("pipeline",))
+        self._pipeline = pipeline
+        self._dropped_counter = counter
+        registry.register_collector(self._export)
+
+    def _export(self) -> None:
+        self._dropped_counter.labels(pipeline=self._pipeline).set_total(
+            float(self.dropped_steps))
+
     def events(self) -> List[dict]:
         with self._lock:
             return [ev for step in self._steps for ev in step]
 
     def to_chrome_trace(self) -> dict:
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms",
-                "otherData": {"dropped_steps": self.dropped_steps}}
+        with self._lock:
+            evs = [ev for step in self._steps for ev in step]
+            threads = dict(self._threads)
+            dropped = self.dropped_steps
+        meta = [{"name": "process_name", "ph": "M", "pid": self.pid,
+                 "tid": 0, "args": {"name": self.process}}]
+        for tid in sorted(threads):
+            meta.append({"name": "thread_name", "ph": "M", "pid": self.pid,
+                         "tid": tid, "args": {"name": threads[tid]}})
+        return {"traceEvents": meta + evs, "displayTimeUnit": "ms",
+                "otherData": {"dropped_steps": dropped,
+                              "truncated": dropped > 0,
+                              "process": self.process, "pid": self.pid}}
 
     def to_json(self) -> str:
         return json.dumps(self.to_chrome_trace())
@@ -102,5 +240,289 @@ class SpanRecorder:
     def clear(self) -> None:
         with self._lock:
             self._steps.clear()
-            self._open = []
-            self._depth = 0
+            self._open = {}
+            self._depth = {}
+
+
+def merge_chrome_traces(traces: Sequence[dict]) -> dict:
+    """Merge per-process Chrome-trace exports into one Perfetto-loadable
+    fleet trace: concatenates ``traceEvents`` (each ring already carries
+    its own real pid lanes), dedups identical ``M`` metadata events, and
+    folds the per-ring drop accounting into ``otherData``."""
+    events: List[dict] = []
+    seen_meta = set()
+    processes: List[dict] = []
+    dropped = 0
+    for doc in traces:
+        if not doc:
+            continue
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") == "M":
+                key = (ev.get("name"), ev.get("pid"), ev.get("tid"),
+                       str(ev.get("args")))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            events.append(ev)
+        other = doc.get("otherData", {})
+        dropped += int(other.get("dropped_steps", 0) or 0)
+        if "process" in other:
+            processes.append({"process": other.get("process"),
+                              "pid": other.get("pid"),
+                              "dropped_steps": other.get("dropped_steps", 0)})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_steps": dropped,
+                          "truncated": dropped > 0,
+                          "processes": processes}}
+
+
+class E2ETracer:
+    """Per-process end-to-end delta tracker: batch trace contexts move
+    through three pools as the delta path advances —
+
+    ``_pending``  (note_ingest)   arrived, awaiting a tick
+    ``_in_tick``  (tick_begin)    drained into the in-flight tick
+    ``_awaiting`` (tick_end)      ticked, awaiting validation publish
+
+    — and are sealed per epoch by :meth:`note_publish` into ``_by_epoch``,
+    the bounded annotation map read routes and changefeed records resolve
+    stage breakdowns from. The annotation dict is JSON-safe and rides
+    ``rec["trace"]`` on every changefeed record, which is how the context
+    crosses to replicas (same-host wall clock makes the transport stage a
+    plain subtraction).
+
+    Everything mutable sits behind one leaf lock (``_lock``); the metric/
+    span/timeline side effects happen outside it via the two-phase
+    ``note_publish`` / ``flush_publish`` split so the read plane never
+    holds its own lock across an observation.
+    """
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_pending: int = 4096, max_epochs: int = 256):
+        self.enabled = trace_e2e_enabled() if enabled is None else bool(enabled)
+        self.max_pending = max_pending
+        self.max_epochs = max_epochs
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pending: List[dict] = []
+        self._in_tick: List[dict] = []
+        self._awaiting: List[dict] = []
+        self._tick_t0: Optional[float] = None
+        self._by_epoch: "OrderedDict[int, dict]" = OrderedDict()
+        self.dropped = 0
+        self._hist = None      # wired once by bind()
+        self._spans = None
+        self._timeline = None
+        _tsan_hook(self)
+
+    # -- wiring -------------------------------------------------------------
+    def bind(self, registry=None, spans=None, timeline=None) -> None:
+        """Wire export surfaces (idempotent for the registry; called once
+        at obs attach, before traffic)."""
+        if spans is not None:
+            self._spans = spans
+        if timeline is not None:
+            self._timeline = timeline
+        if registry is not None and self._hist is None:
+            from dbsp_tpu.obs.registry import default_latency_buckets
+            self._hist = registry.histogram(
+                "dbsp_tpu_e2e_stage_seconds",
+                "Per-stage latency of the end-to-end delta path "
+                "ingest->tick->publish->changefeed->replica->read (closed "
+                "stage set: obs.tracing.E2E_STAGES; writer stages sampled "
+                "once per published epoch, replica stages once per applied "
+                "changefeed batch, serve once per read)",
+                labels=("stage",), buckets=default_latency_buckets())
+
+    # -- writer-side path ---------------------------------------------------
+    def note_ingest(self, rows: int, ts: Optional[float] = None,
+                    trace_id: Optional[str] = None) -> Optional[str]:
+        """Stamp one arrived batch; returns its trace id (caller-supplied
+        via the ``X-Dbsp-Trace`` header, or freshly minted)."""
+        if not self.enabled or rows <= 0:
+            return None
+        now = time.time() if ts is None else ts
+        with self._lock:
+            if len(self._pending) >= self.max_pending:
+                self.dropped += 1
+                return None
+            if trace_id is None:
+                self._seq += 1
+                trace_id = "%x-%d" % (os.getpid(), self._seq)
+            self._pending.append(
+                {"id": trace_id, "ingest_ts": now, "rows": rows})
+        return trace_id
+
+    def tick_begin(self) -> None:
+        """The tick that is about to drain the input queues starts: every
+        pending context's queue_wait ends here. Called by the controller
+        *before* it drains ``_pushed``/endpoint rows, so any context
+        stamped earlier has its rows included in this tick."""
+        if not self.enabled:
+            return
+        now = time.time()
+        with self._lock:
+            batch, self._pending = self._pending, []
+            self._tick_t0 = now
+            for ctx in batch:
+                ctx["queue_wait_s"] = max(0.0, now - ctx["ingest_ts"])
+            self._in_tick.extend(batch)
+
+    def tick_end(self) -> List[str]:
+        """The tick finished (step + output emission): contexts move to
+        the awaiting-publish pool. Returns the batch trace ids so the
+        controller can link its timeline tick record to them."""
+        if not self.enabled:
+            return []
+        now = time.time()
+        with self._lock:
+            t0, self._tick_t0 = self._tick_t0, None
+            moved, self._in_tick = self._in_tick, []
+            tick_s = max(0.0, now - t0) if t0 is not None else 0.0
+            for ctx in moved:
+                ctx["tick_s"] = tick_s
+                ctx["tick_end_ts"] = now
+            self._awaiting.extend(moved)
+            return [ctx["id"] for ctx in moved[:_MAX_IDS_PER_EPOCH]]
+
+    def note_publish(self, epoch: int,
+                     ts: Optional[float] = None) -> Optional[dict]:
+        """Seal every awaiting context into epoch ``epoch``'s annotation
+        (called by ``ReadPlane.publish`` under the plane lock — state move
+        only; pass the result to :meth:`flush_publish` after the plane
+        lock is released for the metric/span/timeline effects).
+
+        Stage arithmetic is exact for the oldest batch: queue_wait + tick
+        + publish sum to ``publish_ts - ingest_ts`` on one wall clock.
+        """
+        if not self.enabled:
+            return None
+        now = time.time() if ts is None else ts
+        with self._lock:
+            moved, self._awaiting = self._awaiting, []
+            if not moved:
+                return None
+            oldest = min(moved, key=lambda c: c["ingest_ts"])
+            ann = {
+                "ids": [c["id"] for c in moved[:_MAX_IDS_PER_EPOCH]],
+                "n": len(moved),
+                "rows": sum(c["rows"] for c in moved),
+                "epoch": epoch,
+                "ingest_ts": oldest["ingest_ts"],
+                "publish_ts": now,
+                "stages": {
+                    "queue_wait": oldest["queue_wait_s"],
+                    "tick": oldest["tick_s"],
+                    "publish": max(0.0, now - oldest["tick_end_ts"]),
+                },
+            }
+            self._by_epoch[epoch] = ann
+            while len(self._by_epoch) > self.max_epochs:
+                self._by_epoch.popitem(last=False)
+        return ann
+
+    def flush_publish(self, ann: Optional[dict]) -> None:
+        """Record the sealed epoch's writer stages: histogram samples, one
+        ``e2e`` span per stage in the writer's ring, and timeline
+        ``e2e_stage`` records for EXPLAIN SPIKE's stage detector."""
+        if ann is None:
+            return
+        for stage in ("queue_wait", "tick", "publish"):
+            self._record_stage(stage, ann["stages"][stage], ann["ids"],
+                               spans=self._spans)
+
+    def _record_stage(self, stage: str, seconds: float,
+                      ids: List[str], spans=None) -> None:
+        hist = self._hist
+        if hist is not None:
+            hist.labels(stage=stage).observe(seconds)
+        if spans is not None:
+            t1 = time.perf_counter_ns()
+            spans.span_at("e2e:" + stage, t1 - int(seconds * 1e9), t1,
+                          args={"trace": ids, "stage": stage,
+                                "seconds": round(seconds, 6)})
+        tl = self._timeline
+        if tl is not None:
+            tl.note_e2e_stage(stage, seconds, ids)
+
+    # -- lookups ------------------------------------------------------------
+    def for_epoch(self, epoch) -> Optional[dict]:
+        """The sealed annotation for one published epoch (None once it has
+        aged out of the bounded map, or for pre-tracing epochs)."""
+        if not self.enabled or epoch is None:
+            return None
+        with self._lock:
+            return self._by_epoch.get(epoch)
+
+    def annotate_read(self, resp: dict, t0_perf: float) -> dict:
+        """Attach ``age_s`` + per-stage breakdown to a primary ``/view``
+        response (resolved from the response's epoch); observes the serve
+        stage. Mutates and returns ``resp``."""
+        if not self.enabled:
+            return resp
+        serve_s = max(0.0, time.perf_counter() - t0_perf)
+        hist = self._hist
+        if hist is not None:
+            hist.labels(stage="serve").observe(serve_s)
+        ann = self.for_epoch(resp.get("epoch"))
+        if ann is not None:
+            stages = dict(ann["stages"])
+            stages["serve"] = serve_s
+            resp["age_s"] = max(0.0, time.time() - ann["ingest_ts"])
+            resp["stages"] = stages
+            resp["trace"] = {"ids": list(ann["ids"])}
+        return resp
+
+    # -- replica-side path --------------------------------------------------
+    def note_apply(self, ann: Optional[dict], recv_ts: float,
+                   apply_s: float, spans=None) -> Optional[dict]:
+        """Replica-side stage stamps for one applied changefeed record:
+        extends the writer annotation (same trace ids) with transport =
+        receipt - publish and the measured apply fold. ``spans`` is the
+        *replica's* ring, so the same delta shows up in both processes'
+        traces under identical ids."""
+        if not self.enabled or ann is None:
+            return None
+        transport_s = max(0.0, recv_ts - ann.get("publish_ts", recv_ts))
+        apply_s = max(0.0, apply_s)
+        ids = list(ann.get("ids", ()))
+        ext = dict(ann)
+        stages = dict(ann.get("stages", {}))
+        stages["transport"] = transport_s
+        stages["apply"] = apply_s
+        ext["stages"] = stages
+        ext["applied_ts"] = recv_ts + apply_s
+        # the stage spans go to the *replica's* ring, not the writer's
+        self._record_stage("transport", transport_s, ids, spans=spans)
+        self._record_stage("apply", apply_s, ids, spans=spans)
+        return ext
+
+    def annotate_replica_read(self, resp: dict, ext: Optional[dict],
+                              t0_perf: float) -> dict:
+        """Replica flavor of :meth:`annotate_read`: the stage breakdown
+        comes from the stored applied annotation (which already includes
+        transport/apply)."""
+        if not self.enabled:
+            return resp
+        serve_s = max(0.0, time.perf_counter() - t0_perf)
+        hist = self._hist
+        if hist is not None:
+            hist.labels(stage="serve").observe(serve_s)
+        # epoch gate: a fold can land between the table snapshot and this
+        # annotation — never label one epoch's rows with another's trace
+        if ext is not None and ext.get("epoch") == resp.get("epoch"):
+            stages = dict(ext["stages"])
+            stages["serve"] = serve_s
+            resp["age_s"] = max(0.0, time.time() - ext["ingest_ts"])
+            resp["stages"] = stages
+            resp["trace"] = {"ids": list(ext["ids"])}
+        return resp
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled, "seq": self._seq,
+                    "pending": len(self._pending),
+                    "in_tick": len(self._in_tick),
+                    "awaiting_publish": len(self._awaiting),
+                    "epochs": len(self._by_epoch),
+                    "dropped": self.dropped}

@@ -321,7 +321,7 @@ class ReadPlane:
 
     # -- publication (controller step path; plane lock only) ---------------
 
-    def publish(self) -> int:
+    def publish(self, tracer=None) -> int:
         """Swap in new snapshots for every view whose output advanced
         since the last publication; append exactly one changefeed record
         per changed view. Returns the (possibly unchanged) epoch.
@@ -329,10 +329,18 @@ class ReadPlane:
         Called by the controller AFTER outputs were emitted for the
         closing interval, while it still holds the step lock — so handle
         reads here are race-free. The epoch swap itself happens under the
-        plane's own ``_lock``; readers never take it."""
+        plane's own ``_lock``; readers never take it.
+
+        ``tracer`` (the controller's :class:`~dbsp_tpu.obs.tracing.
+        E2ETracer`) seals every awaiting trace context into this epoch's
+        annotation, which rides each changefeed record as ``trace`` —
+        that is how the context crosses to replicas. The seal is a pure
+        state move under the tracer's leaf lock; its metric/span/timeline
+        effects run after the plane lock is released."""
         if not self.enabled:
             return self.epoch
         now = time.time()
+        ann = None
         with self._lock:
             changed = []
             for vs in self._views.values():
@@ -344,11 +352,15 @@ class ReadPlane:
             if not changed:
                 return self.epoch
             epoch = self.epoch + 1
+            if tracer is not None:
+                ann = tracer.note_publish(epoch, ts=now)
             for vs, sid in changed:
-                self._publish_view_locked(vs, sid, epoch, now)
+                self._publish_view_locked(vs, sid, epoch, now, ann)
             self.epoch = epoch
             self.publishes += 1
             self.last_publish_ts = now
+        if tracer is not None:
+            tracer.flush_publish(ann)
         if self._publish_total is not None:
             self._publish_total.inc()
         with self._wakeup:
@@ -356,7 +368,9 @@ class ReadPlane:
         return epoch
 
     def _publish_view_locked(self, vs: _ViewState, sid: int, epoch: int,
-                             now: float) -> None:  # holds: _lock
+                             now: float,
+                             ann: Optional[dict] = None
+                             ) -> None:  # holds: _lock
         cur = vs.handle.peek()
         if vs.nkeys is None and cur is not None:
             vs.nkeys = len(cur.keys)
@@ -381,9 +395,15 @@ class ReadPlane:
         if vs.feed.maxlen is not None and len(vs.feed) == vs.feed.maxlen \
                 and vs.feed:
             vs.dropped_epoch = max(vs.dropped_epoch, vs.feed[0]["epoch"])
-        vs.feed.append({"view": vs.name, "epoch": epoch, "step": sid,
-                        "ts": now, "kind": "delta", "nkeys": vs.nkeys,
-                        "rows": delta_rows})
+        rec = {"view": vs.name, "epoch": epoch, "step": sid,
+               "ts": now, "kind": "delta", "nkeys": vs.nkeys,
+               "rows": delta_rows}
+        if ann is not None:
+            # the sealed e2e annotation (trace ids + writer-stage
+            # breakdown) is shared by reference across this epoch's
+            # records — JSON-safe and never mutated after the seal
+            rec["trace"] = ann
+        vs.feed.append(rec)
 
     # -- readers (zero locks on the snapshot path) --------------------------
 
@@ -567,11 +587,21 @@ class ReplicaServer:
 
     def __init__(self, primary: str, views: Sequence[str],
                  name: str = "replica", host: str = "127.0.0.1",
-                 port: int = 0, poll_timeout_s: float = 0.5):
+                 port: int = 0, poll_timeout_s: float = 0.5, e2e=None):
+        from dbsp_tpu.obs.tracing import SpanRecorder
+
         self.primary = primary.rstrip("/")
         self.views_served = tuple(views)
         self.name = name
         self.poll_timeout_s = float(poll_timeout_s)
+        # e2e delta tracing: the primary's in-process E2ETracer (manager
+        # wiring) — changefeed `trace` annotations extend with this
+        # replica's transport/apply stages; None = no stage attribution
+        self.e2e = e2e
+        # this replica's OWN span ring (its `/trace` surface): the same
+        # delta shows up here and in the writer's ring under identical
+        # trace ids, which is what the fleet trace merges on
+        self.spans = SpanRecorder(process=name)
         self._lock = threading.Lock()  # state/cursor/cache fold guard
         self._state: Dict[str, Dict[tuple, int]] = {
             v: {} for v in self.views_served}
@@ -582,6 +612,8 @@ class ReplicaServer:
             v: None for v in self.views_served}
         self._sorted: Dict[str, Optional[tuple]] = {
             v: None for v in self.views_served}
+        self._trace: Dict[str, Optional[dict]] = {
+            v: None for v in self.views_served}
         self.applied = 0
         self.stalled = False
         self._stop = threading.Event()
@@ -591,11 +623,14 @@ class ReplicaServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json(self, code: int, obj: dict) -> None:
+            def _json(self, code: int, obj: dict,
+                      headers: Optional[dict] = None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -606,8 +641,14 @@ class ReplicaServer:
                 try:
                     if parts[0] == "status":
                         self._json(200, plane.status())
+                    elif parts[0] == "trace":
+                        self._json(200, plane.spans.to_chrome_trace())
                     elif parts[0] == "view" and len(parts) == 2:
-                        self._json(200, plane.answer(parts[1], q))
+                        obj = plane.answer(parts[1], q)
+                        ids = (obj.get("trace") or {}).get("ids") or ()
+                        hdrs = {"X-Dbsp-Trace": ",".join(ids)} \
+                            if ids else None
+                        self._json(200, obj, headers=hdrs)
                     else:
                         self._json(404, {"error": "unknown route"})
                 except KeyError as e:
@@ -679,6 +720,8 @@ class ReplicaServer:
                 time.sleep(0.02)
 
     def _apply(self, view: str, recs: List[dict]) -> None:
+        recv_ts = time.time()
+        t0 = time.perf_counter()
         with self._lock:
             st = self._state[view]
             for rec in recs:
@@ -698,25 +741,45 @@ class ReplicaServer:
                 self._applied_ts[view] = rec["ts"]
                 self.applied += 1
             self._sorted[view] = None
+        if self.e2e is not None:
+            # stage stamps for the newest traced record of this fold:
+            # transport = receipt - primary publish (same-host wall
+            # clock), apply = the measured fold above. One annotation per
+            # fold — a catch-up burst is one transport/apply sample, not
+            # one per record.
+            ann = next((r["trace"] for r in reversed(recs)
+                        if r.get("trace")), None)
+            ext = self.e2e.note_apply(ann, recv_ts,
+                                      time.perf_counter() - t0,
+                                      spans=self.spans)
+            if ext is not None:
+                with self._lock:
+                    self._trace[view] = ext
 
     # -- reads --------------------------------------------------------------
 
     def _table(self, view: str) -> tuple:
-        """(rows, weights) sorted parallel lists — lazily rebuilt after a
-        fold, served to many readers by reference."""
+        """(rows, weights, epoch, ts, nkeys) — one immutable tuple built
+        under the fold lock, lazily rebuilt after a fold and served to
+        many readers by reference. Epoch/ts ride in the SAME tuple as the
+        rows so a read can never pair one fold's rows with another fold's
+        cursor (the serial-twin test hammers exactly that window)."""
         cached = self._sorted[view]
         if cached is not None:
             return cached
         with self._lock:
             items = sorted(self._state[view].items())
-            cached = ([t for t, _ in items], [w for _, w in items])
+            cached = ([t for t, _ in items], [w for _, w in items],
+                      self._cursor[view], self._applied_ts[view],
+                      self._nkeys[view])
             self._sorted[view] = cached
         return cached
 
     def answer(self, view: str, q: Dict[str, list]) -> dict:
+        t0 = time.perf_counter()
         if view not in self._state:
             raise KeyError(view)
-        rows_t, ws = self._table(view)
+        rows_t, ws, epoch, ts, nkeys = self._table(view)
         if "key" in q:
             prefix = tuple(int(x) for x in q["key"][0].split(","))
             b = bisect.bisect_left(rows_t, prefix)
@@ -735,11 +798,15 @@ class ReplicaServer:
             out = [[*t, w] for t, w in zip(rows_t, ws)]
         if "limit" in q:
             out = out[:int(q["limit"][0])]
-        return {"view": view, "epoch": self._cursor[view],
-                "ts": self._applied_ts[view], "replica": self.name,
-                "nkeys": self._nkeys[view], "rows": out}
+        resp = {"view": view, "epoch": epoch, "ts": ts,
+                "replica": self.name, "nkeys": nkeys, "rows": out}
+        if self.e2e is not None:
+            self.e2e.annotate_replica_read(resp, self._trace.get(view), t0)
+        return resp
 
     def status(self) -> dict:
         return {"name": self.name, "stalled": self.stalled,
                 "applied": self.applied, "epochs": dict(self._cursor),
-                "applied_ts": dict(self._applied_ts)}
+                "applied_ts": dict(self._applied_ts),
+                "trace_e2e": bool(self.e2e is not None
+                                  and self.e2e.enabled)}

@@ -1,0 +1,403 @@
+"""Tier-1 acceptance for fleet-wide end-to-end delta tracing
+(``dbsp_tpu/obs/tracing.py`` — README §Observability).
+
+Contracts, each tested non-vacuously:
+
+* **Exact stage decomposition** — for the oldest batch of a published
+  epoch, ``queue_wait + tick + publish`` equals ``publish_ts -
+  ingest_ts`` to float precision: the writer-side stages are a
+  partition of the delta's measured age, not independent estimates.
+* **Kill switch** — ``DBSP_TPU_TRACE_E2E=0`` (and friends) disables
+  every e2e surface; the OFF tracer mints no ids and records nothing.
+* **Real pid/tid lanes** — spans emitted from two threads land on two
+  distinct tid lanes with thread_name metadata; ring overflow exports
+  ``dbsp_tpu_obs_trace_dropped_total`` and marks the trace truncated.
+* **HTTP propagation** — a pushed ``X-Dbsp-Trace`` header is adopted
+  as the batch's trace id and comes back on the ``/view`` response for
+  the epoch that delta landed in, with ``age_s`` + per-stage breakdown;
+  the changefeed record carries the sealed annotation; the manager's
+  ``/fleet/trace`` merges writer + replica rings into one
+  Perfetto-loadable trace holding both processes' e2e spans.
+* **Replica serial twin under tsan** (the hammer): concurrent
+  ``/view`` + ``/changefeed`` reads against a live ReplicaServer while
+  ``_apply`` folds race under a seeded interleaving schedule — every
+  answer must be bit-identical to a serial fold of the changefeed at
+  that answer's epoch, with zero sanitizer violations.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from dbsp_tpu.circuit import Runtime
+from dbsp_tpu.io.catalog import Catalog
+from dbsp_tpu.io.controller import Controller, ControllerConfig
+from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                              build_inputs, queries)
+from dbsp_tpu.nexmark import model as M
+from dbsp_tpu.obs.tracing import (E2E_STAGES, E2ETracer, SpanRecorder,
+                                  merge_chrome_traces, trace_e2e_enabled)
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, json.loads(r.read() or b"{}"), dict(r.headers)
+
+
+# ---------------------------------------------------------------------------
+# exact stage decomposition + kill switch (pure tracer, no pipeline)
+# ---------------------------------------------------------------------------
+
+
+def test_writer_stages_partition_delta_age_exactly():
+    tr = E2ETracer(enabled=True)
+    i1 = tr.note_ingest(10)
+    time.sleep(0.01)
+    i2 = tr.note_ingest(5)
+    assert i1 and i2 and i1 != i2
+    tr.tick_begin()
+    time.sleep(0.005)
+    ids = tr.tick_end()
+    assert set(ids) == {i1, i2}
+    ann = tr.note_publish(epoch=3)
+    assert ann is not None and ann["epoch"] == 3 and ann["rows"] == 15
+    # the decomposition claim: stages partition the OLDEST batch's age
+    total = ann["publish_ts"] - ann["ingest_ts"]
+    parts = ann["stages"]
+    assert set(parts) == {"queue_wait", "tick", "publish"}
+    assert abs(sum(parts.values()) - total) < 1e-9
+    assert parts["queue_wait"] >= 0.01 and parts["tick"] >= 0.005
+    assert tr.for_epoch(3) is ann and tr.for_epoch(99) is None
+
+    # read annotation: age + stages + ids for the served epoch
+    resp = {"epoch": 3}
+    tr.annotate_read(resp, time.perf_counter())
+    assert resp["age_s"] >= total
+    assert set(resp["stages"]) == {"queue_wait", "tick", "publish",
+                                   "serve"}
+    assert resp["trace"]["ids"] == list(ann["ids"])
+
+    # replica side: transport/apply extend the same annotation, same ids
+    ext = tr.note_apply(ann, ann["publish_ts"] + 0.02, 0.004)
+    assert ext["ids"] == ann["ids"]
+    assert abs(ext["stages"]["transport"] - 0.02) < 1e-6
+    assert ext["stages"]["apply"] == pytest.approx(0.004)
+    rresp = {"epoch": 3}
+    tr.annotate_replica_read(rresp, ext, time.perf_counter())
+    assert set(rresp["stages"]) == set(E2E_STAGES)
+    assert rresp["trace"]["ids"] == list(ann["ids"])
+
+
+def test_kill_switch_env_values(monkeypatch):
+    for v in ("0", "false", "no", "off"):
+        assert not trace_e2e_enabled({"DBSP_TPU_TRACE_E2E": v})
+    for v in ("1", "true", "yes", "on"):
+        assert trace_e2e_enabled({"DBSP_TPU_TRACE_E2E": v})
+    assert trace_e2e_enabled({})  # default on
+    monkeypatch.setenv("DBSP_TPU_TRACE_E2E", "0")
+    tr = E2ETracer()
+    assert not tr.enabled
+    assert tr.note_ingest(10) is None
+    tr.tick_begin()
+    assert tr.tick_end() == []
+    assert tr.note_publish(1) is None
+    resp = {"epoch": 1}
+    tr.annotate_read(resp, time.perf_counter())
+    assert "age_s" not in resp and "stages" not in resp
+
+
+def test_bounded_pools_drop_not_grow():
+    tr = E2ETracer(enabled=True, max_pending=4, max_epochs=2)
+    ids = [tr.note_ingest(1) for _ in range(10)]
+    assert sum(1 for i in ids if i) == 4 and tr.stats()["dropped"] == 6
+    for epoch in (1, 2, 3):
+        tr.note_ingest(1)
+        tr.tick_begin()
+        tr.tick_end()
+        tr.note_publish(epoch)
+    assert tr.stats()["epochs"] == 2
+    assert tr.for_epoch(1) is None  # evicted, bounded
+    assert tr.for_epoch(3) is not None
+
+
+# ---------------------------------------------------------------------------
+# SpanRecorder: real pid/tid lanes, dropped export, atomic span_at pairs
+# ---------------------------------------------------------------------------
+
+
+def test_spans_land_on_real_thread_lanes():
+    rec = SpanRecorder(max_steps=16, process="lanes")
+
+    def work(name):
+        with rec.span(f"op-{name}"):
+            time.sleep(0.002)
+
+    ts = [threading.Thread(target=work, args=(i,), name=f"lane-{i}")
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    ct = rec.to_chrome_trace()
+    import os
+    evs = [e for e in ct["traceEvents"] if e["ph"] in ("B", "E")]
+    assert evs and all(e["pid"] == os.getpid() for e in evs)
+    assert len({e["tid"] for e in evs}) == 2, "one lane per thread"
+    meta = [e for e in ct["traceEvents"] if e["ph"] == "M"]
+    names = {e["args"]["name"] for e in meta
+             if e["name"] == "thread_name"}
+    assert {"lane-0", "lane-1"} <= names
+    assert any(e["name"] == "process_name" and
+               e["args"]["name"] == "lanes" for e in meta)
+
+
+def test_dropped_steps_exported_and_truncation_marked():
+    from dbsp_tpu.obs.export import prometheus_text
+    from dbsp_tpu.obs.registry import MetricsRegistry
+
+    rec = SpanRecorder(max_steps=2, process="tiny")
+    reg = MetricsRegistry()
+    rec.bind(reg, pipeline="p0")
+    for i in range(5):
+        with rec.span(f"s{i}"):
+            pass
+    assert rec.dropped_steps == 3
+    assert rec.to_chrome_trace()["otherData"]["truncated"] is True
+    text = prometheus_text(reg)
+    assert "dbsp_tpu_obs_trace_dropped_total" in text
+    assert 'pipeline="p0"' in text and " 3" in text
+
+
+def test_span_at_pairs_always_balanced():
+    rec = SpanRecorder(max_steps=8)
+    t = time.time_ns()
+    rec.span_at("e2e:tick", t - 1000, t, args={"trace": ["x-1"]})
+    evs = rec.events()
+    assert [e["ph"] for e in evs] == ["B", "E"]
+    assert evs[0]["ts"] <= evs[1]["ts"]
+    assert evs[0]["args"]["trace"] == ["x-1"]
+    merged = merge_chrome_traces([rec.to_chrome_trace(),
+                                  rec.to_chrome_trace()])
+    assert merged["displayTimeUnit"] == "ms"
+    assert len([e for e in merged["traceEvents"]
+                if e["ph"] in ("B", "E")]) == 4
+
+
+# ---------------------------------------------------------------------------
+# HTTP propagation end to end: push header -> /view -> changefeed ->
+# replica -> fleet trace (manager surface)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_flows_push_to_read_across_fleet(monkeypatch):
+    from dbsp_tpu.client import Connection
+    from dbsp_tpu.manager import PipelineManager
+
+    monkeypatch.setenv("DBSP_TPU_MANAGER_COMPILED", "0")
+    mgr = PipelineManager()
+    mgr.start()
+    try:
+        conn = Connection(port=mgr.port)
+        conn.create_program("prog", {
+            "t": {"columns": ["k", "v"], "dtypes": ["int64", "int64"],
+                  "key_columns": 1}},
+            {"view": "SELECT k, v FROM t WHERE v >= 0"})
+        pipe = conn.start_pipeline("traced", "prog",
+                                   config={"min_batch_records": 10 ** 9,
+                                           "flush_interval_s": 3600.0})
+        # caller-minted id: the header is adopted, not replaced
+        n = pipe.push("t", [[i, i] for i in range(6)],
+                      trace="cafe-42")
+        assert n == 6 and pipe.last_trace == "cafe-42"
+        pipe.step()
+
+        code, obj, hdrs = _get(pipe.base, "/view/view")
+        assert code == 200
+        assert obj["rows"] == [[i, i, 1] for i in range(6)]
+        assert "cafe-42" in obj["trace"]["ids"]
+        assert "cafe-42" in hdrs.get("X-Dbsp-Trace", "")
+        assert obj["age_s"] > 0
+        stages = obj["stages"]
+        assert set(stages) == {"queue_wait", "tick", "publish", "serve"}
+        # attribution completeness: the named writer stages ARE the age
+        # (serve excluded: it postdates publish)
+        writer = stages["queue_wait"] + stages["tick"] + stages["publish"]
+        assert writer <= obj["age_s"] + 1e-6
+
+        # the sealed annotation rides the changefeed record
+        code, feed, _ = _get(pipe.base, "/changefeed?view=view&after=0")
+        rec = feed["records"][-1]
+        assert "cafe-42" in rec["trace"]["ids"]
+        assert rec["trace"]["epoch"] == rec["epoch"]
+
+        # minted-id path: no header -> the server mints and echoes one
+        assert pipe.push("t", [[100, 1]]) == 1
+        minted = pipe.last_trace
+        assert minted and "-" in minted
+        pipe.step()
+
+        # replica: same ids, stages extended with transport/apply
+        conn.add_replicas("traced", 1)
+        deadline = time.time() + 15
+        robj = None
+        while time.time() < deadline:
+            sts = conn.replicas("traced")
+            if sts[0]["applied"] > 0 and sts[0]["staleness_s"] == 0.0:
+                robj = conn.read_view("traced", "view", key=100)
+                if robj.get("trace"):
+                    break
+            time.sleep(0.05)
+        assert robj and minted in robj["trace"]["ids"]
+        assert set(robj["stages"]) == set(E2E_STAGES)
+        assert robj["replica"] != "traced"  # served by the replica
+
+        # fleet trace: one merged ring, writer + replica lanes, with the
+        # SAME trace id visible in both processes' e2e spans
+        fleet = conn.fleet_trace()
+        evs = fleet["traceEvents"]
+        procs = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert len(procs) >= 2, f"expected writer+replica lanes: {procs}"
+        e2e_spans = [e for e in evs if e["ph"] == "B"
+                     and e.get("cat") == "e2e"]
+        by_stage = {}
+        for e in e2e_spans:
+            by_stage.setdefault(e["name"], []).append(e)
+        assert {"e2e:transport", "e2e:apply"} <= set(by_stage)
+        traced = [e for e in e2e_spans
+                  if minted in (e["args"].get("trace") or ())]
+        assert {e["name"] for e in traced} >= {"e2e:transport",
+                                               "e2e:apply"}
+
+        # the stage histogram is exported per stage
+        text = pipe.metrics()
+        assert "dbsp_tpu_e2e_stage_seconds_bucket" in text
+        for st in ("queue_wait", "tick", "publish", "serve"):
+            assert f'stage="{st}"' in text
+
+        conn.remove_replicas("traced")
+        conn.shutdown_pipeline("traced")
+    finally:
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# the hammer: replica answers == serial twin under seeded interleaving
+# ---------------------------------------------------------------------------
+
+
+def _register_inputs(catalog, handles):
+    for name, h, key, vals in (
+            ("persons", handles[0], M.PERSON_KEY, M.PERSON_VALS),
+            ("auctions", handles[1], M.AUCTION_KEY, M.AUCTION_VALS),
+            ("bids", handles[2], M.BID_KEY, M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+
+
+def _served_q4():
+    from dbsp_tpu.io.server import CircuitServer
+    from dbsp_tpu.obs import PipelineObs
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    _register_inputs(catalog, handles)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    ctl = Controller(handle, catalog, ControllerConfig(
+        min_batch_records=10 ** 9, flush_interval_s=3600.0))
+    obs = PipelineObs(name="e2e-hammer")
+    obs.attach_circuit(handle.circuit)
+    obs.attach_controller(ctl)
+    srv = CircuitServer(ctl, obs=obs)
+    srv.start()
+    return ctl, handles, srv
+
+
+def test_replica_answers_match_serial_twin_under_tsan():
+    """3 reader threads hammer a live replica's /view (+ the primary's
+    /changefeed) while the feed thread folds new epochs, with a seeded
+    interleaving schedule widening every ReplicaServer lock window.
+    Every observed answer must equal a serial fold of the changefeed at
+    exactly that answer's epoch — the consistency contract the
+    (rows, epoch) snapshot tuple exists to uphold — and the sanitizer
+    must see zero guard/lockset/order violations."""
+    from dbsp_tpu.serving import ReplicaServer
+    from dbsp_tpu.testing import tsan
+    from dbsp_tpu.testing.faults import InterleaveSchedule
+
+    sched = InterleaveSchedule(seed=29, rate=0.4, sleep_s=0.001,
+                               max_yields=600,
+                               only=("ReplicaServer.",))
+    observed = []
+    obs_lock = threading.Lock()
+    with tsan.session(schedule=sched) as report:
+        ctl, handles, srv = _served_q4()
+        base = f"http://127.0.0.1:{srv.port}"
+        rep = ReplicaServer(base, ["q4"], name="rep-tsan",
+                            e2e=ctl.e2e).start()
+        stop = threading.Event()
+
+        def storm():
+            while not stop.is_set():
+                code, obj, _ = _get(rep.base_url, "/view/q4")
+                assert code == 200
+                with obs_lock:
+                    observed.append(
+                        (obj["epoch"],
+                         [(tuple(r[:-1]), r[-1]) for r in obj["rows"]]))
+                _get(base, "/changefeed?view=q4&after=0")
+
+        readers = [threading.Thread(target=storm, name=f"rd-{i}")
+                   for i in range(3)]
+        gen = NexmarkGenerator(GeneratorConfig(seed=17))
+        try:
+            for r in readers:
+                r.start()
+            for t in range(5):
+                gen.feed(handles, t * 150, (t + 1) * 150)
+                ctl.note_pushed(150)
+                ctl.step()
+                time.sleep(0.05)  # let folds interleave with reads
+            deadline = time.time() + 20
+            while time.time() < deadline and \
+                    rep.status()["epochs"]["q4"] < ctl.read_plane.epoch:
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for r in readers:
+                r.join(timeout=30)
+            rep.stop()
+            srv.stop()
+        assert all(not r.is_alive() for r in readers)
+        assert rep.status()["epochs"]["q4"] == ctl.read_plane.epoch
+
+        # serial twin: fold the changefeed once, remembering the state
+        # at every epoch boundary
+        out = ctl.read_plane.changefeed("q4", after_epoch=0)
+        twin, by_epoch = {}, {0: []}
+        for rec in out["records"]:
+            for row in rec["rows"]:
+                t, w = tuple(row[:-1]), row[-1]
+                nw = twin.get(t, 0) + w
+                if nw:
+                    twin[t] = nw
+                else:
+                    twin.pop(t, None)
+            by_epoch[rec["epoch"]] = sorted(twin.items())
+        assert observed, "storm read nothing"
+        for epoch, rows in observed:
+            assert rows == by_epoch[epoch], \
+                f"answer at epoch {epoch} diverged from serial twin"
+        # non-vacuity: reads raced real folds, and the schedule injected
+        assert {e for e, _ in observed if e > 0}, "no post-fold reads"
+        assert sched.yields > 0
+    assert report.violations == [], tsan.TsanViolations(report.violations)

@@ -85,6 +85,17 @@ the tier-1 test in tests/test_analysis.py):
    zero spikes, freshness samples must flow arrival->visibility, and the
    always-on note_* hot path must stay under its per-op overhead bound.
    The import-based tier-1 consumer is tests/test_timeline.py.
+8. **Read-path front** (CLI only; DBSP_TPU_LINT_READPATH=0 skips) — a
+   served q4 under a tsan interleaving probe: hammered lock-free reads
+   stay race-clean and consistent (see ``run_readpath_dryrun``). The
+   import-based tier-1 consumer is tests/test_readpath.py.
+9. **Tracing front** (CLI only; DBSP_TPU_LINT_TRACING=0 skips) — a
+   served q4 + replica dryrun: span rings B/E-balanced on real
+   pid/tid lanes, >= 95% of a fresh read's e2e age attributed to named
+   stages, one delta's trace id identical across the writer and replica
+   rings, and the ``DBSP_TPU_TRACE_E2E=0`` control recording zero e2e
+   spans (see ``run_tracing_dryrun``). The import-based tier-1 consumer
+   is tests/test_e2e_tracing.py.
 
 Usage: ``python tools/lint_all.py`` — prints a per-front summary and exits
 1 when any front fails. ``--static`` runs only the pure-static fronts
@@ -1104,6 +1115,238 @@ def run_readpath_dryrun() -> list:
     return violations
 
 
+def _tracing_dryrun_child() -> None:
+    """Subprocess body for the tracing front: a served host-engine q4
+    pipeline (CircuitServer) feeding a live ReplicaServer, with
+    DBSP_TPU_TRACE_E2E taken from the environment. Pushes one delta
+    under a known trace id, reads it back over HTTP from the primary
+    the instant the tick lands (age attribution) and from the replica
+    after its fold (trace-id identity across process rings), then dumps
+    both span rings' per-(pid,tid) B/E balance, the e2e span counts and
+    stage ids, and the stage histogram's populated label set as one
+    JSON line."""
+    import json
+    import re
+    import time
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from dbsp_tpu.circuit import Runtime
+    from dbsp_tpu.io.catalog import Catalog
+    from dbsp_tpu.io.controller import Controller, ControllerConfig
+    from dbsp_tpu.io.server import CircuitServer
+    from dbsp_tpu.nexmark import (GeneratorConfig, NexmarkGenerator,
+                                  build_inputs, queries)
+    from dbsp_tpu.nexmark import model as M
+    from dbsp_tpu.obs import PipelineObs
+    from dbsp_tpu.obs.export import prometheus_text
+    from dbsp_tpu.serving import ReplicaServer
+
+    def build(c):
+        streams, handles = build_inputs(c)
+        return handles, queries.q4(*streams).output()
+
+    handle, (handles, out) = Runtime.init_circuit(1, build)
+    catalog = Catalog()
+    for name, h, key, vals in (("persons", handles[0], M.PERSON_KEY,
+                                M.PERSON_VALS),
+                               ("auctions", handles[1], M.AUCTION_KEY,
+                                M.AUCTION_VALS),
+                               ("bids", handles[2], M.BID_KEY,
+                                M.BID_VALS)):
+        catalog.register_input(name, h, key + vals)
+    catalog.register_output("q4", out, (jnp.int64, jnp.int64))
+    ctl = Controller(handle, catalog, ControllerConfig(
+        min_batch_records=10**9, flush_interval_s=3600.0))
+    obs = PipelineObs(name="lint-tracing")
+    obs.attach_circuit(handle.circuit)
+    obs.attach_controller(ctl)
+    srv = CircuitServer(ctl, obs=obs)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    rep = ReplicaServer(base, ["q4"], name="lint-replica",
+                        e2e=ctl.e2e).start()
+    gen = NexmarkGenerator(GeneratorConfig(seed=23))
+
+    def get(url):
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return json.loads(r.read() or b"{}"), dict(r.headers)
+
+    ept = 400  # big enough that the tick dominates the delta's age
+    try:
+        for t in range(3):
+            gen.feed(handles, t * ept, (t + 1) * ept)
+            ctl.note_pushed(ept)
+            ctl.step()
+        # the probed delta: a known trace id through the whole path
+        gen.feed(handles, 3 * ept, 4 * ept)
+        delta_id = ctl.note_pushed(ept)
+        ctl.step()
+        obj, hdrs = get(base + "/view/q4")  # read NOW: age ~= stages
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                rep.status()["epochs"]["q4"] < ctl.read_plane.epoch:
+            time.sleep(0.005)
+        robj, rhdrs = get(rep.base_url + "/view/q4")
+        rings = {"writer": obs.spans.to_chrome_trace(),
+                 "replica": rep.spans.to_chrome_trace()}
+    finally:
+        rep.stop()
+        srv.stop()
+
+    def ring_summary(ct):
+        depth, nbe, e2e_ids = {}, 0, {}
+        for e in ct["traceEvents"]:
+            if e["ph"] not in ("B", "E"):
+                continue
+            nbe += 1
+            lane = f"{e['pid']}/{e['tid']}"
+            d = depth.get(lane, 0) + (1 if e["ph"] == "B" else -1)
+            depth[lane] = d
+            if d < 0:
+                break  # negative depth: report it as-is
+            if e["ph"] == "B" and e.get("cat") == "e2e":
+                for tid_ in (e.get("args", {}).get("trace") or ()):
+                    e2e_ids.setdefault(
+                        e["name"].replace("e2e:", ""), []).append(tid_)
+        return {"events": nbe, "lane_depths": depth,
+                "e2e_spans": sum(len(v) for v in e2e_ids.values()),
+                "ids_by_stage": e2e_ids}
+
+    stages = obj.get("stages") or {}
+    hist_stages = sorted(set(re.findall(
+        r'dbsp_tpu_e2e_stage_seconds_count\{[^}]*stage="(\w+)"[^}]*\} '
+        r'[1-9]', prometheus_text(obs.registry))))
+    print(json.dumps({
+        "enabled": ctl.e2e.enabled,
+        "delta_id": delta_id,
+        "view": {"age_s": obj.get("age_s"), "stages": stages,
+                 "trace_ids": (obj.get("trace") or {}).get("ids"),
+                 "header": hdrs.get("X-Dbsp-Trace")},
+        "attributed_frac": (sum(stages.values()) / obj["age_s"]
+                            if stages and obj.get("age_s") else 0.0),
+        "replica_view": {"trace_ids":
+                         (robj.get("trace") or {}).get("ids"),
+                         "stages": sorted(robj.get("stages") or ()),
+                         "header": rhdrs.get("X-Dbsp-Trace")},
+        "rings": {k: ring_summary(v) for k, v in rings.items()},
+        "hist_stages": hist_stages,
+    }))
+
+
+def run_tracing_dryrun() -> list:
+    """9. **Tracing front** (subprocess; CLI runs it by default,
+    ``DBSP_TPU_LINT_TRACING=0`` skips — tests/test_e2e_tracing.py
+    carries the import-based tier-1 coverage): a served q4 + replica
+    dryrun MUST show (a) every span ring lane B/E-balanced, (b) >= 95%
+    of a fresh read's measured e2e age attributed to named stages,
+    (c) the SAME trace id on the writer ring's publish span and the
+    replica ring's transport/apply spans for one delta (the fleet-trace
+    join key), and (d) the OFF control (``DBSP_TPU_TRACE_E2E=0``)
+    recording zero e2e spans, no read annotations and an empty stage
+    histogram — the kill switch proven live, the detector non-vacuous."""
+    import json
+    import subprocess
+
+    if os.environ.get("DBSP_TPU_LINT_TRACING", "1") == "0":
+        print("lint_all: tracing_dryrun: skipped "
+              "(DBSP_TPU_LINT_TRACING=0)")
+        return []
+
+    def child(on):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DBSP_TPU_TRACE_E2E="1" if on else "0")
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "from tools.lint_all import _tracing_dryrun_child; "
+                 "_tracing_dryrun_child()"],
+                cwd=_ROOT, env=env, capture_output=True, text=True,
+                timeout=600)
+        except subprocess.TimeoutExpired:
+            return None, "tracing dryrun timed out after 600s"
+        if p.returncode != 0:
+            return None, (f"tracing dryrun failed:\n{p.stdout[-800:]}\n"
+                          f"{p.stderr[-800:]}")
+        try:
+            return json.loads(p.stdout.strip().splitlines()[-1]), None
+        except (ValueError, IndexError):
+            return None, f"tracing dryrun emitted no JSON:\n" \
+                         f"{p.stdout[-400:]}"
+
+    violations = []
+    on, err = child(on=True)
+    if err:
+        return [err]
+    for ring, summ in on.get("rings", {}).items():
+        if not summ.get("events"):
+            violations.append(
+                f"{ring} span ring recorded no events — the trace "
+                "surface is dead and every claim below is vacuous")
+        bad = {k: v for k, v in summ.get("lane_depths", {}).items() if v}
+        if bad:
+            violations.append(
+                f"{ring} span ring has unbalanced B/E lanes {bad} — "
+                "the Chrome trace would render phantom open spans")
+    frac = on.get("attributed_frac", 0.0)
+    if frac < 0.95:
+        violations.append(
+            f"only {frac:.1%} of the fresh read's e2e age is attributed "
+            f"to named stages (stages={json.dumps(on['view']['stages'])},"
+            f" age_s={on['view']['age_s']}) — the decomposition leaks")
+    did = on.get("delta_id")
+    wids = on.get("rings", {}).get("writer", {}).get("ids_by_stage", {})
+    rids = on.get("rings", {}).get("replica", {}).get("ids_by_stage", {})
+    if not did or did not in wids.get("publish", []):
+        violations.append(
+            f"probed delta id {did} missing from the writer ring's "
+            f"publish spans ({json.dumps(wids)}) — writer-side stage "
+            "spans are not keyed by trace id")
+    for st in ("transport", "apply"):
+        if did and did not in rids.get(st, []):
+            violations.append(
+                f"probed delta id {did} missing from the replica ring's "
+                f"{st} spans ({json.dumps(rids)}) — the fleet trace "
+                "cannot join this delta across processes")
+    if did and did not in (on["view"]["trace_ids"] or []):
+        violations.append(
+            f"/view response served the probed epoch without its trace "
+            f"id ({json.dumps(on['view'])}) — read attribution is "
+            "disconnected from ingest")
+    need = {"queue_wait", "tick", "publish", "serve", "transport",
+            "apply"}
+    have = set(on.get("hist_stages", []))
+    if not need <= have:
+        violations.append(
+            f"stage histogram missing samples for "
+            f"{sorted(need - have)} (have {sorted(have)}) — "
+            "dbsp_tpu_e2e_stage_seconds does not cover the taxonomy")
+
+    off, err = child(on=False)
+    if err:
+        return violations + [err]
+    off_e2e = {k: v.get("e2e_spans", 0)
+               for k, v in off.get("rings", {}).items()}
+    if off.get("enabled") or any(off_e2e.values()):
+        violations.append(
+            f"OFF control (DBSP_TPU_TRACE_E2E=0) still recorded e2e "
+            f"spans ({off_e2e}) — the kill switch is dead")
+    if off.get("delta_id") is not None or off.get("view", {}).get(
+            "age_s") is not None or off.get("hist_stages"):
+        violations.append(
+            f"OFF control still minted ids / annotated reads / filled "
+            f"the stage histogram (id={off.get('delta_id')}, "
+            f"view={json.dumps(off.get('view'))}, "
+            f"hist={off.get('hist_stages')}) — tracing work survives "
+            "the kill switch")
+    return violations
+
+
 #: the pure-static fronts (``--static``): AST/file passes only — no
 #: subprocess dryruns, no circuit builds, no jax compilation
 STATIC_FRONTS = (("check_metrics", run_check_metrics),
@@ -1136,7 +1379,8 @@ def main(argv=None) -> int:
                   ("profile_dryrun", run_profile_dryrun),
                   ("lineage_dryrun", run_lineage_dryrun),
                   ("timeline_dryrun", run_timeline_dryrun),
-                  ("readpath_dryrun", run_readpath_dryrun)]
+                  ("readpath_dryrun", run_readpath_dryrun),
+                  ("tracing_dryrun", run_tracing_dryrun)]
     failed = 0
     for name, fn in fronts:
         violations = fn()
